@@ -1,0 +1,13 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A ground-up re-design of Pilosa (reference: /root/reference, Go) for TPU:
+the storage hierarchy (holder -> index -> field -> view -> fragment), the PQL
+query language and the HTTP API are kept compatible, but query execution lowers
+to XLA/Pallas bitwise + popcount kernels over dense HBM-resident bitmap blocks,
+with shard fan-out via jax shard_map over a device mesh and reductions riding
+ICI collectives (lax.psum / top_k merges) instead of HTTP map-reduce.
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP

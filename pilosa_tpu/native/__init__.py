@@ -1,0 +1,101 @@
+"""Native (C++) helpers with pure-Python fallbacks.
+
+The Go reference is a single static binary; here the Python control plane
+offloads its few byte-at-a-time hot loops (FNV/xxhash hashing for op-log
+checksums, partition hashing, and block checksums) to a small C++ library
+built on first use with g++. If no toolchain is available every function
+falls back to a pure-Python implementation with identical outputs.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "hash.cpp")
+_LIB = os.path.join(_HERE, "build", "libpilosa_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+FNV32_OFFSET = 2166136261
+FNV64_OFFSET = 14695981039346656037
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        for attempt in ("load", "rebuild"):
+            try:
+                stale = not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+                if stale or attempt == "rebuild":
+                    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+                    subprocess.run(
+                        ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                        check=True,
+                        capture_output=True,
+                    )
+                lib = ctypes.CDLL(_LIB)
+                lib.pilosa_fnv32a.restype = ctypes.c_uint32
+                lib.pilosa_fnv32a.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+                lib.pilosa_fnv64a.restype = ctypes.c_uint64
+                lib.pilosa_fnv64a.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+                lib.pilosa_xxhash64.restype = ctypes.c_uint64
+                lib.pilosa_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+                _lib = lib
+                return _lib
+            except Exception:
+                # A stale/wrong-arch .so can fail to load: retry once with a
+                # forced rebuild before giving up on the native path.
+                continue
+        _build_failed = True
+        import warnings
+
+        warnings.warn(
+            "pilosa_tpu native helper library unavailable; using pure-Python "
+            "fallbacks (slower; xxhash64 block checksums use a different "
+            "algorithm — do not mix native and fallback nodes in one cluster)"
+        )
+    return _lib
+
+
+def fnv32a(data: bytes, h: int = FNV32_OFFSET) -> int:
+    lib = _load()
+    if lib is not None:
+        return lib.pilosa_fnv32a(data, len(data), h)
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def fnv64a(data: bytes, h: int = FNV64_OFFSET) -> int:
+    lib = _load()
+    if lib is not None:
+        return lib.pilosa_fnv64a(data, len(data), h)
+    for b in data:
+        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def xxhash64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is not None:
+        return lib.pilosa_xxhash64(data, len(data), seed)
+    import hashlib
+
+    # Fallback: not the xxhash algorithm, but block checksums only need to be
+    # consistent among our own nodes (all nodes agree on which path they use;
+    # a native/fallback mixed cluster is not supported).
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def has_native() -> bool:
+    return _load() is not None

@@ -1,0 +1,99 @@
+// Native helpers for pilosa_tpu: FNV hashing for the op-log checksum and
+// shard partitioning, plus hot byte-level utilities that are slow in pure
+// Python. Compiled to a shared library loaded via ctypes
+// (pilosa_tpu/native/__init__.py); every entry point has a pure-Python
+// fallback so the framework still runs without a C++ toolchain.
+//
+// Reference behavior mirrored:
+//  - fnv32a: op record checksum (reference roaring/roaring.go op.WriteTo)
+//  - fnv64a: shard->partition hash (reference cluster.go:871-880)
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+uint32_t pilosa_fnv32a(const uint8_t* data, size_t n, uint32_t h) {
+    for (size_t i = 0; i < n; i++) {
+        h ^= (uint32_t)data[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+uint64_t pilosa_fnv64a(const uint8_t* data, size_t n, uint64_t h) {
+    for (size_t i = 0; i < n; i++) {
+        h ^= (uint64_t)data[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+// xxhash64 (used for fragment block checksums, reference fragment.go:2814
+// blockHasher uses cespare/xxhash). Independent implementation from the
+// public algorithm spec.
+static inline uint64_t rotl64(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+static const uint64_t PRIME1 = 11400714785074694791ULL;
+static const uint64_t PRIME2 = 14029467366897019727ULL;
+static const uint64_t PRIME3 = 1609587929392839161ULL;
+static const uint64_t PRIME4 = 9650029242287828579ULL;
+static const uint64_t PRIME5 = 2870177450012600261ULL;
+
+static inline uint64_t read64(const uint8_t* p) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    return v;
+}
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    __builtin_memcpy(&v, p, 4);
+    return v;
+}
+
+uint64_t pilosa_xxhash64(const uint8_t* data, size_t n, uint64_t seed) {
+    const uint8_t* p = data;
+    const uint8_t* end = data + n;
+    uint64_t h;
+    if (n >= 32) {
+        uint64_t v1 = seed + PRIME1 + PRIME2;
+        uint64_t v2 = seed + PRIME2;
+        uint64_t v3 = seed;
+        uint64_t v4 = seed - PRIME1;
+        const uint8_t* limit = end - 32;
+        do {
+            v1 = rotl64(v1 + read64(p) * PRIME2, 31) * PRIME1; p += 8;
+            v2 = rotl64(v2 + read64(p) * PRIME2, 31) * PRIME1; p += 8;
+            v3 = rotl64(v3 + read64(p) * PRIME2, 31) * PRIME1; p += 8;
+            v4 = rotl64(v4 + read64(p) * PRIME2, 31) * PRIME1; p += 8;
+        } while (p <= limit);
+        h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+        v1 = rotl64(v1 * PRIME2, 31) * PRIME1; h ^= v1; h = h * PRIME1 + PRIME4;
+        v2 = rotl64(v2 * PRIME2, 31) * PRIME1; h ^= v2; h = h * PRIME1 + PRIME4;
+        v3 = rotl64(v3 * PRIME2, 31) * PRIME1; h ^= v3; h = h * PRIME1 + PRIME4;
+        v4 = rotl64(v4 * PRIME2, 31) * PRIME1; h ^= v4; h = h * PRIME1 + PRIME4;
+    } else {
+        h = seed + PRIME5;
+    }
+    h += (uint64_t)n;
+    while (p + 8 <= end) {
+        uint64_t k = rotl64(read64(p) * PRIME2, 31) * PRIME1;
+        h = rotl64(h ^ k, 27) * PRIME1 + PRIME4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h = rotl64(h ^ ((uint64_t)read32(p) * PRIME1), 23) * PRIME2 + PRIME3;
+        p += 4;
+    }
+    while (p < end) {
+        h = rotl64(h ^ ((uint64_t)(*p) * PRIME5), 11) * PRIME1;
+        p++;
+    }
+    h ^= h >> 33;
+    h *= PRIME2;
+    h ^= h >> 29;
+    h *= PRIME3;
+    h ^= h >> 32;
+    return h;
+}
+
+}  // extern "C"

@@ -1,0 +1,234 @@
+"""Roaring bitmap tests.
+
+Differential tests against a naive Python-set reference, mirroring the
+reference's roaring/naive.go differential strategy (SURVEY.md §4.6), plus
+file-format round-trips and a read of the reference repo's testdata
+(/root/reference/testdata/sample_view/0, written by the Go implementation).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import Bitmap, deserialize, serialize
+from pilosa_tpu.roaring.codec import OP_ADD_BATCH, OpWriter, apply_ops, encode_op
+
+SAMPLE_VIEW = "/root/reference/testdata/sample_view/0"
+
+
+def random_values(rng, n, spread):
+    return np.unique(rng.integers(0, spread, size=n, dtype=np.uint64))
+
+
+class TestBasicOps:
+    def test_add_remove_contains(self):
+        b = Bitmap()
+        assert b.add(100)
+        assert not b.add(100)
+        assert b.contains(100)
+        assert b.count() == 1
+        assert b.remove(100)
+        assert not b.remove(100)
+        assert not b.contains(100)
+        assert b.count() == 0
+
+    def test_add_many_spanning_containers(self, rng):
+        vals = random_values(rng, 50_000, 1 << 24)
+        b = Bitmap()
+        changed = b.add_many(vals)
+        assert changed == vals.size
+        assert b.count() == vals.size
+        np.testing.assert_array_equal(b.to_array(), vals)
+
+    def test_remove_many(self, rng):
+        vals = random_values(rng, 10_000, 1 << 22)
+        b = Bitmap(vals)
+        half = vals[::2]
+        removed = b.remove_many(half)
+        assert removed == half.size
+        np.testing.assert_array_equal(b.to_array(), vals[1::2])
+
+    def test_array_to_bitmap_conversion(self):
+        # Push one container past ARRAY_MAX_SIZE=4096.
+        vals = np.arange(0, 10000, dtype=np.uint64) * 2
+        b = Bitmap(vals)
+        assert b.count() == 10000
+        c = b.container(0)
+        assert c.typ == "bitmap"
+        np.testing.assert_array_equal(b.to_array(), vals)
+
+    def test_min_max(self, rng):
+        vals = random_values(rng, 1000, 1 << 30)
+        b = Bitmap(vals)
+        lo, ok = b.min()
+        assert ok and lo == int(vals.min())
+        assert b.max() == int(vals.max())
+        empty = Bitmap()
+        _, ok = empty.min()
+        assert not ok
+
+    def test_count_range(self, rng):
+        vals = random_values(rng, 20_000, 1 << 21)
+        b = Bitmap(vals)
+        for start, end in [(0, 1 << 21), (100, 200), (65536, 65536 * 3), (1 << 20, 1 << 21), (5, 5)]:
+            want = int(((vals >= start) & (vals < end)).sum())
+            assert b.count_range(start, end) == want, (start, end)
+
+
+class TestSetAlgebra:
+    @pytest.mark.parametrize("spread", [1 << 16, 1 << 20, 1 << 24])
+    @pytest.mark.parametrize("n", [100, 5000, 60_000])
+    def test_differential(self, rng, n, spread):
+        """AND/OR/ANDNOT/XOR vs python set, across container-type mixes."""
+        a_vals = random_values(rng, n, spread)
+        b_vals = random_values(rng, n, spread)
+        a, b = Bitmap(a_vals), Bitmap(b_vals)
+        sa, sb = set(a_vals.tolist()), set(b_vals.tolist())
+
+        np.testing.assert_array_equal(
+            a.intersect(b).to_array(), np.array(sorted(sa & sb), dtype=np.uint64)
+        )
+        np.testing.assert_array_equal(
+            a.union(b).to_array(), np.array(sorted(sa | sb), dtype=np.uint64)
+        )
+        np.testing.assert_array_equal(
+            a.difference(b).to_array(), np.array(sorted(sa - sb), dtype=np.uint64)
+        )
+        np.testing.assert_array_equal(
+            a.xor(b).to_array(), np.array(sorted(sa ^ sb), dtype=np.uint64)
+        )
+        assert a.intersection_count(b) == len(sa & sb)
+
+    def test_union_in_place(self, rng):
+        a_vals = random_values(rng, 3000, 1 << 20)
+        b_vals = random_values(rng, 3000, 1 << 20)
+        a = Bitmap(a_vals)
+        a.union_in_place(Bitmap(b_vals))
+        want = np.union1d(a_vals, b_vals)
+        np.testing.assert_array_equal(a.to_array(), want)
+
+    def test_shift(self, rng):
+        vals = random_values(rng, 5000, 1 << 20)
+        vals = np.append(vals, [65535, 131071])  # container-edge carries
+        b = Bitmap(np.unique(vals))
+        shifted = b.shift()
+        want = np.unique(vals) + 1
+        np.testing.assert_array_equal(shifted.to_array(), want)
+
+    def test_flip(self, rng):
+        vals = random_values(rng, 1000, 1 << 18)
+        b = Bitmap(vals)
+        lo, hi = 1000, 200_000  # inclusive range
+        flipped = b.flip(lo, hi)
+        s = set(vals.tolist())
+        want = sorted((set(range(lo, hi + 1)) - s) | {v for v in s if not lo <= v <= hi})
+        np.testing.assert_array_equal(flipped.to_array(), np.array(want, dtype=np.uint64))
+
+    def test_offset_range(self, rng):
+        shard_width = 1 << 20
+        vals = random_values(rng, 5000, shard_width)
+        row = 7
+        b = Bitmap(vals + row * shard_width)
+        out = b.offset_range(3 * shard_width, row * shard_width, (row + 1) * shard_width)
+        np.testing.assert_array_equal(out.to_array(), vals + 3 * shard_width)
+
+
+class TestCodec:
+    @pytest.mark.parametrize("kind", ["sparse", "dense", "runs", "mixed", "empty"])
+    def test_roundtrip(self, rng, kind):
+        if kind == "sparse":
+            vals = random_values(rng, 500, 1 << 30)
+        elif kind == "dense":
+            vals = np.unique(rng.integers(0, 1 << 17, size=100_000, dtype=np.uint64))
+        elif kind == "runs":
+            vals = np.arange(1000, 90_000, dtype=np.uint64)
+        elif kind == "mixed":
+            vals = np.unique(
+                np.concatenate(
+                    [
+                        np.arange(0, 70_000, dtype=np.uint64),  # run container(s)
+                        random_values(rng, 100, 1 << 40),  # far sparse arrays
+                        np.unique(rng.integers(1 << 20, (1 << 20) + 65536, size=30_000, dtype=np.uint64)),
+                    ]
+                )
+            )
+        else:
+            vals = np.empty(0, dtype=np.uint64)
+        b = Bitmap(vals)
+        data = serialize(b)
+        b2 = deserialize(data)
+        np.testing.assert_array_equal(b2.to_array(), vals)
+
+    def test_reads_reference_go_file(self):
+        """The Go reference's own testdata must load (byte compatibility)."""
+        if not os.path.exists(SAMPLE_VIEW):
+            pytest.skip("reference testdata not available")
+        with open(SAMPLE_VIEW, "rb") as f:
+            data = f.read()
+        b = deserialize(data)
+        assert b.count() > 0
+        # Round-trip: our serialization of it must parse again to equality.
+        b2 = deserialize(serialize(b))
+        np.testing.assert_array_equal(b2.to_array(), b.to_array())
+
+    def test_op_log_replay(self, rng, tmp_path):
+        vals = random_values(rng, 2000, 1 << 21)
+        b = Bitmap(vals)
+        path = tmp_path / "frag"
+        with open(path, "wb") as f:
+            f.write(serialize(b))
+            b.op_writer = OpWriter(f)
+            b.add(5_000_000)
+            b.add_many(np.array([1, 2, 3], dtype=np.uint64))
+            b.remove(int(vals[0]))
+            b.remove_many(np.array([2], dtype=np.uint64))
+        with open(path, "rb") as f:
+            b2 = deserialize(f.read())
+        np.testing.assert_array_equal(b2.to_array(), b.to_array())
+        assert b2.op_n >= 4
+
+    def test_op_checksum_detects_corruption(self):
+        op = bytearray(encode_op(OP_ADD_BATCH, values=np.array([9, 10], dtype=np.uint64)))
+        b = Bitmap()
+        apply_ops(b, bytes(op), 0)
+        assert b.count() == 2
+        op[14] ^= 0xFF  # corrupt a value byte
+        with pytest.raises(ValueError, match="checksum"):
+            apply_ops(Bitmap(), bytes(op), 0)
+
+    def test_import_roaring_bits(self, rng):
+        a_vals = random_values(rng, 3000, 1 << 20)
+        b_vals = random_values(rng, 3000, 1 << 20)
+        b = Bitmap(a_vals)
+        changed = b.import_roaring_bits(serialize(Bitmap(b_vals)))
+        want = np.union1d(a_vals, b_vals)
+        assert changed == want.size - a_vals.size
+        np.testing.assert_array_equal(b.to_array(), want)
+        # clear
+        b.import_roaring_bits(serialize(Bitmap(b_vals)), clear=True)
+        np.testing.assert_array_equal(
+            b.to_array(), np.setdiff1d(a_vals, b_vals, assume_unique=True)
+        )
+
+
+class TestNative:
+    def test_fnv_vectors(self):
+        from pilosa_tpu.native import fnv32a, fnv64a
+
+        # Known FNV-1a test vectors.
+        assert fnv32a(b"") == 2166136261
+        assert fnv32a(b"a") == 0xE40C292C
+        assert fnv32a(b"foobar") == 0xBF9CF968
+        assert fnv64a(b"") == 14695981039346656037
+        assert fnv64a(b"a") == 0xAF63DC4C8601EC8C
+        assert fnv64a(b"foobar") == 0x85944171F73967E8
+
+    def test_xxhash_vectors(self):
+        from pilosa_tpu.native import has_native, xxhash64
+
+        if not has_native():
+            pytest.skip("no native lib")
+        # Known xxh64 vectors (seed 0).
+        assert xxhash64(b"") == 0xEF46DB3751D8E999
+        assert xxhash64(b"xxhash") == 0x32DD38952C4BC720
