@@ -1,0 +1,21 @@
+"""PQL — the Pilosa Query Language.
+
+Hand-rolled recursive-descent parser equivalent to the reference's PEG
+grammar (reference pql/pql.peg, generated parser pql/pql.peg.go), producing
+the same AST shape (reference pql/ast.go: Query / Call{Name, Args, Children}
+/ Condition).
+"""
+
+from pilosa_tpu.pql.ast import (
+    BETWEEN,
+    EQ,
+    GT,
+    GTE,
+    LT,
+    LTE,
+    NEQ,
+    Call,
+    Condition,
+    Query,
+)
+from pilosa_tpu.pql.parser import ParseError, parse_string

@@ -1,0 +1,195 @@
+"""PQL AST: Query, Call, Condition (reference pql/ast.go:27,263,482)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# Condition operator tokens (reference pql/token.go; string forms used in
+# error messages and Condition.String()).
+ILLEGAL = "ILLEGAL"
+EQ = "=="
+NEQ = "!="
+LT = "<"
+LTE = "<="
+GT = ">"
+GTE = ">="
+BETWEEN = "><"
+
+
+class Condition:
+    """A comparison attached to a field arg, e.g. x > 5 (reference pql/ast.go:482)."""
+
+    __slots__ = ("op", "value")
+
+    def __init__(self, op: str, value: Any):
+        self.op = op
+        self.value = value
+
+    def int_slice_value(self) -> list[int]:
+        """BETWEEN bounds as ints (reference Condition.IntSliceValue :495)."""
+        if not isinstance(self.value, list):
+            raise ValueError(f"expected list value for condition, got {self.value!r}")
+        out = []
+        for v in self.value:
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(f"expected int in condition value, got {v!r}")
+            out.append(v)
+        return out
+
+    def string_with_subj(self, subj: str) -> str:
+        if self.op == BETWEEN and isinstance(self.value, list) and len(self.value) == 2:
+            return f"{self.value[0]} <= {subj} <= {self.value[1]}"
+        return f"{subj} {self.op} {self.value}"
+
+    def __repr__(self) -> str:
+        return f"Condition({self.op!r}, {self.value!r})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Condition)
+            and self.op == other.op
+            and self.value == other.value
+        )
+
+
+RESERVED_FIELDS = ("_row", "_col", "_start", "_end", "_timestamp", "_field")
+
+
+def is_reserved_arg(name: str) -> bool:
+    """reference pql/ast.go IsReservedArg."""
+    return name.startswith("_") or name in ("from", "to")
+
+
+class Call:
+    """One function call in the AST (reference pql/ast.go:263)."""
+
+    __slots__ = ("name", "args", "children")
+
+    def __init__(
+        self,
+        name: str,
+        args: Optional[dict[str, Any]] = None,
+        children: Optional[list["Call"]] = None,
+    ):
+        self.name = name
+        self.args = args if args is not None else {}
+        self.children = children if children is not None else []
+
+    # -- typed arg accessors (reference pql/ast.go:297-393) ---------------
+
+    def field_arg(self) -> str:
+        """The non-reserved key holding field=rowID (reference Call.FieldArg)."""
+        for arg in self.args:
+            if not is_reserved_arg(arg):
+                return arg
+        raise ValueError("no field argument specified")
+
+    def bool_arg(self, key: str) -> tuple[bool, bool]:
+        """Returns (value, found); raises if present but not a bool."""
+        if key not in self.args:
+            return False, False
+        v = self.args[key]
+        if not isinstance(v, bool):
+            raise ValueError(f"could not convert {v!r} to bool in {self.name}")
+        return v, True
+
+    def uint64_arg(self, key: str) -> tuple[int, bool]:
+        if key not in self.args:
+            return 0, False
+        v = self.args[key]
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise ValueError(f"could not convert {v!r} to uint64 in {self.name}")
+        return v, True
+
+    def int_arg(self, key: str) -> tuple[int, bool]:
+        return self.uint64_arg(key)
+
+    def string_arg(self, key: str) -> tuple[str, bool]:
+        if key not in self.args:
+            return "", False
+        v = self.args[key]
+        if not isinstance(v, str):
+            raise ValueError(f"could not convert {v!r} to string in {self.name}")
+        return v, True
+
+    def uint64_slice_arg(self, key: str) -> tuple[list[int], bool]:
+        if key not in self.args:
+            return [], False
+        v = self.args[key]
+        if not isinstance(v, list):
+            raise ValueError(f"could not convert {v!r} to []uint64 in {self.name}")
+        return list(v), True
+
+    def clone(self) -> "Call":
+        return Call(
+            self.name,
+            dict(self.args),
+            [c.clone() for c in self.children],
+        )
+
+    def supports_shards(self) -> bool:
+        """Whether the call fans out per shard (used by executor option
+        validation, reference executor.go needsShards equivalent)."""
+        return self.name in (
+            "Row", "Range", "Union", "Intersect", "Xor", "Difference", "Not",
+            "Count", "Shift", "All",
+        )
+
+    # -- stringification (reference Call.String, used in error paths) -----
+
+    def __repr__(self) -> str:
+        return self.to_string()
+
+    def to_string(self) -> str:
+        parts = []
+        for child in self.children:
+            parts.append(child.to_string())
+        for key in sorted(self.args):
+            val = self.args[key]
+            if isinstance(val, Condition):
+                parts.append(val.string_with_subj(key))
+            else:
+                parts.append(f"{key}={_fmt_val(val)}")
+        return f"{self.name}({', '.join(parts)})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Call)
+            and self.name == other.name
+            and self.args == other.args
+            and self.children == other.children
+        )
+
+
+def _fmt_val(v: Any) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return f'"{v}"'
+    if isinstance(v, list):
+        return "[" + ",".join(_fmt_val(x) for x in v) + "]"
+    if isinstance(v, Call):
+        return v.to_string()
+    return str(v)
+
+
+class Query:
+    """A parsed PQL query: a list of top-level calls (reference pql/ast.go:27)."""
+
+    __slots__ = ("calls",)
+
+    def __init__(self, calls: Optional[list[Call]] = None):
+        self.calls = calls if calls is not None else []
+
+    def write_call_n(self) -> int:
+        """Number of mutating calls (reference Query.WriteCallN)."""
+        return sum(
+            1
+            for c in self.calls
+            if c.name in ("Set", "Clear", "SetRowAttrs", "SetColumnAttrs")
+        )
+
+    def __repr__(self) -> str:
+        return "\n".join(c.to_string() for c in self.calls)

@@ -94,11 +94,10 @@ class Container:
         if n <= ARRAY_MAX_SIZE:
             parts = [np.arange(s, l + 1, dtype=np.uint16) for s, l in runs]
             return Container(TYPE_ARRAY, np.concatenate(parts) if parts else _EMPTY_U16, n)
-        words = np.zeros(BITMAP_N, dtype=np.uint64)
         bits = np.zeros(CONTAINER_WIDTH, dtype=bool)
         for s, l in runs:
             bits[s : l + 1] = True
-        words = np.packbits(bits, bitorder="little").view(np.uint64)
+        words = np.packbits(bits, bitorder="little").view(np.uint64).copy()
         return Container(TYPE_BITMAP, words, n)
 
     # -- accessors -------------------------------------------------------
@@ -143,10 +142,19 @@ class Container:
                 self.data, np.uint16(end), side="left"
             )
             return int(hi - lo)
-        pos = self.positions()
-        lo = np.searchsorted(pos, start, side="left")
-        hi = np.searchsorted(pos, min(end, CONTAINER_WIDTH), side="left")
-        return int(hi - lo)
+        # Popcount whole words, masking the partial edge words.
+        end = min(end, CONTAINER_WIDTH)
+        if end <= start:
+            return 0
+        w0, w1 = start >> 6, (end - 1) >> 6
+        words = self.data[w0 : w1 + 1].copy()
+        lo_bits = start & 63
+        hi_bits = (end - 1) & 63
+        if lo_bits:
+            words[0] &= ~np.uint64(0) << np.uint64(lo_bits)
+        if hi_bits != 63:
+            words[-1] &= ~np.uint64(0) >> np.uint64(63 - hi_bits)
+        return int(np.bitwise_count(words).sum())
 
     # -- mutators (return new container) ---------------------------------
 
@@ -350,7 +358,6 @@ class Bitmap:
         vs = np.asarray(vs, dtype=np.uint64)
         if vs.size == 0:
             return 0
-        before = self.count()
         keys = vs >> np.uint64(16)
         lows = (vs & np.uint64(0xFFFF)).astype(np.uint16)
         order = np.argsort(keys, kind="stable")
@@ -358,12 +365,14 @@ class Bitmap:
         boundaries = np.nonzero(np.diff(keys))[0] + 1
         starts = np.concatenate(([0], boundaries))
         ends = np.concatenate((boundaries, [keys.size]))
+        changed = 0
         for s, e in zip(starts, ends):
             key = int(keys[s])
             chunk = np.unique(lows[s:e])
             c = self._cs.get(key)
-            self._put(key, Container.from_positions(chunk) if c is None else c.with_many(chunk))
-        changed = self.count() - before
+            nc = Container.from_positions(chunk) if c is None else c.with_many(chunk)
+            changed += nc.n - (c.n if c is not None else 0)
+            self._put(key, nc)
         if changed and log and self.op_writer is not None:
             # opN counts mutated values like the reference's op.count()
             # (roaring.go:1620), so it matches what a WAL replay computes.
@@ -375,7 +384,6 @@ class Bitmap:
         vs = np.asarray(vs, dtype=np.uint64)
         if vs.size == 0:
             return 0
-        before = self.count()
         keys = vs >> np.uint64(16)
         lows = (vs & np.uint64(0xFFFF)).astype(np.uint16)
         order = np.argsort(keys, kind="stable")
@@ -383,12 +391,14 @@ class Bitmap:
         boundaries = np.nonzero(np.diff(keys))[0] + 1
         starts = np.concatenate(([0], boundaries))
         ends = np.concatenate((boundaries, [keys.size]))
+        changed = 0
         for s, e in zip(starts, ends):
             key = int(keys[s])
             c = self._cs.get(key)
             if c is not None:
-                self._put(key, c.without_many(np.unique(lows[s:e])))
-        changed = before - self.count()
+                nc = c.without_many(np.unique(lows[s:e]))
+                changed += c.n - nc.n
+                self._put(key, nc)
         if changed and log and self.op_writer is not None:
             self.op_writer.append_remove_batch(vs)
             self.op_n += int(vs.size)

@@ -106,10 +106,16 @@ def deserialize(data: bytes, b: Optional[Bitmap] = None) -> Bitmap:
         b = Bitmap()
     if len(data) == 0:
         return b
+    if len(data) < 8:
+        raise ValueError(f"data too small: {len(data)} bytes")
     file_magic = struct.unpack_from("<H", data, 0)[0]
-    if file_magic == MAGIC_NUMBER:
-        return _deserialize_pilosa(data, b)
-    return _deserialize_official(data, b)
+    try:
+        if file_magic == MAGIC_NUMBER:
+            return _deserialize_pilosa(data, b)
+        return _deserialize_official(data, b)
+    except struct.error as e:
+        # Truncated inputs surface as the module's documented error type.
+        raise ValueError(f"malformed roaring data: {e}") from e
 
 
 def _deserialize_pilosa(data: bytes, b: Bitmap) -> Bitmap:
@@ -120,18 +126,22 @@ def _deserialize_pilosa(data: bytes, b: Bitmap) -> Bitmap:
         raise ValueError(f"wrong roaring version: file is v{version}")
     b.flags = data[3]
     key_n = struct.unpack_from("<I", data, 4)[0]
-    if len(data) < 8 + key_n * 12:
-        raise ValueError("insufficient data for header")
+    # Header must hold key_n * (12B descriptive + 4B offset) entries
+    # (reference unmarshal_binary.go:150 checks 12B; offsets checked below).
+    if len(data) < 8 + key_n * 16:
+        raise ValueError(
+            f"insufficient data for header + offsets: {key_n} containers, {len(data)} bytes"
+        )
 
-    keys = np.empty(key_n, dtype=np.uint64)
-    typs = np.empty(key_n, dtype=np.uint16)
-    cards = np.empty(key_n, dtype=np.int64)
-    hdr = np.frombuffer(data, dtype=np.uint8, count=key_n * 12, offset=8)
     if key_n:
-        hdr12 = hdr.reshape(key_n, 12)
+        hdr12 = np.frombuffer(data, dtype=np.uint8, count=key_n * 12, offset=8).reshape(key_n, 12)
         keys = hdr12[:, 0:8].copy().view("<u8").reshape(key_n)
         typs = hdr12[:, 8:10].copy().view("<u2").reshape(key_n)
         cards = hdr12[:, 10:12].copy().view("<u2").reshape(key_n).astype(np.int64) + 1
+    else:
+        keys = np.empty(0, dtype=np.uint64)
+        typs = np.empty(0, dtype=np.uint16)
+        cards = np.empty(0, dtype=np.int64)
 
     ops_offset = 8 + key_n * 12
     # 32-bit offsets with wraparound for >4GB files (reference

@@ -1,0 +1,214 @@
+"""PQL parser tests — cases mirror the reference grammar (pql/pql.peg) and
+the query shapes exercised throughout the reference's executor_test.go."""
+
+import pytest
+
+from pilosa_tpu.pql import (
+    BETWEEN,
+    EQ,
+    GT,
+    LT,
+    NEQ,
+    Call,
+    Condition,
+    ParseError,
+    parse_string,
+)
+
+
+def one(q):
+    query = parse_string(q)
+    assert len(query.calls) == 1
+    return query.calls[0]
+
+
+class TestBasicCalls:
+    def test_row(self):
+        c = one("Row(f=10)")
+        assert c.name == "Row"
+        assert c.args == {"f": 10}
+
+    def test_row_keyed(self):
+        c = one('Row(f="ten")')
+        assert c.args == {"f": "ten"}
+
+    def test_set(self):
+        c = one("Set(3, f=10)")
+        assert c.name == "Set"
+        assert c.args == {"_col": 3, "f": 10}
+
+    def test_set_with_timestamp(self):
+        c = one("Set(3, f=10, 2010-01-02T03:04)")
+        assert c.args == {"_col": 3, "f": 10, "_timestamp": "2010-01-02T03:04"}
+
+    def test_set_keyed(self):
+        c = one("Set('col-key', f='row-key')")
+        assert c.args == {"_col": "col-key", "f": "row-key"}
+
+    def test_clear(self):
+        c = one("Clear(3, f=10)")
+        assert c.args == {"_col": 3, "f": 10}
+
+    def test_clear_row(self):
+        c = one("ClearRow(f=5)")
+        assert c.name == "ClearRow"
+        assert c.args == {"f": 5}
+
+    def test_nested(self):
+        c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+        assert c.name == "Count"
+        inter = c.children[0]
+        assert inter.name == "Intersect"
+        assert [ch.name for ch in inter.children] == ["Row", "Row"]
+        assert inter.children[0].args == {"a": 1}
+        assert inter.children[1].args == {"b": 2}
+
+    def test_multiple_calls(self):
+        q = parse_string("Set(1, f=2) Set(3, f=4)\nCount(Row(f=2))")
+        assert [c.name for c in q.calls] == ["Set", "Set", "Count"]
+        assert q.write_call_n() == 2
+
+    def test_union_empty_and_many(self):
+        assert one("Union()").children == []
+        c = one("Union(Row(f=1), Row(f=2), Row(f=3))")
+        assert len(c.children) == 3
+
+    def test_not(self):
+        c = one("Not(Row(f=1))")
+        assert c.name == "Not" and c.children[0].args == {"f": 1}
+
+    def test_store(self):
+        c = one("Store(Row(f=1), dest=2)")
+        assert c.name == "Store"
+        assert c.children[0].name == "Row"
+        assert c.args == {"dest": 2}
+
+
+class TestArgs:
+    def test_topn(self):
+        c = one("TopN(f, n=25)")
+        assert c.args == {"_field": "f", "n": 25}
+
+    def test_topn_no_args(self):
+        c = one("TopN(f)")
+        assert c.args == {"_field": "f"}
+
+    def test_topn_with_child_and_args(self):
+        c = one("TopN(f, Row(other=7), n=12)")
+        assert c.args == {"_field": "f", "n": 12}
+        assert c.children[0].name == "Row"
+
+    def test_rows(self):
+        c = one("Rows(f, limit=10, previous=3)")
+        assert c.args == {"_field": "f", "limit": 10, "previous": 3}
+
+    def test_list_arg(self):
+        c = one("TopN(f, ids=[1,2,3])")
+        assert c.args["ids"] == [1, 2, 3]
+
+    def test_string_and_bool_and_null(self):
+        c = one('GroupBy(Rows(a), limit=7, filter=null, x=true, y=false, s="hi")')
+        assert c.args["filter"] is None
+        assert c.args["x"] is True
+        assert c.args["y"] is False
+        assert c.args["s"] == "hi"
+
+    def test_floats_and_negatives(self):
+        c = one("Foo(a=1.5, b=-2, c=-0.25, d=.5)")
+        assert c.args == {"a": 1.5, "b": -2, "c": -0.25, "d": 0.5}
+
+    def test_bare_string(self):
+        c = one("Options(Row(f=1), shards=[0,2])")
+        assert c.args["shards"] == [0, 2]
+
+    def test_call_as_value(self):
+        c = one("GroupBy(Rows(a), filter=Row(b=1))")
+        assert isinstance(c.args["filter"], Call)
+        assert c.args["filter"].name == "Row"
+
+    def test_duplicate_arg_rejected(self):
+        with pytest.raises(ParseError, match="duplicate"):
+            parse_string("Row(f=1, f=2)")
+
+
+class TestConditions:
+    def test_comparison_ops(self):
+        for text, op in [
+            ("Row(f > 5)", GT),
+            ("Row(f < 5)", LT),
+            ("Row(f == 5)", EQ),
+            ("Row(f != 5)", NEQ),
+        ]:
+            c = one(text)
+            cond = c.args["f"]
+            assert isinstance(cond, Condition)
+            assert cond.op == op and cond.value == 5
+
+    def test_between_op(self):
+        c = one("Row(f >< [4, 8])")
+        cond = c.args["f"]
+        assert cond.op == BETWEEN and cond.value == [4, 8]
+
+    def test_conditional_form(self):
+        c = one("Row(4 < f <= 9)")
+        cond = c.args["f"]
+        assert cond.op == BETWEEN
+        assert cond.value == [5, 9]  # strict < bumps the low bound
+
+    def test_conditional_inclusive(self):
+        c = one("Row(-5 <= f <= 5)")
+        assert c.args["f"].value == [-5, 5]
+
+    def test_range_generic_fallback(self):
+        # Range(f > 5) must fall through the special Range form to the
+        # generic-call rule, like the PEG's ordered choice.
+        c = one("Range(f > 5)")
+        assert c.name == "Range"
+        assert c.args["f"] == Condition(GT, 5)
+
+    def test_range_timestamp_form(self):
+        c = one("Range(f=1, 2010-01-01T00:00, 2011-01-01T00:00)")
+        assert c.args == {
+            "f": 1,
+            "from": "2010-01-01T00:00",
+            "to": "2011-01-01T00:00",
+        }
+
+    def test_range_from_to_labels(self):
+        c = one("Range(f=1, from=2010-01-01T00:00, to=2011-01-01T00:00)")
+        assert c.args["from"] == "2010-01-01T00:00"
+        assert c.args["to"] == "2011-01-01T00:00"
+
+
+class TestAttrs:
+    def test_set_row_attrs(self):
+        c = one('SetRowAttrs(f, 10, color="blue", rank=5)')
+        assert c.args == {"_field": "f", "_row": 10, "color": "blue", "rank": 5}
+
+    def test_set_column_attrs(self):
+        c = one('SetColumnAttrs(7, happy=true)')
+        assert c.args == {"_col": 7, "happy": True}
+
+
+class TestErrors:
+    def test_garbage(self):
+        with pytest.raises(ParseError):
+            parse_string("Row(f=1")
+        with pytest.raises(ParseError):
+            parse_string(")}{")
+        with pytest.raises(ParseError):
+            parse_string("Row(=1)")
+
+    def test_empty_is_ok(self):
+        assert parse_string("").calls == []
+        assert parse_string("   \n ").calls == []
+
+
+class TestStringify:
+    def test_roundtrip(self):
+        c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+        assert one(c.to_string()) == c
+
+    def test_condition_string(self):
+        c = one("Row(4 < f <= 9)")
+        assert "5 <= f <= 9" in c.to_string()
