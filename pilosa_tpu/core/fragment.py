@@ -1,0 +1,748 @@
+"""Fragment: one (view ∩ shard) storage unit (reference fragment.go).
+
+Storage is a single roaring bitmap whose position space interleaves rows:
+position = row_id * SHARD_WIDTH + (column_id % SHARD_WIDTH) (reference
+fragment.go pos() :1539). Durability is a snapshot file (byte-compatible
+Pilosa roaring format) plus an appended op-log WAL; once op_n crosses
+MAX_OP_N the file is atomically rewritten (reference fragment.go:84,
+:2296-2394 snapshot via .snapshotting temp + rename).
+
+BSI (bit-sliced index) int values live in dedicated views; within such a
+fragment row 0 is the existence ("not null") plane, row 1 the sign plane,
+and rows 2..2+bitDepth the magnitude planes (reference fragment.go:91-93).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from pilosa_tpu.core.cache import Pair, new_cache, load_cache, save_cache, top_n_pairs
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.native import xxhash64
+from pilosa_tpu.roaring import Bitmap, serialize
+from pilosa_tpu.roaring.codec import OpWriter, deserialize
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+
+# Maximum op-log length before a snapshot rewrite (reference fragment.go:84).
+MAX_OP_N = 10000
+
+# Rows per checksum block for anti-entropy (reference fragment.go:81).
+HASH_BLOCK_SIZE = 100
+
+# BSI plane rows (reference fragment.go:91-93).
+BSI_EXISTS_BIT = 0
+BSI_SIGN_BIT = 1
+BSI_OFFSET_BIT = 2
+
+CACHE_EXT = ".cache"
+
+
+def pos(row_id: int, column_id: int) -> int:
+    """Bit position in fragment storage (reference fragment.go pos)."""
+    return row_id * SHARD_WIDTH + (column_id % SHARD_WIDTH)
+
+
+class Fragment:
+    """In-process fragment. Thread-safe for single-writer/multi-reader via a
+    coarse lock (the reference uses an RWMutex per fragment, fragment.go:101)."""
+
+    def __init__(
+        self,
+        path: Optional[str],
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        cache_type: str = "ranked",
+        cache_size: int = 50000,
+        mutex: bool = False,
+    ):
+        self.path = path  # None = memory-only (tests)
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.mutex = mutex
+        self.storage = Bitmap()
+        self.cache = new_cache(cache_type, cache_size)
+        self.cache_type = cache_type
+        self.max_row_id = 0
+        self.lock = threading.RLock()
+        self._file = None
+        # Bumped on every mutation; the TPU block cache uses it to decide
+        # when a device re-upload is needed (see pilosa_tpu/ops/blocks.py).
+        self.version = 0
+        self._row_cache: dict[int, Bitmap] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def open(self) -> "Fragment":
+        if self.path is not None:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            data = b""
+            if os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    data = f.read()
+            if data:
+                self.storage = deserialize(data)
+            else:
+                # New file: write an empty-bitmap header so the op log that
+                # follows always has a valid roaring prefix (reference
+                # fragment.go openStorage writes the marshaled bitmap first).
+                with open(self.path, "wb") as f:
+                    f.write(serialize(self.storage))
+            # Unbuffered append so each WAL record hits the OS directly
+            # (crash durability without per-record flush syscalls).
+            self._file = open(self.path, "ab", buffering=0)
+            self.storage.op_writer = OpWriter(self._file)
+            load_cache(self.cache, self.path + CACHE_EXT)
+        mx = self.storage.max()
+        self.max_row_id = mx // SHARD_WIDTH if self.storage.any() else 0
+        return self
+
+    def close(self) -> None:
+        with self.lock:
+            self.flush_cache()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+                self.storage.op_writer = None
+
+    def flush_cache(self) -> None:
+        if self.path is not None and self.cache_type != "none":
+            save_cache(self.cache, self.path + CACHE_EXT)
+
+    # -- snapshotting -----------------------------------------------------
+
+    def _increment_op_n(self) -> None:
+        if self.storage.op_n > MAX_OP_N:
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        """Atomically rewrite the storage file without the op log
+        (reference fragment.go:2311-2394)."""
+        with self.lock:
+            if self.path is None:
+                self.storage.op_n = 0
+                return
+            tmp = self.path + ".snapshotting"
+            with open(tmp, "wb") as f:
+                f.write(serialize(self.storage))
+                f.flush()
+                os.fsync(f.fileno())
+            if self._file is not None:
+                self._file.close()
+            os.replace(tmp, self.path)
+            self._file = open(self.path, "ab", buffering=0)
+            self.storage.op_writer = OpWriter(self._file)
+            self.storage.op_n = 0
+
+    # -- mutation ---------------------------------------------------------
+
+    def _mutated(self, row_ids: Optional[Iterable[int]] = None) -> None:
+        self.version += 1
+        if row_ids is None:
+            self._row_cache.clear()
+        else:
+            for r in row_ids:
+                self._row_cache.pop(r, None)
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        """reference fragment.go setBit :647 (+ handleMutex :670)."""
+        with self.lock:
+            changed = False
+            if self.mutex:
+                changed = self._clear_mutex_column(row_id, column_id) or changed
+            if self.storage.add(pos(row_id, column_id)):
+                changed = True
+                self.cache.add(row_id, self.row_count(row_id))
+                self._mutated([row_id])
+                if row_id > self.max_row_id:
+                    self.max_row_id = row_id
+            self._increment_op_n()
+            return changed
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        with self.lock:
+            if self.storage.remove(pos(row_id, column_id)):
+                self.cache.add(row_id, self.row_count(row_id))
+                self._mutated([row_id])
+                self._increment_op_n()
+                return True
+            return False
+
+    def _clear_mutex_column(self, keep_row: int, column_id: int) -> bool:
+        """Clear any other row's bit for this column (mutex fields,
+        reference fragment.go handleMutex + mutexVector fragment.go:3242)."""
+        changed = False
+        col = column_id % SHARD_WIDTH
+        for row_id in self.row_ids():
+            if row_id == keep_row:
+                continue
+            if self.storage.contains(row_id * SHARD_WIDTH + col):
+                self.storage.remove(row_id * SHARD_WIDTH + col)
+                self.cache.add(row_id, self.row_count(row_id))
+                self._mutated([row_id])
+                changed = True
+        return changed
+
+    def clear_row(self, row_id: int) -> bool:
+        """Remove all bits in a row (reference fragment.go unprotectedClearRow)."""
+        with self.lock:
+            row_bm = self._row_bitmap(row_id)
+            vals = row_bm.to_array() + np.uint64(row_id * SHARD_WIDTH)
+            if vals.size == 0:
+                return False
+            self.storage.remove_many(vals)
+            self.cache.add(row_id, 0)
+            self._mutated([row_id])
+            self._increment_op_n()
+            return True
+
+    def set_row(self, row: Row, row_id: int) -> bool:
+        """Overwrite a row with the given Row's segment for this shard
+        (reference fragment.go unprotectedSetRow, used by Store)."""
+        with self.lock:
+            self.clear_row(row_id)
+            seg = row.shard_bitmap(self.shard)
+            vals = seg.to_array() + np.uint64(row_id * SHARD_WIDTH)
+            if vals.size:
+                self.storage.add_many(vals)
+            self.cache.add(row_id, int(vals.size))
+            self._mutated([row_id])
+            if vals.size and row_id > self.max_row_id:
+                self.max_row_id = row_id
+            self._increment_op_n()
+            return True
+
+    # -- reads ------------------------------------------------------------
+
+    def _row_bitmap(self, row_id: int) -> Bitmap:
+        cached = self._row_cache.get(row_id)
+        if cached is not None:
+            return cached
+        bm = self.storage.offset_range(0, row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH)
+        self._row_cache[row_id] = bm
+        return bm
+
+    def row(self, row_id: int) -> Row:
+        """One row as a Row with this shard's segment (reference fragment.row
+        :602 -> rowFromStorage via OffsetRange)."""
+        with self.lock:
+            return Row.from_segment(self.shard, self._row_bitmap(row_id))
+
+    def row_count(self, row_id: int) -> int:
+        return self.storage.count_range(row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH)
+
+    def row_ids(self) -> list[int]:
+        """All row IDs with at least one bit (container-key derived; a shard
+        row spans SHARD_WIDTH/2^16 container keys, reference fragment.go:55)."""
+        shift = SHARD_WIDTH_EXP - 16
+        seen = sorted({k >> shift for k in self.storage.keys()})
+        return seen
+
+    def columns(self) -> Row:
+        """Union of all rows as absolute columns (used by existence checks)."""
+        out = Bitmap()
+        for row_id in self.row_ids():
+            out.union_in_place(self._row_bitmap(row_id))
+        return Row.from_segment(self.shard, out)
+
+    def for_each_bit(self, fn: Callable[[int, int], None]) -> None:
+        """fn(row_id, absolute_column_id) for every bit (reference :1553)."""
+        arr = self.storage.to_array()
+        rows = arr // np.uint64(SHARD_WIDTH)
+        cols = self.shard * SHARD_WIDTH + (arr % np.uint64(SHARD_WIDTH))
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            fn(r, c)
+
+    # -- BSI ops (reference fragment.go:932-1537) --------------------------
+
+    def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        """Sign-magnitude BSI write (reference setValueBase :988)."""
+        with self.lock:
+            uvalue = -value if value < 0 else value
+            changed = False
+            col = column_id % SHARD_WIDTH
+            for i in range(bit_depth):
+                p = (BSI_OFFSET_BIT + i) * SHARD_WIDTH + col
+                if (uvalue >> i) & 1:
+                    changed = self.storage.add(p) or changed
+                else:
+                    changed = self.storage.remove(p) or changed
+            p = BSI_EXISTS_BIT * SHARD_WIDTH + col
+            changed = self.storage.add(p) or changed
+            p = BSI_SIGN_BIT * SHARD_WIDTH + col
+            if value < 0:
+                changed = self.storage.add(p) or changed
+            else:
+                changed = self.storage.remove(p) or changed
+            if changed:
+                self._mutated()
+                top = BSI_OFFSET_BIT + bit_depth - 1
+                if top > self.max_row_id:
+                    self.max_row_id = top
+            self._increment_op_n()
+            return changed
+
+    def clear_value(self, column_id: int, bit_depth: int) -> bool:
+        with self.lock:
+            col = column_id % SHARD_WIDTH
+            changed = False
+            for r in range(BSI_OFFSET_BIT + bit_depth):
+                changed = self.storage.remove(r * SHARD_WIDTH + col) or changed
+            if changed:
+                self._mutated()
+            self._increment_op_n()
+            return changed
+
+    def value(self, column_id: int, bit_depth: int) -> tuple[int, bool]:
+        """Read one column's BSI value (reference fragment.value :896)."""
+        with self.lock:
+            col = column_id % SHARD_WIDTH
+            if not self.storage.contains(BSI_EXISTS_BIT * SHARD_WIDTH + col):
+                return 0, False
+            value = 0
+            for i in range(bit_depth):
+                if self.storage.contains((BSI_OFFSET_BIT + i) * SHARD_WIDTH + col):
+                    value |= 1 << i
+            if self.storage.contains(BSI_SIGN_BIT * SHARD_WIDTH + col):
+                value = -value
+            return value, True
+
+    def _brow(self, plane: int) -> Bitmap:
+        return self._row_bitmap(plane)
+
+    def not_null(self) -> Row:
+        return self.row(BSI_EXISTS_BIT)
+
+    def sum(self, filter_row: Optional[Row], bit_depth: int) -> tuple[int, int]:
+        """Σ values + count (reference fragment.sum :1111): popcount per
+        plane × place value, positives minus negatives."""
+        with self.lock:
+            consider = self._brow(BSI_EXISTS_BIT)
+            if filter_row is not None:
+                consider = consider.intersect(filter_row.shard_bitmap(self.shard))
+            count = consider.count()
+            nrow = self._brow(BSI_SIGN_BIT).intersect(consider)
+            prow = consider.difference(nrow)
+            total = 0
+            for i in range(bit_depth):
+                plane = self._brow(BSI_OFFSET_BIT + i)
+                total += (1 << i) * (plane.intersection_count(prow) - plane.intersection_count(nrow))
+            return total, count
+
+    def min(self, filter_row: Optional[Row], bit_depth: int) -> tuple[int, int]:
+        """reference fragment.min :1146."""
+        with self.lock:
+            consider = self._brow(BSI_EXISTS_BIT)
+            if filter_row is not None:
+                consider = consider.intersect(filter_row.shard_bitmap(self.shard))
+            if not consider.any():
+                return 0, 0
+            neg = self._brow(BSI_SIGN_BIT).intersect(consider)
+            if neg.any():
+                v, cnt = self._max_unsigned(neg, bit_depth)
+                return -v, cnt
+            return self._min_unsigned(consider, bit_depth)
+
+    def max(self, filter_row: Optional[Row], bit_depth: int) -> tuple[int, int]:
+        """reference fragment.max :1191."""
+        with self.lock:
+            consider = self._brow(BSI_EXISTS_BIT)
+            if filter_row is not None:
+                consider = consider.intersect(filter_row.shard_bitmap(self.shard))
+            if not consider.any():
+                return 0, 0
+            pos_ = consider.difference(self._brow(BSI_SIGN_BIT))
+            if not pos_.any():
+                v, cnt = self._min_unsigned(consider, bit_depth)
+                return -v, cnt
+            return self._max_unsigned(pos_, bit_depth)
+
+    def _min_unsigned(self, filt: Bitmap, bit_depth: int) -> tuple[int, int]:
+        value, count = 0, 0
+        for i in range(bit_depth - 1, -1, -1):
+            row = filt.difference(self._brow(BSI_OFFSET_BIT + i))
+            count = row.count()
+            if count > 0:
+                filt = row
+            else:
+                value += 1 << i
+                if i == 0:
+                    count = filt.count()
+        return value, count
+
+    def _max_unsigned(self, filt: Bitmap, bit_depth: int) -> tuple[int, int]:
+        value, count = 0, 0
+        for i in range(bit_depth - 1, -1, -1):
+            row = self._brow(BSI_OFFSET_BIT + i).intersect(filt)
+            count = row.count()
+            if count > 0:
+                value += 1 << i
+                filt = row
+            elif i == 0:
+                count = filt.count()
+        return value, count
+
+    def range_op(self, op: str, bit_depth: int, predicate: int) -> Row:
+        """BSI comparison scan (reference fragment.rangeOp :1273). op is a
+        pql condition token string."""
+        with self.lock:
+            if op == "==":
+                bm = self._range_eq(bit_depth, predicate)
+            elif op == "!=":
+                bm = self._range_neq(bit_depth, predicate)
+            elif op in ("<", "<="):
+                bm = self._range_lt(bit_depth, predicate, op == "<=")
+            elif op in (">", ">="):
+                bm = self._range_gt(bit_depth, predicate, op == ">=")
+            else:
+                raise ValueError(f"invalid range operation: {op}")
+            return Row.from_segment(self.shard, bm)
+
+    def range_between(self, bit_depth: int, pmin: int, pmax: int) -> Row:
+        """reference fragment.rangeBetween :1504."""
+        with self.lock:
+            b = self._brow(BSI_EXISTS_BIT)
+            sign = self._brow(BSI_SIGN_BIT)
+            upmin, upmax = abs(pmin), abs(pmax)
+            if pmin >= 0:
+                bm = self._range_between_unsigned(b.difference(sign), bit_depth, upmin, upmax)
+            elif pmax < 0:
+                bm = self._range_between_unsigned(b.intersect(sign), bit_depth, upmax, upmin)
+            else:
+                pos_ = self._range_lt_unsigned(b.difference(sign), bit_depth, upmax, True)
+                neg = self._range_lt_unsigned(b.intersect(sign), bit_depth, upmin, True)
+                bm = pos_.union(neg)
+            return Row.from_segment(self.shard, bm)
+
+    def _range_eq(self, bit_depth: int, predicate: int) -> Bitmap:
+        b = self._brow(BSI_EXISTS_BIT)
+        sign = self._brow(BSI_SIGN_BIT)
+        upredicate = abs(predicate)
+        b = b.intersect(sign) if predicate < 0 else b.difference(sign)
+        for i in range(bit_depth - 1, -1, -1):
+            plane = self._brow(BSI_OFFSET_BIT + i)
+            if (upredicate >> i) & 1:
+                b = b.intersect(plane)
+            else:
+                b = b.difference(plane)
+        return b
+
+    def _range_neq(self, bit_depth: int, predicate: int) -> Bitmap:
+        return self._brow(BSI_EXISTS_BIT).difference(self._range_eq(bit_depth, predicate))
+
+    def _range_lt(self, bit_depth: int, predicate: int, allow_eq: bool) -> Bitmap:
+        b = self._brow(BSI_EXISTS_BIT)
+        sign = self._brow(BSI_SIGN_BIT)
+        upredicate = abs(predicate)
+        if (predicate >= 0 and allow_eq) or (predicate >= -1 and not allow_eq):
+            pos_ = self._range_lt_unsigned(b.difference(sign), bit_depth, upredicate, allow_eq)
+            return sign.intersect(b).union(pos_)
+        return self._range_gt_unsigned(b.intersect(sign), bit_depth, upredicate, allow_eq)
+
+    def _range_gt(self, bit_depth: int, predicate: int, allow_eq: bool) -> Bitmap:
+        b = self._brow(BSI_EXISTS_BIT)
+        sign = self._brow(BSI_SIGN_BIT)
+        upredicate = abs(predicate)
+        if (predicate >= 0 and allow_eq) or (predicate >= -1 and not allow_eq):
+            return self._range_gt_unsigned(b.difference(sign), bit_depth, upredicate, allow_eq)
+        neg = self._range_lt_unsigned(b.intersect(sign), bit_depth, upredicate, allow_eq)
+        return b.difference(sign).union(neg)
+
+    def _range_lt_unsigned(self, filt: Bitmap, bit_depth: int, predicate: int, allow_eq: bool) -> Bitmap:
+        keep = Bitmap()
+        leading_zeros = True
+        for i in range(bit_depth - 1, -1, -1):
+            plane = self._brow(BSI_OFFSET_BIT + i)
+            bit = (predicate >> i) & 1
+            if leading_zeros:
+                if bit == 0:
+                    filt = filt.difference(plane)
+                    continue
+                leading_zeros = False
+            if i == 0 and not allow_eq:
+                if bit == 0:
+                    return keep
+                return filt.difference(plane.difference(keep))
+            if bit == 0:
+                filt = filt.difference(plane.difference(keep))
+                continue
+            if i > 0:
+                keep = keep.union(filt.difference(plane))
+        return filt
+
+    def _range_gt_unsigned(self, filt: Bitmap, bit_depth: int, predicate: int, allow_eq: bool) -> Bitmap:
+        keep = Bitmap()
+        for i in range(bit_depth - 1, -1, -1):
+            plane = self._brow(BSI_OFFSET_BIT + i)
+            bit = (predicate >> i) & 1
+            if i == 0 and not allow_eq:
+                if bit == 1:
+                    return keep
+                return filt.difference(filt.difference(plane).difference(keep))
+            if bit == 1:
+                filt = filt.difference(filt.difference(plane).difference(keep))
+                continue
+            if i > 0:
+                keep = keep.union(filt.intersect(plane))
+        return filt
+
+    def _range_between_unsigned(self, filt: Bitmap, bit_depth: int, pmin: int, pmax: int) -> Bitmap:
+        keep1 = Bitmap()  # GTE min
+        keep2 = Bitmap()  # LTE max
+        for i in range(bit_depth - 1, -1, -1):
+            plane = self._brow(BSI_OFFSET_BIT + i)
+            bit1 = (pmin >> i) & 1
+            bit2 = (pmax >> i) & 1
+            if bit1 == 1:
+                filt = filt.difference(filt.difference(plane).difference(keep1))
+            elif i > 0:
+                keep1 = keep1.union(filt.intersect(plane))
+            if bit2 == 0:
+                filt = filt.difference(plane.difference(keep2))
+            elif i > 0:
+                keep2 = keep2.union(filt.difference(plane))
+        return filt
+
+    # -- TopN / Rows -------------------------------------------------------
+
+    def top(
+        self,
+        n: int = 0,
+        src: Optional[Row] = None,
+        row_ids: Optional[list[int]] = None,
+        min_threshold: int = 0,
+        tanimoto_threshold: int = 0,
+    ) -> list[Pair]:
+        """Top rows by count (reference fragment.top :1570). Candidates come
+        from the rank cache; when src is given counts are exact
+        intersection counts."""
+        with self.lock:
+            if row_ids is not None:
+                candidates = [Pair(id=r, count=self.cache.get(r)) for r in row_ids]
+            else:
+                candidates = self.cache.top()
+            if src is not None:
+                src_bm = src.shard_bitmap(self.shard)
+                src_count = src_bm.count()
+                out = []
+                for p in candidates:
+                    if tanimoto_threshold > 0:
+                        # prune: count must be within tanimoto bound
+                        # (reference fragment.go:1657-1676)
+                        if p.count < tanimoto_threshold * src_count // 100:
+                            continue
+                    c = self._row_bitmap(p.id).intersection_count(src_bm)
+                    if tanimoto_threshold > 0:
+                        union = p.count + src_count - c
+                        if union == 0 or c * 100 // union < tanimoto_threshold:
+                            continue
+                    if c > 0 and c >= min_threshold:
+                        out.append(Pair(id=p.id, count=c))
+            else:
+                out = [p for p in candidates if p.count > 0 and p.count >= min_threshold]
+            return top_n_pairs(out, n)
+
+    def rows(
+        self,
+        column: Optional[int] = None,
+        start_row: int = 0,
+        limit: int = 0,
+    ) -> list[int]:
+        """Row-ID scan with filters (reference fragment.rows :2618)."""
+        with self.lock:
+            ids = [r for r in self.row_ids() if r >= start_row]
+            if column is not None:
+                col = column % SHARD_WIDTH
+                ids = [r for r in ids if self.storage.contains(r * SHARD_WIDTH + col)]
+            if limit:
+                ids = ids[:limit]
+            return ids
+
+    # -- bulk import -------------------------------------------------------
+
+    def bulk_import(self, row_ids: np.ndarray, column_ids: np.ndarray, clear: bool = False) -> None:
+        """Batched bit import: one WAL record (reference fragment.bulkImport
+        :1997 -> importPositions :2053)."""
+        with self.lock:
+            row_ids = np.asarray(row_ids, dtype=np.uint64)
+            column_ids = np.asarray(column_ids, dtype=np.uint64)
+            if self.mutex and not clear:
+                self._bulk_import_mutex(row_ids, column_ids)
+                return
+            positions = row_ids * np.uint64(SHARD_WIDTH) + (
+                column_ids % np.uint64(SHARD_WIDTH)
+            )
+            if clear:
+                self.storage.remove_many(positions)
+            else:
+                self.storage.add_many(positions)
+            self._rebuild_cache_rows(np.unique(row_ids))
+            self._mutated()
+            if not clear and row_ids.size:
+                self.max_row_id = max(self.max_row_id, int(row_ids.max()))
+            self._increment_op_n()
+
+    def _bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray) -> None:
+        """Mutex import: last write per column wins, other rows cleared
+        (reference fragment.bulkImportMutex :2133)."""
+        # Deduplicate: keep the last (row, column) per column.
+        last: dict[int, int] = {}
+        for r, c in zip(row_ids.tolist(), column_ids.tolist()):
+            last[c % SHARD_WIDTH] = r
+        cols = np.array(sorted(last), dtype=np.uint64)
+        targets = np.array([last[int(c)] for c in cols], dtype=np.uint64)
+        to_clear = []
+        for row_id in self.row_ids():
+            row_bm = self._row_bitmap(row_id)
+            mask = np.array([row_bm.contains(int(c)) and last[int(c)] != row_id for c in cols])
+            if mask.any():
+                to_clear.append(row_id * np.uint64(SHARD_WIDTH) + cols[mask])
+        if to_clear:
+            self.storage.remove_many(np.concatenate(to_clear))
+        self.storage.add_many(targets * np.uint64(SHARD_WIDTH) + cols)
+        self._rebuild_cache_rows(np.unique(np.concatenate([targets, np.asarray(row_ids, dtype=np.uint64)])))
+        self._mutated()
+        if targets.size:
+            self.max_row_id = max(self.max_row_id, int(targets.max()))
+        self._increment_op_n()
+
+    def import_value(
+        self, column_ids: np.ndarray, values: np.ndarray, bit_depth: int, clear: bool = False
+    ) -> None:
+        """Bulk BSI write (reference fragment.importValue :2205): one batched
+        add/remove per plane instead of per-column loops."""
+        with self.lock:
+            column_ids = np.asarray(column_ids, dtype=np.uint64)
+            values = np.asarray(values, dtype=np.int64)
+            cols = column_ids % np.uint64(SHARD_WIDTH)
+            uvals = np.abs(values).astype(np.uint64)
+            to_set = []
+            to_clear = []
+            for i in range(bit_depth):
+                plane_base = np.uint64((BSI_OFFSET_BIT + i) * SHARD_WIDTH)
+                bit_set = (uvals >> np.uint64(i)) & np.uint64(1) == 1
+                to_set.append(plane_base + cols[bit_set])
+                to_clear.append(plane_base + cols[~bit_set])
+            exists = np.uint64(BSI_EXISTS_BIT * SHARD_WIDTH) + cols
+            sign_base = np.uint64(BSI_SIGN_BIT * SHARD_WIDTH)
+            neg = values < 0
+            if clear:
+                to_clear.append(exists)
+                to_clear.append(sign_base + cols)
+            else:
+                to_set.append(exists)
+                to_set.append(sign_base + cols[neg])
+                to_clear.append(sign_base + cols[~neg])
+            if clear:
+                to_clear.extend(to_set)
+                to_set = []
+            if to_set:
+                self.storage.add_many(np.concatenate(to_set))
+            if to_clear:
+                self.storage.remove_many(np.concatenate(to_clear))
+            self._mutated()
+            top = BSI_OFFSET_BIT + bit_depth - 1
+            if not clear and top > self.max_row_id:
+                self.max_row_id = top
+            self._increment_op_n()
+
+    def import_roaring(self, data: bytes, clear: bool = False) -> int:
+        """Union/clear a pre-serialized roaring bitmap in one op
+        (reference fragment.importRoaring :2255)."""
+        with self.lock:
+            changed = self.storage.import_roaring_bits(data, clear=clear)
+            self._rebuild_cache_rows(np.array(self.row_ids()))
+            self._mutated()
+            if self.storage.any():
+                self.max_row_id = self.storage.max() // SHARD_WIDTH
+            self._increment_op_n()
+            return changed
+
+    def _rebuild_cache_rows(self, row_ids: np.ndarray) -> None:
+        for r in row_ids.tolist():
+            self.cache.bulk_add(int(r), self.row_count(int(r)))
+        self.cache.invalidate()
+
+    # -- anti-entropy block checksums (reference fragment.go:1778-1875) ----
+
+    def checksum_blocks(self) -> list[tuple[int, int]]:
+        """[(block_id, checksum)] for each 100-row block with data. Checksum
+        is xxhash64 of the block's serialized sub-bitmap (the reference
+        hashes (row,col) pair streams with xxhash, fragment.go:2814; any
+        deterministic digest works as long as all nodes agree)."""
+        with self.lock:
+            out = []
+            block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
+            blocks = sorted({(k << 16) // block_span for k in self.storage.keys()})
+            for b in blocks:
+                sub = self.storage.offset_range(0, b * block_span, (b + 1) * block_span)
+                if sub.any():
+                    out.append((b, xxhash64(serialize(sub))))
+            return out
+
+    def block_data(self, block_id: int) -> bytes:
+        """Serialized sub-bitmap for one block (positions block-relative),
+        for anti-entropy merge (reference fragment.BlockData)."""
+        with self.lock:
+            block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
+            sub = self.storage.offset_range(0, block_id * block_span, (block_id + 1) * block_span)
+            return serialize(sub)
+
+    def merge_block(self, block_id: int, data: bytes) -> tuple[int, int]:
+        """Union a peer's block into ours; returns (added, _) counts
+        (reference fragment.mergeBlock :1875 — the reference computes
+        set/clear diffs; we union, matching its add-path)."""
+        with self.lock:
+            other = deserialize(data)
+            block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
+            abs_bm = other.offset_range(block_id * block_span, 0, block_span)
+            before = self.storage.count()
+            self.storage.union_in_place(abs_bm)
+            # Log the change so the WAL stays consistent.
+            if self.storage.op_writer is not None:
+                self.storage.op_writer.append_roaring(serialize(abs_bm), self.storage.count() - before, False)
+            self._rebuild_cache_rows(np.array(self.row_ids()))
+            self._mutated()
+            return self.storage.count() - before, 0
+
+    # -- maintenance -------------------------------------------------------
+
+    def min_row_id(self) -> tuple[int, bool]:
+        if not self.storage.any():
+            return 0, False
+        lo, _ = self.storage.min()
+        return lo // SHARD_WIDTH, True
+
+    def min_row(self, filter_row: Optional[Row]) -> tuple[int, int]:
+        """reference fragment.minRow :1232."""
+        with self.lock:
+            lo, ok = self.min_row_id()
+            if not ok:
+                return 0, 0
+            if filter_row is None:
+                return lo, 1
+            for r in self.row_ids():
+                cnt = self.row(r).intersection_count(filter_row)
+                if cnt > 0:
+                    return r, cnt
+            return 0, 0
+
+    def max_row(self, filter_row: Optional[Row]) -> tuple[int, int]:
+        with self.lock:
+            lo, ok = self.min_row_id()
+            if not ok:
+                return 0, 0
+            if filter_row is None:
+                return self.max_row_id, 1
+            for r in reversed(self.row_ids()):
+                cnt = self.row(r).intersection_count(filter_row)
+                if cnt > 0:
+                    return r, cnt
+            return 0, 0

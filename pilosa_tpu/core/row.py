@@ -1,0 +1,136 @@
+"""Row — a query-result bitmap spanning shards (reference row.go).
+
+The reference keeps per-shard rowSegments holding roaring bitmaps in
+absolute column space (reference row.go:27,332). Here a Row maps
+shard -> roaring.Bitmap with *shard-relative* positions (0..SHARD_WIDTH),
+which is both simpler and exactly the layout the TPU dense blocks use;
+absolute columns are materialized only at result-serialization time.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from pilosa_tpu.roaring import Bitmap
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class Row:
+    __slots__ = ("segments", "attrs", "keys")
+
+    def __init__(self, columns: Optional[Iterable[int]] = None):
+        # shard -> Bitmap of shard-relative positions
+        self.segments: dict[int, Bitmap] = {}
+        self.attrs: dict = {}
+        self.keys: list[str] = []
+        if columns is not None:
+            cols = np.asarray(
+                list(columns) if not isinstance(columns, np.ndarray) else columns,
+                dtype=np.uint64,
+            )
+            if cols.size:
+                shards = cols // np.uint64(SHARD_WIDTH)
+                for shard in np.unique(shards):
+                    sel = cols[shards == shard]
+                    self.segments[int(shard)] = Bitmap(sel % np.uint64(SHARD_WIDTH))
+
+    @staticmethod
+    def from_segment(shard: int, bitmap: Bitmap) -> "Row":
+        r = Row()
+        if bitmap.any():
+            r.segments[shard] = bitmap
+        return r
+
+    # -- set algebra (segment-wise; reference row.go:107-217) -------------
+
+    def _binary(self, other: "Row", fn, keys) -> "Row":
+        out = Row()
+        empty = Bitmap()
+        for shard in keys:
+            a = self.segments.get(shard, empty)
+            b = other.segments.get(shard, empty)
+            c = fn(a, b)
+            if c.any():
+                out.segments[shard] = c
+        return out
+
+    def intersect(self, other: "Row") -> "Row":
+        return self._binary(
+            other, Bitmap.intersect, self.segments.keys() & other.segments.keys()
+        )
+
+    def union(self, other: "Row") -> "Row":
+        return self._binary(
+            other, Bitmap.union, self.segments.keys() | other.segments.keys()
+        )
+
+    def difference(self, other: "Row") -> "Row":
+        return self._binary(other, Bitmap.difference, self.segments.keys())
+
+    def xor(self, other: "Row") -> "Row":
+        return self._binary(
+            other, Bitmap.xor, self.segments.keys() | other.segments.keys()
+        )
+
+    def shift(self) -> "Row":
+        # Shift within each shard; Pilosa's Shift does not carry across
+        # shards either (reference row.go Shift -> segment-wise shift).
+        out = Row()
+        for shard, seg in self.segments.items():
+            shifted = seg.shift()
+            # Drop any bit shifted past the shard width.
+            if shifted.max() >= SHARD_WIDTH:
+                shifted.remove(SHARD_WIDTH, log=False)
+            if shifted.any():
+                out.segments[shard] = shifted
+        return out
+
+    def intersection_count(self, other: "Row") -> int:
+        return sum(
+            self.segments[s].intersection_count(other.segments[s])
+            for s in self.segments.keys() & other.segments.keys()
+        )
+
+    def count(self) -> int:
+        return sum(b.count() for b in self.segments.values())
+
+    def any(self) -> bool:
+        return any(b.any() for b in self.segments.values())
+
+    def includes_column(self, col: int) -> bool:
+        shard = col // SHARD_WIDTH
+        seg = self.segments.get(shard)
+        return seg is not None and seg.contains(col % SHARD_WIDTH)
+
+    def columns(self) -> np.ndarray:
+        """All absolute column IDs, sorted ascending."""
+        parts = []
+        for shard in sorted(self.segments):
+            seg = self.segments[shard]
+            parts.append(seg.to_array() + np.uint64(shard * SHARD_WIDTH))
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def shard_bitmap(self, shard: int) -> Bitmap:
+        return self.segments.get(shard, Bitmap())
+
+    def merge(self, other: "Row") -> None:
+        """Absorb other's segments (used by the executor's reduce step,
+        reference row.go Merge :67)."""
+        for shard, seg in other.segments.items():
+            mine = self.segments.get(shard)
+            if mine is None:
+                self.segments[shard] = seg
+            else:
+                self.segments[shard] = mine.union(seg)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Row):
+            return NotImplemented
+        return np.array_equal(self.columns(), other.columns())
+
+    def __repr__(self) -> str:
+        return f"Row(count={self.count()}, shards={sorted(self.segments)})"

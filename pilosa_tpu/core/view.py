@@ -1,0 +1,114 @@
+"""View: a layout of rows within a field (reference view.go).
+
+Views are "standard", time-quantum views like "standard_20190101", or BSI
+views "bsig_<field>" (reference view.go:37-41). A view owns one fragment
+per shard, laid out on disk at <field>/views/<view>/fragments/<shard>.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from pilosa_tpu.core.fragment import Fragment
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsig_"
+
+
+def view_by_time(name: str, t, unit: str) -> str:
+    from pilosa_tpu.core.timequantum import view_by_time_unit
+
+    return view_by_time_unit(name, t, unit)
+
+
+def bsi_view_name(field_name: str) -> str:
+    return VIEW_BSI_PREFIX + field_name
+
+
+class View:
+    def __init__(
+        self,
+        path: Optional[str],
+        index: str,
+        field: str,
+        name: str,
+        cache_type: str = "ranked",
+        cache_size: int = 50000,
+        mutex: bool = False,
+        broadcast_shard: Optional[Callable[[str, str, int], None]] = None,
+    ):
+        self.path = path  # .../<field>/views/<name>
+        self.index = index
+        self.field = field
+        self.name = name
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.mutex = mutex
+        self.fragments: dict[int, Fragment] = {}
+        self.lock = threading.RLock()
+        # Called the first time a shard appears so the cluster layer can
+        # broadcast CreateShardMessage (reference view.go:263-305).
+        self.broadcast_shard = broadcast_shard
+
+    def open(self) -> "View":
+        if self.path is not None:
+            frag_dir = os.path.join(self.path, "fragments")
+            os.makedirs(frag_dir, exist_ok=True)
+            for entry in sorted(os.listdir(frag_dir)):
+                if not entry.isdigit():
+                    continue
+                shard = int(entry)
+                self.fragments[shard] = self._new_fragment(shard).open()
+        return self
+
+    def close(self) -> None:
+        with self.lock:
+            for f in self.fragments.values():
+                f.close()
+
+    def _fragment_path(self, shard: int) -> Optional[str]:
+        if self.path is None:
+            return None
+        return os.path.join(self.path, "fragments", str(shard))
+
+    def _new_fragment(self, shard: int) -> Fragment:
+        return Fragment(
+            self._fragment_path(shard),
+            self.index,
+            self.field,
+            self.name,
+            shard,
+            cache_type=self.cache_type,
+            cache_size=self.cache_size,
+            mutex=self.mutex,
+        )
+
+    def fragment(self, shard: int) -> Optional[Fragment]:
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        """reference view.go CreateFragmentIfNotExists :263."""
+        with self.lock:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                frag = self._new_fragment(shard).open()
+                self.fragments[shard] = frag
+                if self.broadcast_shard is not None:
+                    self.broadcast_shard(self.index, self.field, shard)
+            return frag
+
+    def available_shards(self) -> list[int]:
+        return sorted(self.fragments)
+
+    def delete_fragment(self, shard: int) -> None:
+        with self.lock:
+            frag = self.fragments.pop(shard, None)
+            if frag is not None:
+                frag.close()
+                if frag.path and os.path.exists(frag.path):
+                    os.remove(frag.path)
+                cache_path = (frag.path or "") + ".cache"
+                if frag.path and os.path.exists(cache_path):
+                    os.remove(cache_path)

@@ -331,18 +331,17 @@ class Parser:
         ok, _ = self._try(self._lit, "[")
         if ok:
             self._sp()
-            items: list[Any] = []
-            ok2, first = self._try(self._item, call)
-            if ok2:
-                items.append(first)
-                while True:
-                    saved = self.pos
-                    try:
-                        self._comma()
-                        items.append(self._item(call))
-                    except _Backtrack:
-                        self.pos = saved
-                        break
+            # list <- item (comma list)? — at least one item (reference
+            # pql.peg list rule; '[]' is a parse error there too).
+            items: list[Any] = [self._item(call)]
+            while True:
+                saved = self.pos
+                try:
+                    self._comma()
+                    items.append(self._item(call))
+                except _Backtrack:
+                    self.pos = saved
+                    break
             self._sp()
             self._lit("]")
             self._sp()
@@ -355,7 +354,10 @@ class Parser:
             saved = self.pos
             try:
                 self._lit(word)
-                if not self._at_item_boundary():
+                # The grammar's lookahead is &(comma / sp close) — ')' only,
+                # NOT ']': inside a list, "null]" falls through to the
+                # bare-string rule (reference pql.peg item rule).
+                if not self._at_item_boundary(allow_rbrack=False):
                     raise _Backtrack()
                 return value
             except _Backtrack:
@@ -393,12 +395,13 @@ class Parser:
             return s
         raise _Backtrack()
 
-    def _at_item_boundary(self) -> bool:
+    def _at_item_boundary(self, allow_rbrack: bool = True) -> bool:
         """After an item we must see a comma, ')' or ']' (possibly via sp)."""
         i = self.pos
         while i < len(self.text) and self.text[i] in " \t\n":
             i += 1
-        return i >= len(self.text) or self.text[i] in ",)]"
+        boundary = ",)]" if allow_rbrack else ",)"
+        return i >= len(self.text) or self.text[i] in boundary
 
     def _quoted(self, q: str) -> str:
         self._lit(q)

@@ -353,28 +353,35 @@ def apply_ops(b: Bitmap, data: bytes, offset: int) -> int:
 class OpWriter:
     """Appends checksummed op records to a file (the fragment WAL).
 
-    Attached to a Bitmap as bitmap.op_writer (reference fragment.go:455);
-    the fragment fsync policy decides when to flush.
+    Attached to a Bitmap as bitmap.op_writer (reference fragment.go:455).
+    Callers should hand in an unbuffered file (fragment.open uses
+    buffering=0) so each record hits the OS immediately and a process crash
+    loses nothing — matching the reference's unbuffered Go file writes;
+    fsync is left to the OS like the reference does. flush() covers
+    buffered writers.
     """
 
     def __init__(self, f: BinaryIO):
         self.f = f
 
+    def _write(self, record: bytes) -> None:
+        self.f.write(record)
+
     def append_add(self, v: int) -> None:
-        self.f.write(encode_op(OP_ADD, value=v))
+        self._write(encode_op(OP_ADD, value=v))
 
     def append_remove(self, v: int) -> None:
-        self.f.write(encode_op(OP_REMOVE, value=v))
+        self._write(encode_op(OP_REMOVE, value=v))
 
     def append_add_batch(self, vs: np.ndarray) -> None:
-        self.f.write(encode_op(OP_ADD_BATCH, values=vs))
+        self._write(encode_op(OP_ADD_BATCH, values=vs))
 
     def append_remove_batch(self, vs: np.ndarray) -> None:
-        self.f.write(encode_op(OP_REMOVE_BATCH, values=vs))
+        self._write(encode_op(OP_REMOVE_BATCH, values=vs))
 
     def append_roaring(self, data: bytes, op_n: int, clear: bool) -> None:
         typ = OP_REMOVE_ROARING if clear else OP_ADD_ROARING
-        self.f.write(encode_op(typ, roaring=data, op_n=op_n))
+        self._write(encode_op(typ, roaring=data, op_n=op_n))
 
     def flush(self) -> None:
         self.f.flush()

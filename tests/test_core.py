@@ -1,0 +1,349 @@
+"""Core storage tests: fragment bit/BSI ops, durability, field/view/index
+hierarchy, time quantum views. Mirrors the layered strategy of the
+reference's fragment_internal_test.go / field_internal_test.go (SURVEY §4)
+with numpy oracles for BSI differential checks."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Field, FieldOptions, Fragment, Holder, Index, Row
+from pilosa_tpu.core.field import (
+    options_for_bool,
+    options_for_int,
+    options_for_mutex,
+    options_for_time,
+)
+from pilosa_tpu.core.fragment import MAX_OP_N
+from pilosa_tpu.core.timequantum import views_by_time, views_by_time_range
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def mem_fragment(**kw):
+    return Fragment(None, "i", "f", "standard", 0, **kw)
+
+
+class TestFragmentBits:
+    def test_set_clear_row(self):
+        f = mem_fragment()
+        assert f.set_bit(0, 100)
+        assert not f.set_bit(0, 100)
+        assert f.set_bit(3, 100)
+        assert f.set_bit(3, 200)
+        np.testing.assert_array_equal(f.row(3).columns(), [100, 200])
+        assert f.clear_bit(3, 100)
+        np.testing.assert_array_equal(f.row(3).columns(), [200])
+        assert f.row_count(3) == 1
+        assert f.max_row_id == 3
+        assert f.row_ids() == [0, 3]
+
+    def test_shard_relative_columns(self):
+        f = Fragment(None, "i", "f", "standard", 2)
+        col = 2 * SHARD_WIDTH + 5
+        f.set_bit(1, col)
+        np.testing.assert_array_equal(f.row(1).columns(), [col])
+
+    def test_mutex(self):
+        f = mem_fragment(mutex=True)
+        f.set_bit(1, 50)
+        f.set_bit(2, 50)  # must clear row 1's bit
+        assert f.row(1).count() == 0
+        np.testing.assert_array_equal(f.row(2).columns(), [50])
+
+    def test_clear_row_and_set_row(self):
+        f = mem_fragment()
+        f.bulk_import(np.array([1, 1, 1]), np.array([10, 20, 30]))
+        assert f.clear_row(1)
+        assert f.row(1).count() == 0
+        r = Row([5, 6])
+        f.set_row(r, 2)
+        np.testing.assert_array_equal(f.row(2).columns(), [5, 6])
+
+    def test_bulk_import_and_cache(self):
+        f = mem_fragment()
+        rows = np.array([7] * 1000 + [8] * 500, dtype=np.uint64)
+        cols = np.arange(1500, dtype=np.uint64)
+        f.bulk_import(rows, cols)
+        assert f.row_count(7) == 1000
+        assert f.row_count(8) == 500
+        top = f.top(n=2)
+        assert [(p.id, p.count) for p in top] == [(7, 1000), (8, 500)]
+
+    def test_bulk_import_mutex(self):
+        f = mem_fragment(mutex=True)
+        f.bulk_import(np.array([1, 2]), np.array([9, 9]))  # last wins
+        assert f.row(1).count() == 0
+        np.testing.assert_array_equal(f.row(2).columns(), [9])
+
+    def test_import_roaring(self):
+        from pilosa_tpu.roaring import Bitmap, serialize
+
+        f = mem_fragment()
+        bm = Bitmap(np.array([5, 10, SHARD_WIDTH + 3], dtype=np.uint64))  # rows 0 and 1
+        changed = f.import_roaring(serialize(bm))
+        assert changed == 3
+        np.testing.assert_array_equal(f.row(0).columns(), [5, 10])
+        np.testing.assert_array_equal(f.row(1).columns(), [3])
+
+
+class TestFragmentBSI:
+    @pytest.mark.parametrize("seed", [1, 2])
+    def test_differential_vs_numpy(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 500
+        cols = np.unique(rng.integers(0, 100_000, n, dtype=np.uint64))
+        vals = rng.integers(-(2**12), 2**12, cols.size, dtype=np.int64)
+        depth = 13
+        f = mem_fragment(cache_type="none")
+        f.import_value(cols, vals, depth)
+
+        # value() readback
+        for i in range(0, cols.size, 37):
+            v, ok = f.value(int(cols[i]), depth)
+            assert ok and v == vals[i]
+
+        # sum / min / max
+        s, c = f.sum(None, depth)
+        assert (s, c) == (int(vals.sum()), cols.size)
+        mn, _ = f.min(None, depth)
+        mx, _ = f.max(None, depth)
+        assert mn == int(vals.min())
+        assert mx == int(vals.max())
+
+        # range ops vs numpy
+        for op, npop in [
+            ("==", np.equal), ("!=", np.not_equal),
+            ("<", np.less), ("<=", np.less_equal),
+            (">", np.greater), (">=", np.greater_equal),
+        ]:
+            for pred in [-5000, -37, 0, 1, 800, 5000]:
+                got = f.range_op(op, depth, pred).columns()
+                want = cols[npop(vals, pred)]
+                np.testing.assert_array_equal(got, want, err_msg=f"{op} {pred}")
+
+        # between
+        for lo, hi in [(-100, 100), (-5000, -1), (0, 5000), (37, 38)]:
+            got = f.range_between(depth, lo, hi).columns()
+            want = cols[(vals >= lo) & (vals <= hi)]
+            np.testing.assert_array_equal(got, want)
+
+    def test_sum_with_filter(self):
+        f = mem_fragment(cache_type="none")
+        f.import_value(np.array([1, 2, 3]), np.array([10, 20, 30]), 6)
+        filt = Row([1, 3])
+        s, c = f.sum(filt, 6)
+        assert (s, c) == (40, 2)
+
+    def test_set_value_overwrite(self):
+        f = mem_fragment(cache_type="none")
+        f.set_value(42, 8, 100)
+        f.set_value(42, 8, -3)
+        assert f.value(42, 8) == (-3, True)
+        f.clear_value(42, 8)
+        assert f.value(42, 8) == (0, False)
+
+
+class TestFragmentDurability:
+    def test_reopen(self, tmp_path):
+        p = str(tmp_path / "frag" / "0")
+        f = Fragment(p, "i", "f", "standard", 0).open()
+        f.set_bit(1, 10)
+        f.bulk_import(np.array([2, 2]), np.array([20, 21]))
+        f.close()
+        f2 = Fragment(p, "i", "f", "standard", 0).open()
+        np.testing.assert_array_equal(f2.row(1).columns(), [10])
+        np.testing.assert_array_equal(f2.row(2).columns(), [20, 21])
+        assert f2.max_row_id == 2
+        f2.close()
+
+    def test_snapshot_on_op_threshold(self, tmp_path):
+        p = str(tmp_path / "frag" / "0")
+        f = Fragment(p, "i", "f", "standard", 0).open()
+        # A single large batch exceeds MAX_OP_N and triggers a snapshot.
+        vals = np.arange(MAX_OP_N + 10, dtype=np.uint64)
+        f.bulk_import(np.zeros(vals.size, dtype=np.uint64), vals)
+        assert f.storage.op_n == 0  # snapshot reset
+        f.close()
+        f2 = Fragment(p, "i", "f", "standard", 0).open()
+        assert f2.row_count(0) == MAX_OP_N + 10
+        f2.close()
+
+    def test_checksum_blocks(self):
+        f = mem_fragment()
+        f.set_bit(5, 100)
+        f.set_bit(150, 100)  # second block (rows 100-199)
+        blocks = f.checksum_blocks()
+        assert [b for b, _ in blocks] == [0, 1]
+        # merge into an empty fragment reproduces the data
+        g = mem_fragment()
+        for bid, _ in blocks:
+            g.merge_block(bid, f.block_data(bid))
+        assert g.checksum_blocks() == blocks
+
+
+class TestField:
+    def test_set_field_basics(self, tmp_path):
+        f = Field(str(tmp_path / "f"), "i", "f").open()
+        assert f.set_bit(1, 100)
+        assert f.set_bit(1, SHARD_WIDTH + 5)
+        row = f.row(1, 0)
+        np.testing.assert_array_equal(row.columns(), [100])
+        row = f.row(1, 1)
+        np.testing.assert_array_equal(row.columns(), [SHARD_WIDTH + 5])
+        shards = f.available_shards()
+        assert sorted(shards.to_array().tolist()) == [0, 1]
+        f.close()
+
+    def test_int_field(self, tmp_path):
+        f = Field(str(tmp_path / "v"), "i", "v", options_for_int(-1000, 1000)).open()
+        f.set_value(10, 250)
+        f.set_value(11, -250)
+        assert f.value(10) == (250, True)
+        assert f.value(11) == (-250, True)
+        s, c = f.sum(None, 0)
+        assert (s, c) == (0, 2)
+        with pytest.raises(ValueError, match="less than"):
+            f.set_value(1, -2000)
+        with pytest.raises(ValueError, match="greater than"):
+            f.set_value(1, 2000)
+        f.close()
+
+    def test_int_field_base_offset(self, tmp_path):
+        # min > 0 => base = min; stored values are base-relative.
+        f = Field(str(tmp_path / "v"), "i", "v", options_for_int(100, 200)).open()
+        f.set_value(1, 150)
+        assert f.value(1) == (150, True)
+        s, c = f.sum(None, 0)
+        assert (s, c) == (150, 1)
+        mn, _ = f.min(None, 0)
+        mx, _ = f.max(None, 0)
+        assert (mn, mx) == (150, 150)
+        f.close()
+
+    def test_bool_field(self, tmp_path):
+        f = Field(str(tmp_path / "b"), "i", "b", options_for_bool()).open()
+        f.set_bit(1, 7)  # true
+        f.set_bit(0, 7)  # flips to false (mutex-like)
+        assert f.row(1, 0).count() == 0
+        np.testing.assert_array_equal(f.row(0, 0).columns(), [7])
+        f.close()
+
+    def test_time_field(self, tmp_path):
+        f = Field(str(tmp_path / "t"), "i", "t", options_for_time("YMD")).open()
+        ts = dt.datetime(2018, 3, 5, 10)
+        f.set_bit(2, 9, timestamp=ts)
+        assert set(f.views) >= {"standard", "standard_2018", "standard_201803", "standard_20180305"}
+        got = f.row_time(2, 0, dt.datetime(2018, 1, 1), dt.datetime(2019, 1, 1))
+        np.testing.assert_array_equal(got.columns(), [9])
+        got = f.row_time(2, 0, dt.datetime(2017, 1, 1), dt.datetime(2018, 1, 1))
+        assert got.count() == 0
+        f.close()
+
+    def test_field_reopen_meta(self, tmp_path):
+        path = str(tmp_path / "v")
+        f = Field(path, "i", "v", options_for_int(-100, 100)).open()
+        f.save_meta()
+        f.set_value(5, 42)
+        f.close()
+        f2 = Field(path, "i", "v").open()
+        assert f2.options.type == "int"
+        assert f2.value(5) == (42, True)
+        f2.close()
+
+    def test_mutex_field(self, tmp_path):
+        f = Field(str(tmp_path / "m"), "i", "m", options_for_mutex()).open()
+        f.set_bit(1, 3)
+        f.set_bit(2, 3)
+        assert f.row(1, 0).count() == 0
+        assert f.row(2, 0).count() == 1
+        f.close()
+
+
+class TestTimeQuantum:
+    def test_views_by_time(self):
+        t = dt.datetime(2017, 9, 2, 12)
+        assert views_by_time("standard", t, "YMDH") == [
+            "standard_2017",
+            "standard_201709",
+            "standard_20170902",
+            "standard_2017090212",
+        ]
+
+    def test_views_by_time_range_ymdh(self):
+        # Mirrors reference time_internal_test.go expectations.
+        got = views_by_time_range(
+            "f",
+            dt.datetime(2016, 7, 6, 13),
+            dt.datetime(2016, 7, 8, 2),
+            "YMDH",
+        )
+        assert got == [
+            "f_2016070613", "f_2016070614", "f_2016070615", "f_2016070616",
+            "f_2016070617", "f_2016070618", "f_2016070619", "f_2016070620",
+            "f_2016070621", "f_2016070622", "f_2016070623",
+            "f_20160707",
+            "f_2016070800", "f_2016070801",
+        ]
+
+    def test_views_by_time_range_y(self):
+        got = views_by_time_range("f", dt.datetime(2015, 1, 1), dt.datetime(2017, 1, 1), "Y")
+        assert got == ["f_2015", "f_2016"]
+
+    def test_views_by_time_range_partial_year(self):
+        got = views_by_time_range("f", dt.datetime(2015, 11, 1), dt.datetime(2016, 2, 1), "YM")
+        assert got == ["f_201511", "f_201512", "f_201601"]
+
+
+class TestHierarchy:
+    def test_holder_index_field_reopen(self, tmp_path):
+        h = Holder(str(tmp_path / "data")).open()
+        idx = h.create_index("myidx")
+        f = idx.create_field("myfield")
+        f.set_bit(1, 100)
+        v = idx.create_field("vals", options_for_int(0, 1000))
+        v.set_value(3, 500)
+        h.close()
+
+        h2 = Holder(str(tmp_path / "data")).open()
+        idx2 = h2.index("myidx")
+        assert idx2 is not None
+        np.testing.assert_array_equal(idx2.field("myfield").row(1, 0).columns(), [100])
+        assert idx2.field("vals").value(3) == (500, True)
+        assert sorted(idx2.available_shards().to_array().tolist()) == [0]
+        h2.close()
+
+    def test_existence_field_created(self, tmp_path):
+        h = Holder(str(tmp_path / "data")).open()
+        idx = h.create_index("i")
+        assert idx.existence_field() is not None
+        h.close()
+
+    def test_delete(self, tmp_path):
+        h = Holder(str(tmp_path / "data")).open()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        idx.delete_field("f")
+        assert idx.field("f") is None
+        h.delete_index("i")
+        assert h.index("i") is None
+        h.close()
+
+    def test_duplicate_create_rejected(self, tmp_path):
+        h = Holder(str(tmp_path / "data")).open()
+        h.create_index("i")
+        with pytest.raises(ValueError, match="exists"):
+            h.create_index("i")
+        with pytest.raises(ValueError, match="invalid"):
+            h.create_index("BAD_NAME!")
+        h.close()
+
+    def test_schema(self, tmp_path):
+        h = Holder(str(tmp_path / "data")).open()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        schema = h.schema()
+        assert schema[0]["name"] == "i"
+        assert schema[0]["fields"][0]["name"] == "f"
+        assert schema[0]["shardWidth"] == SHARD_WIDTH
+        h.close()

@@ -106,6 +106,23 @@ class TestArgs:
         c = one("TopN(f, ids=[1,2,3])")
         assert c.args["ids"] == [1, 2, 3]
 
+    def test_null_in_list_is_bare_string(self):
+        # The grammar's null/true/false lookahead is &(comma / sp ')'), so a
+        # terminal "null]" falls through to the bare-string rule.
+        c = one("TopN(f, ids=[1,null])")
+        assert c.args["ids"] == [1, "null"]
+
+    def test_empty_list_rejected(self):
+        with pytest.raises(ParseError):
+            parse_string("TopN(f, ids=[])")
+
+    def test_timestamp_with_trailing_garbage_rejected(self):
+        # Strict-PEG commit semantics: the timestamp alternative consumes
+        # '2020-01-01T00:00', the leftover ':00Z' breaks the enclosing rule,
+        # and like the reference's packrat parser this is a parse error.
+        with pytest.raises(ParseError):
+            parse_string("Row(f=2020-01-01T00:00:00Z)")
+
     def test_string_and_bool_and_null(self):
         c = one('GroupBy(Rows(a), limit=7, filter=null, x=true, y=false, s="hi")')
         assert c.args["filter"] is None
