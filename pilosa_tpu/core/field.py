@@ -148,6 +148,8 @@ class Field:
     # -- lifecycle --------------------------------------------------------
 
     def open(self) -> "Field":
+        from pilosa_tpu.store import AttrStore, TranslateStore
+
         if self.path is not None:
             os.makedirs(self.path, exist_ok=True)
             self._load_meta()
@@ -156,12 +158,25 @@ class Field:
             if os.path.isdir(views_dir):
                 for entry in sorted(os.listdir(views_dir)):
                     self.views[entry] = self._new_view(entry).open()
+        # Row attr store at <field>/.data (reference index.go:464); key
+        # translation at <field>/keys (reference field.go:438).
+        self.row_attr_store = AttrStore(
+            os.path.join(self.path, ".data") if self.path else None
+        )
+        if self.options.keys:
+            self.translate_store = TranslateStore(
+                os.path.join(self.path, "keys") if self.path else None
+            )
         return self
 
     def close(self) -> None:
         with self.lock:
             for v in self.views.values():
                 v.close()
+            if self.row_attr_store is not None:
+                self.row_attr_store.close()
+            if self.translate_store is not None:
+                self.translate_store.close()
 
     def _meta_path(self) -> str:
         return os.path.join(self.path, ".meta")

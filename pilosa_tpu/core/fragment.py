@@ -102,6 +102,15 @@ class Fragment:
             load_cache(self.cache, self.path + CACHE_EXT)
         mx = self.storage.max()
         self.max_row_id = mx // SHARD_WIDTH if self.storage.any() else 0
+        # A missing/stale .cache (e.g. after a crash — it is only flushed
+        # periodically and on close) must not make TopN silently empty:
+        # rebuild from storage. (The reference tolerates stale caches
+        # because Go flushes every minute, holder.go:506; a rebuild at open
+        # is cheap here and strictly better.)
+        if self.cache_type != "none" and len(self.cache) == 0 and self.storage.any():
+            for r in self.row_ids():
+                self.cache.bulk_add(r, self.row_count(r))
+            self.cache.invalidate()
         return self
 
     def close(self) -> None:
@@ -438,24 +447,39 @@ class Fragment:
         return self._brow(BSI_EXISTS_BIT).difference(self._range_eq(bit_depth, predicate))
 
     def _range_lt(self, bit_depth: int, predicate: int, allow_eq: bool) -> Bitmap:
+        # Divergence from the reference: it routes predicate==-1 (strict)
+        # through the positive branch (`predicate >= -1 && !allowEquality`,
+        # fragment.go:1343), which yields value-0 columns for `v < -1`.
+        # Negative predicates belong entirely to the negative-magnitude
+        # branch; `predicate >= 0` is the correct split.
         b = self._brow(BSI_EXISTS_BIT)
         sign = self._brow(BSI_SIGN_BIT)
         upredicate = abs(predicate)
-        if (predicate >= 0 and allow_eq) or (predicate >= -1 and not allow_eq):
+        if predicate >= 0:
             pos_ = self._range_lt_unsigned(b.difference(sign), bit_depth, upredicate, allow_eq)
             return sign.intersect(b).union(pos_)
         return self._range_gt_unsigned(b.intersect(sign), bit_depth, upredicate, allow_eq)
 
     def _range_gt(self, bit_depth: int, predicate: int, allow_eq: bool) -> Bitmap:
+        # Same -1 misroute as _range_lt (reference fragment.go:1412):
+        # `v > -1` must include 0 and all positives; split on predicate >= 0.
         b = self._brow(BSI_EXISTS_BIT)
         sign = self._brow(BSI_SIGN_BIT)
         upredicate = abs(predicate)
-        if (predicate >= 0 and allow_eq) or (predicate >= -1 and not allow_eq):
+        if predicate >= 0:
             return self._range_gt_unsigned(b.difference(sign), bit_depth, upredicate, allow_eq)
         neg = self._range_lt_unsigned(b.intersect(sign), bit_depth, upredicate, allow_eq)
         return b.difference(sign).union(neg)
 
     def _range_lt_unsigned(self, filt: Bitmap, bit_depth: int, predicate: int, allow_eq: bool) -> Bitmap:
+        # Divergence from the reference: its rangeLTUnsigned(pred=0, strict)
+        # falls through the leading-zeros loop and returns value-0 columns,
+        # so Go Pilosa's `Row(v < 0)` includes v==0 (untested edge in
+        # fragment_internal_test.go:571; fixed upstream post-1.4 by the
+        # twos-complement BSI rewrite). Strict "< 0" has no unsigned
+        # solutions; return empty.
+        if predicate == 0 and not allow_eq:
+            return Bitmap()
         keep = Bitmap()
         leading_zeros = True
         for i in range(bit_depth - 1, -1, -1):
@@ -525,7 +549,9 @@ class Fragment:
         intersection counts."""
         with self.lock:
             if row_ids is not None:
-                candidates = [Pair(id=r, count=self.cache.get(r)) for r in row_ids]
+                # Explicit ids (TopN pass 2): exact recount, not cache values
+                # (reference executor.go:879-898 exact recount protocol).
+                candidates = [Pair(id=r, count=self.row_count(r)) for r in row_ids]
             else:
                 candidates = self.cache.top()
             if src is not None:

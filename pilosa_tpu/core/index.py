@@ -65,15 +65,26 @@ class Index:
     # -- lifecycle --------------------------------------------------------
 
     def open(self) -> "Index":
+        from pilosa_tpu.store import AttrStore, TranslateStore
+
         if self.path is not None:
             os.makedirs(self.path, exist_ok=True)
             self._load_meta()
             for entry in sorted(os.listdir(self.path)):
                 full = os.path.join(self.path, entry)
-                if not os.path.isdir(full) or entry.startswith("."):
+                if not os.path.isdir(full) or entry.startswith(".") or entry == "keys":
                     continue
                 f = Field(full, self.name, entry, broadcast_shard=self.broadcast_shard)
                 self.fields[entry] = f.open()
+        # Column attr store at <index>/.data (reference holder.go:443); key
+        # translation at <index>/keys (reference index.go:153).
+        self.column_attr_store = AttrStore(
+            os.path.join(self.path, ".data") if self.path else None
+        )
+        if self.options.keys:
+            self.translate_store = TranslateStore(
+                os.path.join(self.path, "keys") if self.path else None
+            )
         if self.options.track_existence and EXISTENCE_FIELD_NAME not in self.fields:
             self._create_existence_field()
         return self
@@ -82,6 +93,10 @@ class Index:
         with self.lock:
             for f in self.fields.values():
                 f.close()
+            if self.column_attr_store is not None:
+                self.column_attr_store.close()
+            if self.translate_store is not None:
+                self.translate_store.close()
 
     def _meta_path(self) -> str:
         return os.path.join(self.path, ".meta")

@@ -1,0 +1,748 @@
+"""The PQL executor (reference executor.go).
+
+Entry point execute() mirrors the reference's flow (executor.go:113):
+translate keys to ids, execute each top-level call (serially — later calls
+may read earlier writes), translate result ids back to keys. Per-call
+evaluation fans shards out through map_reduce(), whose local form is a
+plain loop/thread-pool (reference mapperLocal worker pool :2578) and whose
+cluster form is wired in by the cluster layer. Per-shard bitmap evaluation
+is delegated to a backend (CPU oracle or the TPU device backend).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+from pilosa_tpu.core.cache import Pair, add_pairs, top_n_pairs
+from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_TIME
+from pilosa_tpu.core.index import EXISTENCE_FIELD_NAME
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.core.timequantum import parse_time, views_by_time_range
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec.cpu import CPUBackend, QueryError
+from pilosa_tpu.exec.result import (
+    FieldRow,
+    GroupCount,
+    PairField,
+    PairsField,
+    RowIDs,
+    ValCount,
+    merge_group_counts,
+)
+from pilosa_tpu.pql import Call, Condition, Query, parse_string
+from pilosa_tpu.pql.ast import is_reserved_arg
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+MAX_INT = (1 << 63) - 1
+
+
+@dataclass
+class ExecOptions:
+    """reference executor.go execOptions :2960."""
+
+    remote: bool = False
+    profile: bool = False
+    exclude_row_attrs: bool = False
+    exclude_columns: bool = False
+    column_attrs: bool = False
+    shards: Optional[list[int]] = None
+
+
+class Executor:
+    def __init__(self, holder, backend=None):
+        self.holder = holder
+        self.backend = backend if backend is not None else CPUBackend(holder)
+        # Cluster seam: replaced by the cluster layer to scatter shards to
+        # owning nodes (reference mapper :2522). Signature:
+        # (index, shards, call, map_fn, reduce_fn, opt) -> reduced value.
+        self.mapper: Optional[Callable] = None
+
+    # ------------------------------------------------------------------
+    # entry
+    # ------------------------------------------------------------------
+
+    def execute(
+        self,
+        index: str,
+        query: Union[str, Query],
+        shards: Optional[list[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> list[Any]:
+        opt = opt or ExecOptions()
+        if isinstance(query, str):
+            query = parse_string(query)
+        idx = self.holder.index(index)
+        if idx is None:
+            raise QueryError(f"index not found: {index}")
+        if opt.shards:
+            shards = list(opt.shards)
+
+        results = []
+        for call in query.calls:
+            self._translate_call(idx, call)
+            result = self.execute_call(index, call, shards, opt)
+            result = self._translate_result(idx, call, result)
+            results.append(result)
+        return results
+
+    # ------------------------------------------------------------------
+    # key translation (reference executor.go translateCalls :2615)
+    # ------------------------------------------------------------------
+
+    def _translate_call(self, idx, c: Call) -> None:
+        col_key, row_key, field_name = None, None, None
+        if c.name in ("Set", "Clear", "Row", "Range", "SetColumnAttrs", "ClearRow"):
+            col_key = "_col"
+            try:
+                field_name = c.field_arg()
+                row_key = field_name
+            except ValueError:
+                pass
+        elif c.name == "SetRowAttrs":
+            row_key = "_row"
+            field_name = c.args.get("_field")
+        elif c.name in ("Rows", "TopN"):
+            field_name = c.args.get("_field")
+            row_key = "previous"
+            # Rows(f, column="key") translates the column arg too
+            # (reference executor.go:2639-2642).
+            if c.name == "Rows":
+                col_key = "column"
+
+        if col_key and isinstance(c.args.get(col_key), str):
+            if not idx.options.keys or idx.translate_store is None:
+                raise QueryError(
+                    "string 'col' value not allowed unless index 'keys' option enabled"
+                )
+            c.args[col_key] = idx.translate_store.translate_key(c.args[col_key])
+
+        if field_name:
+            f = idx.field(field_name)
+            if f is not None and row_key and row_key in c.args:
+                val = c.args[row_key]
+                if f.options.type == FIELD_TYPE_BOOL and isinstance(val, bool):
+                    c.args[row_key] = 1 if val else 0
+                elif f.options.keys and isinstance(val, str):
+                    if f.translate_store is None:
+                        raise QueryError(f"field has no translate store: {field_name}")
+                    c.args[row_key] = f.translate_store.translate_key(val)
+                elif f.options.keys and not isinstance(val, (str, Condition)):
+                    raise QueryError(
+                        "row value must be a string when field 'keys' option enabled"
+                    )
+        for child in c.children:
+            self._translate_call(idx, child)
+
+    def _translate_result(self, idx, c: Call, result: Any) -> Any:
+        """ids -> keys on results (reference executor.go translateResults :2786)."""
+        if isinstance(result, Row) and idx.options.keys and idx.translate_store is not None:
+            cols = result.columns()
+            result.keys = [idx.translate_store.translate_id(int(v)) for v in cols.tolist()]
+        if isinstance(result, PairsField):
+            f = idx.field(result.field_name) if result.field_name else None
+            if f is not None and f.options.keys and f.translate_store is not None:
+                result.pairs = [
+                    Pair(id=p.id, count=p.count, key=f.translate_store.translate_id(p.id) or "")
+                    for p in result.pairs
+                ]
+        if isinstance(result, list) and result and isinstance(result[0], GroupCount):
+            for gc in result:
+                for fr in gc.group:
+                    f = idx.field(fr.field)
+                    if f is not None and f.options.keys and f.translate_store is not None:
+                        fr.row_key = f.translate_store.translate_id(fr.row_id) or ""
+        return result
+
+    # ------------------------------------------------------------------
+    # call dispatch (reference executor.go executeCall :274)
+    # ------------------------------------------------------------------
+
+    def execute_call(self, index: str, c: Call, shards: Optional[list[int]], opt: ExecOptions) -> Any:
+        handlers = {
+            "Sum": self._execute_sum,
+            "Min": self._execute_min,
+            "Max": self._execute_max,
+            "MinRow": self._execute_min_row,
+            "MaxRow": self._execute_max_row,
+            "Count": self._execute_count,
+            "TopN": self._execute_topn,
+            "Rows": self._execute_rows,
+            "GroupBy": self._execute_group_by,
+        }
+        if c.name in handlers:
+            return handlers[c.name](index, c, self._shards(index, shards), opt)
+        if c.name == "Clear":
+            return self._execute_clear(index, c, opt)
+        if c.name == "ClearRow":
+            return self._execute_clear_row(index, c, self._shards(index, shards), opt)
+        if c.name == "Store":
+            return self._execute_store(index, c, self._shards(index, shards), opt)
+        if c.name == "Set":
+            return self._execute_set(index, c, opt)
+        if c.name == "SetRowAttrs":
+            return self._execute_set_row_attrs(index, c, opt)
+        if c.name == "SetColumnAttrs":
+            return self._execute_set_column_attrs(index, c, opt)
+        if c.name == "Options":
+            return self._execute_options(index, c, shards, opt)
+        # default: bitmap call
+        return self._execute_bitmap_call(index, c, self._shards(index, shards), opt)
+
+    def _shards(self, index: str, shards: Optional[list[int]]) -> list[int]:
+        if shards is not None:
+            return shards
+        idx = self.holder.index(index)
+        out = idx.available_shards().to_array().tolist()
+        return out if out else [0]
+
+    # ------------------------------------------------------------------
+    # mapReduce (reference executor.go:2460; local form)
+    # ------------------------------------------------------------------
+
+    def map_reduce(self, index, shards, c, opt, map_fn, reduce_fn):
+        if self.mapper is not None and not opt.remote:
+            return self.mapper(index, shards, c, map_fn, reduce_fn, opt)
+        result = None
+        for shard in shards:
+            v = map_fn(shard)
+            result = v if result is None else reduce_fn(result, v)
+        return result
+
+    # ------------------------------------------------------------------
+    # bitmap calls
+    # ------------------------------------------------------------------
+
+    def _execute_bitmap_call(self, index, c, shards, opt) -> Row:
+        map_fn = lambda shard: self.backend.bitmap_call_shard(index, c, shard)
+
+        def reduce_fn(a, b):
+            a.merge(b)
+            return a
+
+        result = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn)
+        row = result if result is not None else Row()
+        # Attach row attributes at the coordinator (reference
+        # executor.go:348-380 executeBitmapCall attrs handling).
+        if c.name in ("Row", "Range") and not opt.exclude_row_attrs and not opt.remote:
+            try:
+                field_name = c.field_arg()
+            except ValueError:
+                field_name = None
+            if field_name is not None and not isinstance(c.args.get(field_name), Condition):
+                idx = self.holder.index(index)
+                f = idx.field(field_name) if idx else None
+                row_id, ok = c.uint64_arg(field_name)
+                if f is not None and ok and f.row_attr_store is not None:
+                    row.attrs = f.row_attr_store.attrs(row_id)
+        return row
+
+    # ------------------------------------------------------------------
+    # aggregates
+    # ------------------------------------------------------------------
+
+    def _filter_row_shard(self, index, c, shard) -> Optional[Row]:
+        if not c.children:
+            return None
+        return self.backend.bitmap_call_shard(index, c.children[0], shard)
+
+    def _execute_count(self, index, c, shards, opt) -> int:
+        if len(c.children) != 1:
+            raise QueryError("Count() only accepts a single bitmap input")
+        map_fn = lambda shard: self.backend.count_shard(index, c.children[0], shard)
+        result = self.map_reduce(index, shards, c, opt, map_fn, lambda a, b: a + b)
+        return int(result or 0)
+
+    def _agg_field(self, index, c):
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            try:
+                field_name = c.field_arg()
+            except ValueError:
+                raise QueryError("field required")
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx else None
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+        return f
+
+    def _execute_sum(self, index, c, shards, opt) -> ValCount:
+        """reference executor.go executeSum :406."""
+        f = self._agg_field(index, c)
+        if len(c.children) > 1:
+            raise QueryError("Sum() only accepts a single bitmap input")
+
+        def map_fn(shard):
+            filt = self._filter_row_shard(index, c, shard)
+            s, cnt = f.sum(filt, shard)
+            return ValCount(s, cnt)
+
+        def reduce_fn(a, b):
+            return ValCount(a.val + b.val, a.count + b.count)
+
+        out = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or ValCount()
+        if out.count == 0:
+            return ValCount()
+        return out
+
+    def _execute_min(self, index, c, shards, opt) -> ValCount:
+        f = self._agg_field(index, c)
+        if len(c.children) > 1:
+            raise QueryError("Min() only accepts a single bitmap input")
+
+        def map_fn(shard):
+            filt = self._filter_row_shard(index, c, shard)
+            v, cnt = f.min(filt, shard)
+            return ValCount(v, cnt)
+
+        def reduce_fn(a, b):
+            if a.count == 0:
+                return b
+            if b.count == 0:
+                return a
+            if a.val < b.val:
+                return a
+            if b.val < a.val:
+                return b
+            return ValCount(a.val, a.count + b.count)
+
+        return self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or ValCount()
+
+    def _execute_max(self, index, c, shards, opt) -> ValCount:
+        f = self._agg_field(index, c)
+        if len(c.children) > 1:
+            raise QueryError("Max() only accepts a single bitmap input")
+
+        def map_fn(shard):
+            filt = self._filter_row_shard(index, c, shard)
+            v, cnt = f.max(filt, shard)
+            return ValCount(v, cnt)
+
+        def reduce_fn(a, b):
+            if a.count == 0:
+                return b
+            if b.count == 0:
+                return a
+            if a.val > b.val:
+                return a
+            if b.val > a.val:
+                return b
+            return ValCount(a.val, a.count + b.count)
+
+        return self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or ValCount()
+
+    def _minmax_row_fragments(self, index, c, shard):
+        field_name = c.args.get("_field") or c.args.get("field")
+        if not field_name:
+            raise QueryError("MinRow/MaxRow requires field")
+        idx = self.holder.index(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+        v = f.view(VIEW_STANDARD)
+        return v.fragment(shard) if v is not None else None
+
+    def _execute_min_row(self, index, c, shards, opt) -> PairField:
+        def map_fn(shard):
+            frag = self._minmax_row_fragments(index, c, shard)
+            if frag is None:
+                return PairField(Pair(0, 0), str(c.args.get("_field") or c.args.get("field") or ""))
+            filt = self._filter_row_shard(index, c, shard)
+            row_id, cnt = frag.min_row(filt)
+            return PairField(Pair(row_id, cnt), str(c.args.get("_field") or c.args.get("field") or ""))
+
+        def reduce_fn(a, b):
+            if a.pair.count == 0:
+                return b
+            if b.pair.count == 0:
+                return a
+            if a.pair.id < b.pair.id:
+                return a
+            if b.pair.id < a.pair.id:
+                return b
+            return PairField(Pair(a.pair.id, a.pair.count + b.pair.count), a.field_name)
+
+        return self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or PairField(
+            Pair(0, 0), str(c.args.get("_field") or c.args.get("field") or "")
+        )
+
+    def _execute_max_row(self, index, c, shards, opt) -> PairField:
+        def map_fn(shard):
+            frag = self._minmax_row_fragments(index, c, shard)
+            if frag is None:
+                return PairField(Pair(0, 0), str(c.args.get("_field") or c.args.get("field") or ""))
+            filt = self._filter_row_shard(index, c, shard)
+            row_id, cnt = frag.max_row(filt)
+            return PairField(Pair(row_id, cnt), str(c.args.get("_field") or c.args.get("field") or ""))
+
+        def reduce_fn(a, b):
+            if a.pair.count == 0:
+                return b
+            if b.pair.count == 0:
+                return a
+            if a.pair.id > b.pair.id:
+                return a
+            if b.pair.id > a.pair.id:
+                return b
+            return PairField(Pair(a.pair.id, a.pair.count + b.pair.count), a.field_name)
+
+        return self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or PairField(
+            Pair(0, 0), str(c.args.get("_field") or c.args.get("field") or "")
+        )
+
+    # ------------------------------------------------------------------
+    # TopN (two-pass, reference executor.go:860-997)
+    # ------------------------------------------------------------------
+
+    def _execute_topn(self, index, c, shards, opt) -> PairsField:
+        field_name = c.args.get("_field")
+        if not field_name:
+            raise QueryError("TopN() field required")
+        n, _ = c.uint64_arg("n")
+
+        # Pass 1: approximate candidates from rank caches.
+        pairs = self._execute_topn_shards(index, c, shards, opt)
+
+        # Pass 2: exact recount of candidate ids (coordinator only).
+        if n and not opt.remote and pairs.pairs:
+            ids = [p.id for p in pairs.pairs]
+            other = c.clone()
+            other.args["ids"] = ids
+            pairs = self._execute_topn_shards(index, other, shards, opt)
+        pairs.pairs = top_n_pairs(pairs.pairs, n)
+        return pairs
+
+    def _execute_topn_shards(self, index, c, shards, opt) -> PairsField:
+        field_name = c.args["_field"]
+        n, _ = c.uint64_arg("n")
+        ids, _ = c.uint64_slice_arg("ids")
+        threshold, _ = c.uint64_arg("threshold")
+        tanimoto, _ = c.uint64_arg("tanimotoThreshold")
+
+        def map_fn(shard):
+            idx = self.holder.index(index)
+            f = idx.field(field_name)
+            if f is None:
+                raise QueryError(f"field not found: {field_name}")
+            src = self._filter_row_shard(index, c, shard)
+            # With explicit ids (pass 2) or a src filter, never trim per
+            # shard — a local top-n would drop cross-shard count
+            # contributions before the merge (reference fragment.go:1574
+            # forces N=0 when RowIDs are given).
+            return f.top(
+                shard,
+                n=n if (src is None and not ids) else 0,
+                src=src,
+                row_ids=ids if ids else None,
+                min_threshold=threshold,
+                tanimoto_threshold=tanimoto,
+            )
+
+        def reduce_fn(a, b):
+            return add_pairs(a, b)
+
+        merged = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
+        return PairsField(top_n_pairs(merged, 0), field_name)
+
+    # ------------------------------------------------------------------
+    # Rows (reference executor.go:1274)
+    # ------------------------------------------------------------------
+
+    def _execute_rows(self, index, c, shards, opt) -> RowIDs:
+        field_name = c.args.get("field") or c.args.get("_field")
+        if not field_name:
+            raise QueryError("Rows() field required")
+        col, has_col = c.uint64_arg("column")
+        if has_col:
+            shards = [col // SHARD_WIDTH]
+        limit = MAX_INT
+        lim, has_lim = c.uint64_arg("limit")
+        if has_lim:
+            limit = lim
+
+        map_fn = lambda shard: self._execute_rows_shard(index, field_name, c, shard)
+
+        def reduce_fn(a, b):
+            return a.merge(b, limit)
+
+        return self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or RowIDs()
+
+    def _execute_rows_shard(self, index, field_name, c, shard) -> RowIDs:
+        idx = self.holder.index(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+        views = [VIEW_STANDARD]
+        if f.options.type == FIELD_TYPE_TIME:
+            from_t = parse_time(c.args["from"]) if "from" in c.args else None
+            to_t = parse_time(c.args["to"]) if "to" in c.args else None
+            if from_t is not None or to_t is not None:
+                from_t = from_t or dt.datetime(1, 1, 1)
+                to_t = to_t or (dt.datetime.utcnow() + dt.timedelta(days=1))
+                views = views_by_time_range(
+                    VIEW_STANDARD, from_t, to_t, f.options.time_quantum
+                )
+
+        start = 0
+        prev, has_prev = c.uint64_arg("previous")
+        if has_prev:
+            start = prev + 1
+        col, has_col = c.uint64_arg("column")
+        limit, has_lim = c.uint64_arg("limit")
+
+        out: set[int] = set()
+        for vname in views:
+            v = f.view(vname)
+            if v is None:
+                continue
+            frag = v.fragment(shard)
+            if frag is None:
+                continue
+            out.update(
+                frag.rows(column=col if has_col else None, start_row=start, limit=0)
+            )
+        ids = sorted(out)
+        if has_lim:
+            ids = ids[:limit]
+        return RowIDs(ids)
+
+    # ------------------------------------------------------------------
+    # GroupBy (reference executor.go:1068)
+    # ------------------------------------------------------------------
+
+    def _execute_group_by(self, index, c, shards, opt) -> list[GroupCount]:
+        if not c.children:
+            raise QueryError("need at least one child call")
+        limit = MAX_INT
+        lim, has_lim = c.uint64_arg("limit")
+        if has_lim:
+            limit = lim
+        filter_call = c.args.get("filter")
+        if filter_call is not None and not isinstance(filter_call, Call):
+            raise QueryError("filter must be a call")
+
+        # Pre-compute cluster-wide Rows results for children with limit or
+        # column args (reference executor.go:1085-1117).
+        child_rows: list[Optional[RowIDs]] = [None] * len(c.children)
+        for i, child in enumerate(c.children):
+            if child.name != "Rows":
+                raise QueryError(
+                    f"'{child.name}' is not a valid child query for GroupBy, must be 'Rows'"
+                )
+            _, has_l = child.uint64_arg("limit")
+            _, has_c = child.uint64_arg("column")
+            if has_l or has_c:
+                child_rows[i] = self._execute_rows(index, child, shards, opt)
+                if not child_rows[i]:
+                    return []
+
+        map_fn = lambda shard: self._execute_group_by_shard(
+            index, c, filter_call, shard, child_rows
+        )
+
+        def reduce_fn(a, b):
+            return merge_group_counts(a, b, limit)
+
+        results = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
+
+        offset, has_off = c.uint64_arg("offset")
+        if has_off and offset < len(results):
+            results = results[offset:]
+        if has_lim and limit < len(results):
+            results = results[:limit]
+        return results
+
+    def _execute_group_by_shard(self, index, c, filter_call, shard, child_rows) -> list[GroupCount]:
+        filter_row = None
+        if filter_call is not None:
+            filter_row = self.backend.bitmap_call_shard(index, filter_call, shard)
+
+        # Per-child candidate (field, row_id, bitmap) lists.
+        fields = []
+        per_child: list[list[tuple[int, Row]]] = []
+        for i, child in enumerate(c.children):
+            field_name = child.args.get("field") or child.args.get("_field")
+            fields.append(field_name)
+            if child_rows[i] is not None:
+                ids = list(child_rows[i])
+            else:
+                ids = list(self._execute_rows_shard(index, field_name, child, shard))
+            rows = []
+            for rid in ids:
+                idx = self.holder.index(index)
+                f = idx.field(field_name)
+                row = f.row(rid, shard)
+                rows.append((rid, row))
+            per_child.append(rows)
+
+        out: list[GroupCount] = []
+
+        def recurse(i: int, acc: Optional[Row], group: list[FieldRow]):
+            if i == len(per_child):
+                cnt = acc.count() if acc is not None else 0
+                if cnt > 0:
+                    out.append(GroupCount(list(group), cnt))
+                return
+            for rid, row in per_child[i]:
+                nxt = row if acc is None else acc.intersect(row)
+                if i > 0 or acc is not None:
+                    if not nxt.any():
+                        continue
+                group.append(FieldRow(fields[i], rid))
+                recurse(i + 1, nxt, group)
+                group.pop()
+
+        base = filter_row
+        recurse(0, base, [])
+        return out
+
+    # ------------------------------------------------------------------
+    # writes (reference executor.go:1825-2417)
+    # ------------------------------------------------------------------
+
+    def _execute_set(self, index, c, opt) -> bool:
+        col_id, ok = c.uint64_arg("_col")
+        if not ok:
+            raise QueryError("Set() column argument 'col' required")
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+
+        # Track column existence (reference executor.go:2101-2106).
+        ef = idx.existence_field()
+        if ef is not None:
+            ef.set_bit(0, col_id)
+
+        if f.options.type == FIELD_TYPE_INT:
+            val, ok = c.int_arg(field_name)
+            if not ok:
+                raise QueryError("Set() row argument required")
+            return f.set_value(col_id, val)
+
+        row_id, ok = c.uint64_arg(field_name)
+        if not ok:
+            raise QueryError("Set() row argument required")
+        timestamp = None
+        ts = c.args.get("_timestamp")
+        if isinstance(ts, str):
+            timestamp = parse_time(ts)
+        return f.set_bit(row_id, col_id, timestamp)
+
+    def _execute_clear(self, index, c, opt) -> bool:
+        col_id, ok = c.uint64_arg("_col")
+        if not ok:
+            raise QueryError("Clear() column argument 'col' required")
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+        if f.options.type == FIELD_TYPE_INT:
+            frag = f._bsi_fragment(col_id // SHARD_WIDTH)
+            if frag is None:
+                return False
+            return frag.clear_value(col_id, f.options.bit_depth)
+        row_id, ok = c.uint64_arg(field_name)
+        if not ok:
+            raise QueryError("Clear() row argument required")
+        return f.clear_bit(row_id, col_id)
+
+    def _execute_clear_row(self, index, c, shards, opt) -> bool:
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+        if f.options.type not in ("set", "time", "mutex", "bool"):
+            raise QueryError(f"ClearRow() is not supported on {f.options.type} fields")
+        row_id, ok = c.uint64_arg(field_name)
+        if not ok:
+            raise QueryError("ClearRow() row argument required")
+
+        def map_fn(shard):
+            changed = False
+            for vname, v in list(f.views.items()):
+                frag = v.fragment(shard)
+                if frag is not None:
+                    changed = frag.clear_row(row_id) or changed
+            return changed
+
+        return bool(self.map_reduce(index, shards, c, opt, map_fn, lambda a, b: a or b))
+
+    def _execute_store(self, index, c, shards, opt) -> bool:
+        """Store(child, f=row): overwrite row with child's result
+        (reference executeSetRow :2303)."""
+        if len(c.children) != 1:
+            raise QueryError("Store() requires a single row input")
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        f = idx.create_field_if_not_exists(field_name)
+        if f.options.type != "set":
+            raise QueryError("Store() currently only supports set fields")
+        row_id, ok = c.uint64_arg(field_name)
+        if not ok:
+            raise QueryError("Store() row argument required")
+
+        def map_fn(shard):
+            row = self.backend.bitmap_call_shard(index, c.children[0], shard)
+            frag = f.create_view_if_not_exists(VIEW_STANDARD).create_fragment_if_not_exists(shard)
+            f.add_available_shard(shard)
+            return frag.set_row(row, row_id)
+
+        return bool(self.map_reduce(index, shards, c, opt, map_fn, lambda a, b: a or b))
+
+    def _execute_set_row_attrs(self, index, c, opt) -> None:
+        field_name = c.args.get("_field")
+        idx = self.holder.index(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+        row_id, ok = c.uint64_arg("_row")
+        if not ok:
+            raise QueryError("SetRowAttrs() row argument required")
+        attrs = {k: v for k, v in c.args.items() if not is_reserved_arg(k)}
+        f.row_attr_store.set_attrs(row_id, attrs)
+        return None
+
+    def _execute_set_column_attrs(self, index, c, opt) -> None:
+        idx = self.holder.index(index)
+        col_id, ok = c.uint64_arg("_col")
+        if not ok:
+            raise QueryError("SetColumnAttrs() column argument required")
+        attrs = {k: v for k, v in c.args.items() if not is_reserved_arg(k)}
+        idx.column_attr_store.set_attrs(col_id, attrs)
+        return None
+
+    # ------------------------------------------------------------------
+    # Options (reference executeOptionsCall)
+    # ------------------------------------------------------------------
+
+    def _execute_options(self, index, c, shards, opt) -> Any:
+        if len(c.children) != 1:
+            raise QueryError("Options() requires a single child call")
+        import copy
+
+        new_opt = copy.copy(opt)
+        for k, v in c.args.items():
+            if k == "columnAttrs":
+                new_opt.column_attrs = bool(v)
+            elif k == "excludeRowAttrs":
+                new_opt.exclude_row_attrs = bool(v)
+            elif k == "excludeColumns":
+                new_opt.exclude_columns = bool(v)
+            elif k == "shards":
+                if not isinstance(v, list):
+                    raise QueryError("Options() shards must be a list")
+                new_opt.shards = [int(s) for s in v]
+            elif k == "profile":
+                new_opt.profile = bool(v)
+            else:
+                raise QueryError(f"Unknown Options() argument: {k}")
+        if new_opt.shards:
+            shards = new_opt.shards
+        return self.execute_call(index, c.children[0], shards, new_opt)

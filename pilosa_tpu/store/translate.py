@@ -1,0 +1,92 @@
+"""Key translation store: string key <-> uint64 id (reference translate.go:35).
+
+Monotonic id assignment starting at 1, sqlite3-backed (reference uses an
+in-memory store + BoltDB impl, translate.go:195, boltdb/translate.go:48).
+Replication to read-only replicas is handled at the cluster layer by
+shipping new entries (reference EntryReader streaming, translate.go:60);
+here the store exposes entries_since() for that purpose.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Optional
+
+
+class TranslateStoreReadOnlyError(Exception):
+    """Writes must go to the primary (reference ErrTranslateStoreReadOnly)."""
+
+
+class TranslateStore:
+    def __init__(self, path: Optional[str] = None, read_only: bool = False):
+        self.path = path
+        self.read_only = read_only
+        self._lock = threading.RLock()
+        if path is not None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        # Single shared connection + lock (per-thread ':memory:' connections
+        # would each see a private empty database).
+        self._db = sqlite3.connect(path or ":memory:", check_same_thread=False)
+        with self._lock:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS keys ("
+                "seq INTEGER PRIMARY KEY AUTOINCREMENT, key TEXT UNIQUE)"
+            )
+            self._db.commit()
+
+    def translate_key(self, key: str, write: bool = True) -> Optional[int]:
+        """Key -> id, assigning a new id when missing (reference
+        TranslateStore.TranslateColumnsToUint64)."""
+        with self._lock:
+            cur = self._db.execute("SELECT seq FROM keys WHERE key=?", (key,))
+            row = cur.fetchone()
+            if row is not None:
+                return row[0]
+            if not write:
+                return None
+            if self.read_only:
+                raise TranslateStoreReadOnlyError(key)
+            self._db.execute("INSERT OR IGNORE INTO keys (key) VALUES (?)", (key,))
+            self._db.commit()
+            cur = self._db.execute("SELECT seq FROM keys WHERE key=?", (key,))
+            return cur.fetchone()[0]
+
+    def translate_keys(self, keys: list[str], write: bool = True) -> list[Optional[int]]:
+        return [self.translate_key(k, write=write) for k in keys]
+
+    def translate_id(self, id_: int) -> Optional[str]:
+        with self._lock:
+            cur = self._db.execute("SELECT key FROM keys WHERE seq=?", (id_,))
+            row = cur.fetchone()
+        return row[0] if row else None
+
+    def translate_ids(self, ids: list[int]) -> list[Optional[str]]:
+        return [self.translate_id(i) for i in ids]
+
+    def max_id(self) -> int:
+        with self._lock:
+            cur = self._db.execute("SELECT MAX(seq) FROM keys")
+            row = cur.fetchone()
+        return row[0] or 0
+
+    def entries_since(self, seq: int) -> list[tuple[int, str]]:
+        """New (id, key) entries after seq — the replication stream
+        (reference translate.go EntryReader)."""
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT seq, key FROM keys WHERE seq > ? ORDER BY seq", (seq,)
+            ).fetchall()
+        return list(cur)
+
+    def apply_entries(self, entries: list[tuple[int, str]]) -> None:
+        """Replica side: apply a replication batch preserving ids."""
+        with self._lock:
+            for seq, key in entries:
+                self._db.execute("INSERT OR IGNORE INTO keys (seq, key) VALUES (?, ?)", (seq, key))
+            self._db.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

@@ -1,0 +1,412 @@
+"""Executor tests — the PQL op coverage mirrors the reference's
+executor_test.go (every op, keyed variants, existence, GroupBy)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.core.field import (
+    options_for_bool,
+    options_for_int,
+    options_for_mutex,
+    options_for_time,
+)
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.cpu import QueryError
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data")).open()
+    yield h
+    h.close()
+
+
+@pytest.fixture
+def ex(holder):
+    return Executor(holder)
+
+
+def setup_basic(ex):
+    idx = ex.holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    ex.execute("i", "Set(10, f=1) Set(100, f=1) Set(10, g=2)")
+    ex.execute("i", f"Set({SHARD_WIDTH * 2 + 7}, f=1)")  # shard 2
+    return ex
+
+
+class TestBitmapCalls:
+    def test_row(self, ex):
+        setup_basic(ex)
+        (row,) = ex.execute("i", "Row(f=1)")
+        assert row.columns().tolist() == [10, 100, SHARD_WIDTH * 2 + 7]
+
+    def test_intersect_union_difference_xor(self, ex):
+        setup_basic(ex)
+        (r,) = ex.execute("i", "Intersect(Row(f=1), Row(g=2))")
+        assert r.columns().tolist() == [10]
+        (r,) = ex.execute("i", "Union(Row(f=1), Row(g=2))")
+        assert r.columns().tolist() == [10, 100, SHARD_WIDTH * 2 + 7]
+        (r,) = ex.execute("i", "Difference(Row(f=1), Row(g=2))")
+        assert r.columns().tolist() == [100, SHARD_WIDTH * 2 + 7]
+        (r,) = ex.execute("i", "Xor(Row(f=1), Row(g=2))")
+        assert r.columns().tolist() == [100, SHARD_WIDTH * 2 + 7]
+
+    def test_count(self, ex):
+        setup_basic(ex)
+        assert ex.execute("i", "Count(Row(f=1))") == [3]
+        assert ex.execute("i", "Count(Intersect(Row(f=1), Row(g=2)))") == [1]
+
+    def test_not_uses_existence(self, ex):
+        setup_basic(ex)
+        (r,) = ex.execute("i", "Not(Row(f=1))")
+        # existence = {10, 100, shard2+7}; Not(f=1) = existence - row = {}
+        assert r.columns().tolist() == []
+        (r,) = ex.execute("i", "Not(Row(g=2))")
+        assert r.columns().tolist() == [100, SHARD_WIDTH * 2 + 7]
+
+    def test_not_without_existence_errors(self, holder):
+        idx = holder.create_index("noex", IndexOptions(track_existence=False))
+        idx.create_field("f")
+        ex = Executor(holder)
+        ex.execute("noex", "Set(1, f=1)")
+        with pytest.raises(QueryError, match="existence"):
+            ex.execute("noex", "Not(Row(f=1))")
+
+    def test_all(self, ex):
+        setup_basic(ex)
+        (r,) = ex.execute("i", "All()")
+        assert r.columns().tolist() == [10, 100, SHARD_WIDTH * 2 + 7]
+
+    def test_shift(self, ex):
+        setup_basic(ex)
+        (r,) = ex.execute("i", "Shift(Row(g=2), n=1)")
+        assert r.columns().tolist() == [11]
+
+    def test_set_returns_changed(self, ex):
+        ex.holder.create_index("i").create_field("f")
+        assert ex.execute("i", "Set(1, f=1)") == [True]
+        assert ex.execute("i", "Set(1, f=1)") == [False]
+
+    def test_clear(self, ex):
+        setup_basic(ex)
+        assert ex.execute("i", "Clear(10, f=1)") == [True]
+        assert ex.execute("i", "Clear(10, f=1)") == [False]
+        (r,) = ex.execute("i", "Row(f=1)")
+        assert r.columns().tolist() == [100, SHARD_WIDTH * 2 + 7]
+
+    def test_clear_row(self, ex):
+        setup_basic(ex)
+        assert ex.execute("i", "ClearRow(f=1)") == [True]
+        assert ex.execute("i", "Count(Row(f=1))") == [0]
+        # g untouched
+        assert ex.execute("i", "Count(Row(g=2))") == [1]
+
+    def test_store(self, ex):
+        setup_basic(ex)
+        assert ex.execute("i", "Store(Row(f=1), stored=9)") == [True]
+        (r,) = ex.execute("i", "Row(stored=9)")
+        assert r.columns().tolist() == [10, 100, SHARD_WIDTH * 2 + 7]
+
+
+class TestRowTimeRange:
+    def test_range_query(self, holder):
+        idx = holder.create_index("t")
+        idx.create_field("f", options_for_time("YMDH"))
+        ex = Executor(holder)
+        ex.execute("t", 'Set(2, f=1, 2018-01-01T00:00)')
+        ex.execute("t", 'Set(3, f=1, 2018-03-05T12:00)')
+        ex.execute("t", 'Set(4, f=1, 2019-06-01T00:00)')
+        (r,) = ex.execute("t", "Range(f=1, 2018-01-01T00:00, 2019-01-01T00:00)")
+        assert r.columns().tolist() == [2, 3]
+        (r,) = ex.execute("t", "Row(f=1, from=2018-03-01T00:00, to=2019-07-01T00:00)")
+        assert r.columns().tolist() == [3, 4]
+        # plain Row returns standard view (all)
+        (r,) = ex.execute("t", "Row(f=1)")
+        assert r.columns().tolist() == [2, 3, 4]
+
+
+class TestBSI:
+    def setup_bsi(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("v", options_for_int(-1000, 1000))
+        idx.create_field("f")
+        ex = Executor(holder)
+        for col, val in [(1, 100), (2, -300), (3, 500), (4, 500), (5, 0)]:
+            ex.execute("i", f"Set({col}, v={val})")
+        return ex
+
+    def test_sum_min_max(self, holder):
+        ex = self.setup_bsi(holder)
+        (vc,) = ex.execute("i", "Sum(field=v)")
+        assert (vc.val, vc.count) == (800, 5)
+        (vc,) = ex.execute("i", "Min(field=v)")
+        assert (vc.val, vc.count) == (-300, 1)
+        (vc,) = ex.execute("i", "Max(field=v)")
+        assert (vc.val, vc.count) == (500, 2)
+
+    def test_sum_with_filter(self, holder):
+        ex = self.setup_bsi(holder)
+        ex.execute("i", "Set(1, f=1) Set(3, f=1)")
+        (vc,) = ex.execute("i", "Sum(Row(f=1), field=v)")
+        assert (vc.val, vc.count) == (600, 2)
+
+    def test_range_conditions(self, holder):
+        ex = self.setup_bsi(holder)
+        cases = [
+            ("Row(v > 100)", [3, 4]),
+            ("Row(v >= 100)", [1, 3, 4]),
+            ("Row(v < 0)", [2]),
+            ("Row(v <= 0)", [2, 5]),
+            ("Row(v == 500)", [3, 4]),
+            ("Row(v != 500)", [1, 2, 5]),
+            ("Row(v >< [0, 200])", [1, 5]),
+            ("Row(-300 <= v <= 100)", [1, 2, 5]),
+            ("Row(v != null)", [1, 2, 3, 4, 5]),
+        ]
+        for q, want in cases:
+            (r,) = ex.execute("i", q)
+            assert r.columns().tolist() == want, q
+
+    def test_out_of_range_conditions(self, holder):
+        ex = self.setup_bsi(holder)
+        (r,) = ex.execute("i", "Row(v > 100000)")
+        assert r.columns().tolist() == []
+        (r,) = ex.execute("i", "Row(v < 100000)")  # encompasses all -> notNull
+        assert r.columns().tolist() == [1, 2, 3, 4, 5]
+
+
+class TestTopN:
+    def test_topn_basic(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        ex = Executor(holder)
+        # row 1: 4 bits; row 2: 2 bits; row 3: 1 bit, spanning shards
+        for col in [0, 1, 2, SHARD_WIDTH + 1]:
+            ex.execute("i", f"Set({col}, f=1)")
+        for col in [0, SHARD_WIDTH + 2]:
+            ex.execute("i", f"Set({col}, f=2)")
+        ex.execute("i", "Set(5, f=3)")
+        (res,) = ex.execute("i", "TopN(f, n=2)")
+        assert [(p.id, p.count) for p in res.pairs] == [(1, 4), (2, 2)]
+        (res,) = ex.execute("i", "TopN(f)")
+        assert [(p.id, p.count) for p in res.pairs] == [(1, 4), (2, 2), (3, 1)]
+
+    def test_topn_with_src(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        ex = Executor(holder)
+        for col in [0, 1, 2]:
+            ex.execute("i", f"Set({col}, f=1)")
+        ex.execute("i", "Set(1, f=2)")
+        ex.execute("i", "Set(0, g=9) Set(1, g=9)")
+        (res,) = ex.execute("i", "TopN(f, Row(g=9), n=5)")
+        assert [(p.id, p.count) for p in res.pairs] == [(1, 2), (2, 1)]
+
+
+class TestRowsAndGroupBy:
+    def setup_rows(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("a")
+        idx.create_field("b")
+        ex = Executor(holder)
+        ex.execute("i", "Set(0, a=1) Set(1, a=1) Set(1, a=2) Set(2, a=3)")
+        ex.execute("i", "Set(0, b=10) Set(1, b=10) Set(2, b=20)")
+        return ex
+
+    def test_rows(self, holder):
+        ex = self.setup_rows(holder)
+        assert list(ex.execute("i", "Rows(a)")[0]) == [1, 2, 3]
+        assert list(ex.execute("i", "Rows(a, limit=2)")[0]) == [1, 2]
+        assert list(ex.execute("i", "Rows(a, previous=1)")[0]) == [2, 3]
+        assert list(ex.execute("i", "Rows(a, column=1)")[0]) == [1, 2]
+
+    def test_group_by(self, holder):
+        ex = self.setup_rows(holder)
+        (res,) = ex.execute("i", "GroupBy(Rows(a), Rows(b))")
+        got = [([fr.row_id for fr in gc.group], gc.count) for gc in res]
+        assert got == [
+            ([1, 10], 2),
+            ([2, 10], 1),
+            ([3, 20], 1),
+        ]
+
+    def test_group_by_filter(self, holder):
+        ex = self.setup_rows(holder)
+        (res,) = ex.execute("i", "GroupBy(Rows(a), filter=Row(b=10))")
+        got = [([fr.row_id for fr in gc.group], gc.count) for gc in res]
+        assert got == [([1], 2), ([2], 1)]
+
+    def test_group_by_limit(self, holder):
+        ex = self.setup_rows(holder)
+        (res,) = ex.execute("i", "GroupBy(Rows(a), Rows(b), limit=2)")
+        assert len(res) == 2
+
+
+class TestMinMaxRow:
+    def test_min_max_row(self, holder):
+        holder.create_index("i").create_field("f")
+        ex = Executor(holder)
+        ex.execute("i", "Set(0, f=3) Set(1, f=7) Set(2, f=7)")
+        (res,) = ex.execute("i", "MinRow(field=f)")
+        assert (res.pair.id, res.pair.count) == (3, 1)
+        (res,) = ex.execute("i", "MaxRow(field=f)")
+        assert (res.pair.id, res.pair.count) == (7, 1)
+
+
+class TestFieldTypes:
+    def test_bool_field(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("b", options_for_bool())
+        ex = Executor(holder)
+        ex.execute("i", "Set(1, b=true) Set(2, b=false) Set(3, b=true)")
+        (r,) = ex.execute("i", "Row(b=true)")
+        assert r.columns().tolist() == [1, 3]
+        (r,) = ex.execute("i", "Row(b=false)")
+        assert r.columns().tolist() == [2]
+        # flip
+        ex.execute("i", "Set(1, b=false)")
+        (r,) = ex.execute("i", "Row(b=true)")
+        assert r.columns().tolist() == [3]
+
+    def test_mutex_field(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("m", options_for_mutex())
+        ex = Executor(holder)
+        ex.execute("i", "Set(1, m=10) Set(1, m=20)")
+        (r,) = ex.execute("i", "Row(m=10)")
+        assert r.columns().tolist() == []
+        (r,) = ex.execute("i", "Row(m=20)")
+        assert r.columns().tolist() == [1]
+
+
+class TestKeys:
+    def test_keyed_index_and_field(self, holder):
+        idx = holder.create_index("k", IndexOptions(keys=True))
+        from pilosa_tpu.core.field import FieldOptions
+
+        idx.create_field("f", FieldOptions(keys=True))
+        ex = Executor(holder)
+        ex.execute("k", 'Set("alpha", f="one") Set("beta", f="one")')
+        (r,) = ex.execute("k", 'Row(f="one")')
+        assert sorted(r.keys) == ["alpha", "beta"]
+        (res,) = ex.execute("k", 'TopN(f, n=5)')
+        assert [(p.key, p.count) for p in res.pairs] == [("one", 2)]
+
+    def test_unkeyed_rejects_strings(self, holder):
+        holder.create_index("u")
+        ex = Executor(holder)
+        with pytest.raises(QueryError, match="keys"):
+            ex.execute("u", 'Set("alpha", f=1)')
+
+
+class TestAttrs:
+    def test_row_attrs(self, holder):
+        holder.create_index("i").create_field("f")
+        ex = Executor(holder)
+        ex.execute("i", "Set(1, f=7)")
+        ex.execute("i", 'SetRowAttrs(f, 7, color="blue", weight=3)')
+        (r,) = ex.execute("i", "Row(f=7)")
+        assert r.attrs == {"color": "blue", "weight": 3}
+
+    def test_column_attrs(self, holder):
+        idx = holder.create_index("i")
+        ex = Executor(holder)
+        ex.execute("i", 'SetColumnAttrs(9, happy=true)')
+        assert idx.column_attr_store.attrs(9) == {"happy": True}
+
+
+class TestOptions:
+    def test_shards_option(self, ex):
+        setup_basic(ex)
+        (r,) = ex.execute("i", "Options(Row(f=1), shards=[0])")
+        assert r.columns().tolist() == [10, 100]
+
+    def test_exclude_row_attrs(self, ex):
+        setup_basic(ex)
+        ex.execute("i", 'SetRowAttrs(f, 1, x=1)')
+        (r,) = ex.execute("i", "Options(Row(f=1), excludeRowAttrs=true)")
+        assert r.attrs == {}
+
+
+class TestMultiOps:
+    def test_write_then_read_same_query(self, ex):
+        ex.holder.create_index("i").create_field("f")
+        results = ex.execute("i", "Set(1, f=1) Count(Row(f=1))")
+        assert results == [True, 1]
+
+
+class TestReviewRegressions:
+    """Regression tests for review findings (cross-shard TopN recount,
+    negative-predicate BSI routing, keyed Rows column, threaded stores,
+    Shift identity)."""
+
+    def test_topn_cross_shard_recount(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("t")
+        ex = Executor(holder)
+        # row 10: 10 bits all in shard 0; row 20: 6 + 6 across shards = 12.
+        for col in range(10):
+            ex.execute("i", f"Set({col}, t=10)")
+        for col in range(6):
+            ex.execute("i", f"Set({100 + col}, t=20)")
+            ex.execute("i", f"Set({SHARD_WIDTH + col}, t=20)")
+        (res,) = ex.execute("i", "TopN(t, n=1)")
+        assert [(p.id, p.count) for p in res.pairs] == [(20, 12)]
+
+    def test_bsi_negative_predicate_routing(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("v", options_for_int(-10, 10))
+        ex = Executor(holder)
+        for col, val in [(1, -2), (2, -1), (3, 0), (4, 1)]:
+            ex.execute("i", f"Set({col}, v={val})")
+        cases = [
+            ("Row(v < 0)", [1, 2]),
+            ("Row(v < -1)", [1]),
+            ("Row(v <= -1)", [1, 2]),
+            ("Row(v > -1)", [3, 4]),
+            ("Row(v >= -1)", [2, 3, 4]),
+            ("Row(v > -2)", [2, 3, 4]),
+        ]
+        for q, want in cases:
+            (r,) = ex.execute("i", q)
+            assert r.columns().tolist() == want, q
+
+    def test_rows_column_keyed(self, holder):
+        from pilosa_tpu.core.field import FieldOptions
+
+        idx = holder.create_index("k", IndexOptions(keys=True))
+        idx.create_field("f", FieldOptions(keys=True))
+        ex = Executor(holder)
+        ex.execute("k", 'Set("alice", f="red") Set("bob", f="blue")')
+        (rows,) = ex.execute("k", 'Rows(f, column="alice")')
+        assert len(rows) == 1
+
+    def test_attr_store_cross_thread(self, holder):
+        import threading
+
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.fields["f"].row_attr_store.set_attrs(1, {"x": 1})
+        seen = {}
+
+        def reader():
+            seen["attrs"] = idx.fields["f"].row_attr_store.attrs(1)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join()
+        assert seen["attrs"] == {"x": 1}
+
+    def test_shift_identity_and_negative(self, ex):
+        setup_basic(ex)
+        (r,) = ex.execute("i", "Shift(Row(g=2))")
+        assert r.columns().tolist() == [10]  # n missing -> unchanged
+        (r,) = ex.execute("i", "Shift(Row(g=2), n=2)")
+        assert r.columns().tolist() == [12]
+        with pytest.raises(QueryError, match="negative"):
+            ex.execute("i", "Shift(Row(g=2), n=-1)")
