@@ -46,6 +46,11 @@ def pos(row_id: int, column_id: int) -> int:
     return row_id * SHARD_WIDTH + (column_id % SHARD_WIDTH)
 
 
+import itertools
+
+_fragment_uids = itertools.count(1)
+
+
 class Fragment:
     """In-process fragment. Thread-safe for single-writer/multi-reader via a
     coarse lock (the reference uses an RWMutex per fragment, fragment.go:101)."""
@@ -75,7 +80,9 @@ class Fragment:
         self._file = None
         # Bumped on every mutation; the TPU block cache uses it to decide
         # when a device re-upload is needed (see pilosa_tpu/ops/blocks.py).
+        # uid is process-unique (never reused, unlike id()) for cache keys.
         self.version = 0
+        self.uid = next(_fragment_uids)
         self._row_cache: dict[int, Bitmap] = {}
 
     # -- lifecycle --------------------------------------------------------

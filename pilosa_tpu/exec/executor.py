@@ -149,6 +149,19 @@ class Executor:
                     Pair(id=p.id, count=p.count, key=f.translate_store.translate_id(p.id) or "")
                     for p in result.pairs
                 ]
+        if isinstance(result, RowIDs):
+            field_name = c.args.get("field") or c.args.get("_field")
+            f = idx.field(field_name) if field_name else None
+            if f is not None and f.options.keys and f.translate_store is not None:
+                result.keys = [f.translate_store.translate_id(r) or "" for r in result]
+        if isinstance(result, PairField):
+            f = idx.field(result.field_name) if result.field_name else None
+            if f is not None and f.options.keys and f.translate_store is not None:
+                result.pair = Pair(
+                    id=result.pair.id,
+                    count=result.pair.count,
+                    key=f.translate_store.translate_id(result.pair.id) or "",
+                )
         if isinstance(result, list) and result and isinstance(result[0], GroupCount):
             for gc in result:
                 for fr in gc.group:
@@ -252,6 +265,11 @@ class Executor:
     def _execute_count(self, index, c, shards, opt) -> int:
         if len(c.children) != 1:
             raise QueryError("Count() only accepts a single bitmap input")
+        # Device fast path: the whole scatter-gather collapses into fused
+        # bitwise+popcount kernels when all shards are local (the TPU
+        # backend's count_shards; cluster mapper still splits by node).
+        if self.mapper is None and hasattr(self.backend, "count_shards"):
+            return int(self.backend.count_shards(index, c.children[0], shards))
         map_fn = lambda shard: self.backend.count_shard(index, c.children[0], shard)
         result = self.map_reduce(index, shards, c, opt, map_fn, lambda a, b: a + b)
         return int(result or 0)
@@ -402,6 +420,17 @@ class Executor:
         if not field_name:
             raise QueryError("TopN() field required")
         n, _ = c.uint64_arg("n")
+
+        # Device fast path: exact single-pass TopN (popcount-per-row +
+        # top_k) when no rank-cache-only options are in play.
+        plain = not any(
+            k in c.args for k in ("ids", "threshold", "tanimotoThreshold", "attrName")
+        )
+        if plain and self.mapper is None and hasattr(self.backend, "topn_field"):
+            src_call = c.children[0] if c.children else None
+            exact = self.backend.topn_field(index, field_name, shards, n, src_call)
+            if exact is not None:
+                return PairsField(exact, field_name)
 
         # Pass 1: approximate candidates from rank caches.
         pairs = self._execute_topn_shards(index, c, shards, opt)

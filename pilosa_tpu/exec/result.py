@@ -44,12 +44,18 @@ class PairField:
     field_name: str = ""
 
     def to_json(self) -> dict:
+        if self.pair.key:
+            return {"key": self.pair.key, "count": self.pair.count}
         return {"id": self.pair.id, "count": self.pair.count}
 
 
 class RowIDs(list):
     """Rows() result: sorted row IDs with limit-aware merge
-    (reference executor.go RowIDs.merge)."""
+    (reference executor.go RowIDs.merge). When the field is keyed the
+    executor fills `keys` and the JSON form emits them instead
+    (reference RowIdentifiers marshaling)."""
+
+    keys: Optional[list[str]] = None
 
     def merge(self, other: "RowIDs", limit: int) -> "RowIDs":
         seen = set(self)
@@ -57,6 +63,8 @@ class RowIDs(list):
         return RowIDs(out[:limit])
 
     def to_json(self) -> dict:
+        if self.keys is not None:
+            return {"keys": self.keys}
         return {"rows": list(self)}
 
 

@@ -1,0 +1,16 @@
+"""TPU device ops: dense bitmap blocks in HBM + XLA/Pallas kernels.
+
+This is the execution layer BASELINE.json's north star describes: each
+fragment's roaring containers are flattened into a dense
+uint32[rows, SHARD_WIDTH/32] block resident in HBM; PQL bitmap verbs
+lower to bitwise ops and Count/TopN/Sum to popcount reductions, fused by
+XLA (with Pallas variants for the hot paths). Blocks are cached on device
+and re-uploaded only when the owning fragment's version changes.
+"""
+
+from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, BlockCache, pack_fragment
+from pilosa_tpu.ops.kernels import (
+    and_popcount,
+    popcount_rows,
+    row_popcount_topk,
+)

@@ -1,0 +1,120 @@
+"""Dense HBM block layout + device block cache.
+
+Layout: one fragment (view ∩ shard) becomes uint32[rows_padded, WORDS]
+where WORDS = SHARD_WIDTH/32 (32768 for the default 2^20 shard width, i.e.
+128 KiB per row). uint32 is the TPU-native word (int64 is emulated on
+TPU); rows are padded to a multiple of 8 to satisfy float32-class tile
+shapes (8x128 VPU lanes; a 32768-word row is 256 full lanes).
+
+Packing walks roaring containers directly: a container key maps to
+(row, word-range) and its 1024 uint64 words view as 2048 little-endian
+uint32 words, so dense containers are a straight memcpy and array
+containers scatter only their set bits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+
+WORDS_PER_SHARD = SHARD_WIDTH // 32
+_CONTAINERS_PER_ROW = SHARD_WIDTH >> 16
+_WORDS_PER_CONTAINER = (1 << 16) // 32  # 2048
+
+ROW_PAD = 8
+
+
+def _padded_rows(n_rows: int) -> int:
+    return max(((n_rows + ROW_PAD - 1) // ROW_PAD) * ROW_PAD, ROW_PAD)
+
+
+def pack_fragment(frag, n_rows: Optional[int] = None) -> np.ndarray:
+    """Flatten a fragment's roaring storage into uint32[rows_p, WORDS].
+
+    n_rows: minimum logical row count (pad target); defaults to
+    frag.max_row_id + 1.
+    """
+    storage = frag.storage
+    if n_rows is None:
+        n_rows = frag.max_row_id + 1
+    rows_p = _padded_rows(n_rows)
+    arr = np.zeros((rows_p, WORDS_PER_SHARD), dtype=np.uint32)
+    for key in storage.keys():
+        c = storage.container(key)
+        if c is None or c.n == 0:
+            continue
+        row = key // _CONTAINERS_PER_ROW
+        if row >= rows_p:
+            continue  # caller asked for fewer rows than stored
+        cidx = key % _CONTAINERS_PER_ROW
+        base = cidx * _WORDS_PER_CONTAINER
+        if c.typ == "bitmap":
+            arr[row, base : base + _WORDS_PER_CONTAINER] = c.data.view("<u4")
+        else:
+            pos = c.data.astype(np.uint32)
+            np.bitwise_or.at(
+                arr[row],
+                base + (pos >> 5),
+                np.uint32(1) << (pos & np.uint32(31)),
+            )
+    return arr
+
+
+def unpack_row(words: np.ndarray) -> np.ndarray:
+    """uint32[WORDS] -> sorted shard-relative column positions."""
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint64)
+
+
+class BlockCache:
+    """Fragment -> device-resident dense block, invalidated by version.
+
+    The write path stays host-roaring (reference fragment mutation
+    semantics); queries lazily (re)upload blocks whose fragment.version
+    changed — the device-residency policy described in SURVEY.md §7 step 5.
+    A whole-block re-upload on any mutation is the v1 policy; dirty
+    container-range tracking is the planned refinement.
+    """
+
+    def __init__(self, device=None):
+        import jax
+
+        self.device = device
+        self._jax = jax
+        self._entries: dict[int, tuple[int, int, object]] = {}  # id(frag) -> (version, rows, array)
+
+    def block(self, frag, n_rows: Optional[int] = None):
+        """Device block for a fragment, shape uint32[rows_p, WORDS]."""
+        key = frag.uid  # process-unique, never reused (unlike id())
+        want_rows = _padded_rows(n_rows if n_rows is not None else frag.max_row_id + 1)
+        entry = self._entries.get(key)
+        if entry is not None:
+            version, rows, arr = entry
+            if version == frag.version and rows >= want_rows:
+                return arr
+        host = pack_fragment(frag, n_rows=want_rows)
+        arr = self._jax.device_put(host, self.device)
+        self._entries[key] = (frag.version, host.shape[0], arr)
+        return arr
+
+    def row_vector(self, frag, row_id: int):
+        """One row as a device uint32[WORDS] vector."""
+        block = self.block(frag)
+        if row_id >= block.shape[0]:
+            # Row beyond the packed block: empty.
+            import jax.numpy as jnp
+
+            return jnp.zeros((WORDS_PER_SHARD,), dtype=jnp.uint32)
+        return block[row_id]
+
+    def invalidate(self, frag) -> None:
+        self._entries.pop(frag.uid, None)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def resident_bytes(self) -> int:
+        return sum(rows * WORDS_PER_SHARD * 4 for _, rows, _ in self._entries.values())

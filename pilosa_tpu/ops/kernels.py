@@ -1,0 +1,124 @@
+"""XLA / Pallas kernels for bitmap algebra.
+
+The jnp forms compile to fully-fused XLA loops (bitwise verb + popcount +
+reduce in one pass over HBM) — on TPU the bound is HBM bandwidth, which a
+fused elementwise+reduce already saturates; the Pallas variants exist for
+the gather-fused multi-operand cases XLA won't fuse across (and as the
+tuning surface for later rounds). All kernels are jitted once per shape.
+
+Counts are accumulated in uint32 per shard row (a 2^20-bit shard row
+popcounts to ≤2^20, and a full block to ≤2^25 per row-count) and summed to
+Python int on the host, so overflow needs >4G bits in ONE fragment, which
+the 2^20-wide layout cannot produce.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.ops.blocks import WORDS_PER_SHARD
+
+
+@jax.jit
+def and_popcount(a, b):
+    """popcount(a & b) — the Intersect+Count hot path, one fused pass."""
+    return jnp.sum(jax.lax.population_count(a & b), dtype=jnp.uint32)
+
+
+@jax.jit
+def popcount(a):
+    return jnp.sum(jax.lax.population_count(a), dtype=jnp.uint32)
+
+
+@jax.jit
+def popcount_rows(block):
+    """Per-row popcounts of a block: uint32[rows, WORDS] -> uint32[rows]."""
+    return jnp.sum(jax.lax.population_count(block), axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def row_popcount_topk(counts, k: int):
+    """top-k of accumulated per-row counts (TopN merge on device)."""
+    return jax.lax.top_k(counts, k)
+
+
+@jax.jit
+def bsi_plane_counts(planes, exists, sign, filter_vec):
+    """Per-plane positive/negative popcounts for BSI sum, one fused kernel.
+
+    planes: uint32[depth, WORDS] magnitude planes; exists/sign/filter:
+    uint32[WORDS]. Returns (pos_counts[depth], neg_counts[depth], count).
+    Mirrors reference fragment.sum's per-plane popcount × place-value
+    pattern (fragment.go:1111) with the sign split fused on device; the
+    host computes Σ counts[i]·2^i in exact Python ints (plane counts are
+    ≤2^20, so uint32 accumulators cannot overflow)."""
+    consider = exists & filter_vec
+    nrow = sign & consider
+    prow = consider & ~nrow
+    pos_counts = jnp.sum(
+        jax.lax.population_count(planes & prow[None, :]), axis=-1, dtype=jnp.uint32
+    )
+    neg_counts = jnp.sum(
+        jax.lax.population_count(planes & nrow[None, :]), axis=-1, dtype=jnp.uint32
+    )
+    count = jnp.sum(jax.lax.population_count(consider), dtype=jnp.uint32)
+    return pos_counts, neg_counts, count
+
+
+# ---------------------------------------------------------------------------
+# Pallas variants (TPU): fused gather + n-ary bitwise + popcount.
+# ---------------------------------------------------------------------------
+
+
+def _and_popcount_kernel(a_ref, b_ref, out_ref):
+    out_ref[0] = jnp.sum(
+        jax.lax.population_count(a_ref[...] & b_ref[...]), dtype=jnp.uint32
+    )
+
+
+def pallas_and_popcount(a, b, interpret: bool = False):
+    """Pallas fused AND+popcount over uint32 vectors.
+
+    Grid-free single-block version; rows fit VMEM (128 KiB block + 128 KiB
+    block < 16 MB VMEM). Used on real TPU; tests run interpret=True.
+    """
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _and_popcount_kernel,
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.uint32),
+        interpret=interpret,
+    )(a, b)[0]
+
+
+def _multi_and_popcount_kernel(refs_and_out):
+    # refs_and_out: (*in_refs, out_ref)
+    *in_refs, out_ref = refs_and_out
+    acc = in_refs[0][...]
+    for r in in_refs[1:]:
+        acc = acc & r[...]
+    out_ref[0] = jnp.sum(jax.lax.population_count(acc), dtype=jnp.uint32)
+
+
+def fused_count(vectors, op: str = "and", interpret: bool = False):
+    """Fused n-ary bitwise + popcount without materializing intermediates.
+
+    vectors: list of uint32[WORDS] device arrays. op: and|or|xor|andnot.
+    jnp fallback — XLA fuses this chain fine; kept as one entry point so
+    the TPU path can swap in a Pallas mosaic later without touching
+    callers.
+    """
+    acc = vectors[0]
+    for v in vectors[1:]:
+        if op == "and":
+            acc = acc & v
+        elif op == "or":
+            acc = acc | v
+        elif op == "xor":
+            acc = acc ^ v
+        elif op == "andnot":
+            acc = acc & ~v
+    return jnp.sum(jax.lax.population_count(acc), dtype=jnp.uint32)

@@ -1,0 +1,13 @@
+"""Multi-chip parallelism: shard placement over a jax device mesh.
+
+The reference scatters shards to cluster nodes over HTTP and reduces
+streaming responses (reference executor.go mapReduce :2460, cluster.go
+jump-hash placement :871). Intra-host/pod, this layer replaces that wire
+protocol with a jax.sharding.Mesh over a 'shards' axis: stacked fragment
+blocks live sharded across devices, per-device partial results are
+computed by shard_map-ed kernels, and reductions ride ICI collectives
+(lax.psum for Count/Sum, gathered top_k for TopN). Cross-host (DCN)
+traffic remains RPC at the cluster layer (pilosa_tpu/cluster).
+"""
+
+from pilosa_tpu.parallel.mesh import ShardMesh

@@ -8,10 +8,19 @@ the reference tests multi-node with in-process clusters instead of real ones
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, not setdefault: the ambient environment may preselect the real TPU
+# platform, but tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize imports jax at interpreter startup (TPU plugin
+# registration), which snapshots JAX_PLATFORMS before this file runs —
+# update the live config too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -410,3 +410,15 @@ class TestReviewRegressions:
         assert r.columns().tolist() == [12]
         with pytest.raises(QueryError, match="negative"):
             ex.execute("i", "Shift(Row(g=2), n=-1)")
+
+    def test_rows_result_keys_translated(self, holder):
+        from pilosa_tpu.core.field import FieldOptions
+
+        idx = holder.create_index("k2", IndexOptions(keys=True))
+        idx.create_field("f", FieldOptions(keys=True))
+        ex = Executor(holder)
+        ex.execute("k2", 'Set("a", f="red") Set("b", f="blue")')
+        (rows,) = ex.execute("k2", "Rows(f)")
+        assert rows.to_json() == {"keys": ["red", "blue"]} or set(
+            rows.to_json()["keys"]
+        ) == {"red", "blue"}

@@ -1,0 +1,191 @@
+"""TPU backend tests: block packing, kernels, TPUBackend differential vs
+the CPU oracle, and mesh execution on the 8-device virtual CPU platform
+(the multi-node-without-a-cluster strategy, SURVEY.md §4.3)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pilosa_tpu.core import Fragment, Holder
+from pilosa_tpu.core.field import options_for_int
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.exec.tpu import TPUBackend
+from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, BlockCache, pack_fragment, unpack_row
+from pilosa_tpu.ops.kernels import and_popcount, popcount_rows
+from pilosa_tpu.parallel import ShardMesh
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data")).open()
+    yield h
+    h.close()
+
+
+class TestBlockPacking:
+    def test_pack_roundtrip(self, rng):
+        f = Fragment(None, "i", "f", "standard", 0)
+        cols = np.unique(rng.integers(0, SHARD_WIDTH, 5000, dtype=np.uint64))
+        f.bulk_import(np.full(cols.size, 3, dtype=np.uint64), cols)
+        block = pack_fragment(f)
+        assert block.shape[1] == WORDS_PER_SHARD
+        assert block.shape[0] % 8 == 0
+        np.testing.assert_array_equal(unpack_row(block[3]), cols)
+        assert block[0].sum() == 0
+
+    def test_pack_dense_container(self):
+        f = Fragment(None, "i", "f", "standard", 0)
+        cols = np.arange(0, 100_000, dtype=np.uint64)  # bitmap containers
+        f.bulk_import(np.zeros(cols.size, dtype=np.uint64), cols)
+        block = pack_fragment(f)
+        np.testing.assert_array_equal(unpack_row(block[0]), cols)
+
+    def test_cache_invalidation(self):
+        f = Fragment(None, "i", "f", "standard", 0)
+        f.set_bit(0, 1)
+        cache = BlockCache()
+        b1 = cache.block(f)
+        assert np.asarray(b1)[0, 0] == 2  # bit 1
+        f.set_bit(0, 2)  # version bump
+        b2 = cache.block(f)
+        assert np.asarray(b2)[0, 0] == 6  # bits 1,2
+        assert cache.resident_bytes() > 0
+
+
+class TestKernels:
+    def test_and_popcount_matches_numpy(self, rng):
+        a = rng.integers(0, 2**32, WORDS_PER_SHARD, dtype=np.uint32)
+        b = rng.integers(0, 2**32, WORDS_PER_SHARD, dtype=np.uint32)
+        got = int(and_popcount(a, b))
+        want = int(np.bitwise_count(a & b).sum())
+        assert got == want
+
+    def test_popcount_rows(self, rng):
+        block = rng.integers(0, 2**32, (8, WORDS_PER_SHARD), dtype=np.uint32)
+        got = np.asarray(popcount_rows(block))
+        want = np.bitwise_count(block).sum(axis=1)
+        np.testing.assert_array_equal(got, want)
+
+
+class TestTPUBackendDifferential:
+    """The TPU backend must agree with the CPU oracle on every query."""
+
+    def _setup(self, holder, rng):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        idx.create_field("v", options_for_int(-500, 500))
+        ex_cpu = Executor(holder)
+        # random data across 3 shards
+        for row in [1, 2, 3]:
+            cols = np.unique(rng.integers(0, 3 * SHARD_WIDTH, 2000, dtype=np.uint64))
+            idx.field("f").import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+            ef = idx.existence_field()
+            ef.import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
+        cols = np.unique(rng.integers(0, 3 * SHARD_WIDTH, 1500, dtype=np.uint64))
+        idx.field("g").import_bits(np.full(cols.size, 7, dtype=np.uint64), cols)
+        ex_tpu = Executor(holder, backend=TPUBackend(holder))
+        return ex_cpu, ex_tpu
+
+    QUERIES = [
+        "Row(f=1)",
+        "Count(Row(f=2))",
+        "Count(Intersect(Row(f=1), Row(g=7)))",
+        "Count(Union(Row(f=1), Row(f=2), Row(f=3)))",
+        "Count(Difference(Row(f=1), Row(g=7)))",
+        "Count(Xor(Row(f=2), Row(g=7)))",
+        "Union(Row(f=1), Row(g=7))",
+        "Intersect(Row(f=1), Row(f=2))",
+        "Not(Row(f=1))",
+        "All()",
+        "Count(Not(Union(Row(f=1), Row(f=2))))",
+        "TopN(f, n=2)",
+        "TopN(f)",
+        "TopN(f, Row(g=7), n=3)",
+    ]
+
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_differential(self, holder, rng, q):
+        ex_cpu, ex_tpu = self._setup(holder, rng)
+        want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+        got = [result_to_json(r) for r in ex_tpu.execute("i", q)]
+        assert got == want, q
+
+    def test_write_invalidates_device_blocks(self, holder, rng):
+        ex_cpu, ex_tpu = self._setup(holder, rng)
+        before = ex_tpu.execute("i", "Count(Row(f=1))")[0]
+        ex_tpu.execute("i", f"Set({SHARD_WIDTH + 123456}, f=1)")
+        after = ex_tpu.execute("i", "Count(Row(f=1))")[0]
+        assert after == before + 1
+        # still agrees with oracle
+        assert ex_cpu.execute("i", "Count(Row(f=1))")[0] == after
+
+    def test_bsi_falls_back_to_cpu(self, holder, rng):
+        ex_cpu, ex_tpu = self._setup(holder, rng)
+        ex_tpu.execute("i", "Set(5, v=42) Set(6, v=-10)")
+        for q in ["Sum(field=v)", "Row(v > 0)", "Min(field=v)"]:
+            want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+            got = [result_to_json(r) for r in ex_tpu.execute("i", q)]
+            assert got == want, q
+
+
+class TestShardMesh:
+    """Multi-chip execution on the virtual 8-device CPU mesh."""
+
+    def test_mesh_has_8_devices(self):
+        assert len(jax.devices()) == 8
+
+    def test_count_intersect_psum(self, rng):
+        mesh = ShardMesh()
+        S = mesh.n
+        a = rng.integers(0, 2**32, (S, WORDS_PER_SHARD), dtype=np.uint32)
+        b = rng.integers(0, 2**32, (S, WORDS_PER_SHARD), dtype=np.uint32)
+        da, db = mesh.put(a), mesh.put(b)
+        got = mesh.count_intersect(da, db)
+        want = int(np.bitwise_count(a & b).sum())
+        assert got == want
+
+    def test_topn_counts(self, rng):
+        mesh = ShardMesh()
+        S, R = mesh.n, 8
+        blocks = rng.integers(0, 2**32, (S, R, WORDS_PER_SHARD // 16), dtype=np.uint32)
+        got = mesh.topn_counts(mesh.put(blocks))
+        want = np.bitwise_count(blocks).sum(axis=(0, 2))
+        np.testing.assert_array_equal(got, want)
+
+    def test_bsi_sum(self, rng):
+        mesh = ShardMesh()
+        S, D, W = mesh.n, 4, WORDS_PER_SHARD // 64
+        planes = rng.integers(0, 2**32, (S, D, W), dtype=np.uint32)
+        exists = np.full((S, W), 0xFFFFFFFF, dtype=np.uint32)
+        sign = np.zeros((S, W), dtype=np.uint32)
+        total, cnt = mesh.bsi_sum(mesh.put(planes), mesh.put(exists), mesh.put(sign))
+        want = sum(int(np.bitwise_count(planes[:, i, :]).sum()) << i for i in range(D))
+        assert total == want
+        assert cnt == S * W * 32
+
+
+class TestCountBatch:
+    def test_count_batch_matches_singles(self, holder, rng):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        for row in [1, 2, 3]:
+            cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 3000, dtype=np.uint64))
+            idx.field("f").import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+        cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 3000, dtype=np.uint64))
+        idx.field("g").import_bits(np.full(cols.size, 9, dtype=np.uint64), cols)
+        be = TPUBackend(holder)
+        from pilosa_tpu.pql import parse_string
+
+        calls = [
+            parse_string(f"Intersect(Row(f={r}), Row(g=9))").calls[0] for r in [1, 2, 3, 7]
+        ]
+        shards = [0, 1]
+        batch = be.count_batch("i", calls, shards)
+        singles = [be.count_shards("i", c, shards) for c in calls]
+        assert batch == singles
+        assert batch[3] == 0  # nonexistent row counts zero
