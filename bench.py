@@ -1,0 +1,128 @@
+"""Headline benchmark: PQL Intersect+Count throughput, TPU vs host roaring.
+
+Builds an index of BENCH_SHARDS shards (2^20 columns each) with two set
+fields, then measures Count(Intersect(Row(f=i), Row(g=j))) throughput:
+
+- TPU: the TPUBackend's batched path — Q same-shape queries fused into a
+  single device dispatch over stacked HBM blocks (the realistic serving
+  shape; per-query blocking sync through this environment's relay-attached
+  chip costs ~78 ms regardless of work, so batching is the only honest
+  throughput measurement).
+- Baseline: the same queries through the CPU oracle backend (vectorized
+  numpy roaring — the stand-in for the reference's Go/roaring engine; the
+  reference publishes no absolute numbers and no Go toolchain exists in
+  this image, see BASELINE.md).
+
+Prints ONE JSON line {metric, value, unit, vs_baseline}.
+
+Env knobs: BENCH_SHARDS (default 64), BENCH_ROWS (8), BENCH_DENSITY
+(0.05), BENCH_BATCH (256), BENCH_SECONDS (10).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.tpu import TPUBackend
+from pilosa_tpu.pql import parse_string
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+SHARDS = int(os.environ.get("BENCH_SHARDS", "64"))
+ROWS = int(os.environ.get("BENCH_ROWS", "8"))
+DENSITY = float(os.environ.get("BENCH_DENSITY", "0.05"))
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+SECONDS = float(os.environ.get("BENCH_SECONDS", "10"))
+
+
+def build_index(h: Holder):
+    idx = h.create_index("bench")
+    rng = np.random.default_rng(42)
+    n_bits = int(SHARD_WIDTH * DENSITY)
+    for fname in ("f", "g"):
+        field = idx.create_field(fname)
+        for shard in range(SHARDS):
+            base = shard * SHARD_WIDTH
+            for row in range(ROWS):
+                cols = rng.integers(0, SHARD_WIDTH, n_bits, dtype=np.uint64) + base
+                cols = np.unique(cols)
+                field.import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+    return idx
+
+
+def bench_tpu(holder, queries) -> tuple[float, list[int]]:
+    be = TPUBackend(holder)
+    shards = list(range(SHARDS))
+    calls = [parse_string(q).calls[0].children[0] for q in queries]
+    # warmup: compile + upload blocks
+    first = be.count_batch("bench", calls[:BATCH], shards)
+    n_done = 0
+    t0 = time.time()
+    while time.time() - t0 < SECONDS:
+        be.count_batch("bench", calls[:BATCH], shards)
+        n_done += BATCH
+    dt = time.time() - t0
+    return n_done / dt, first
+
+
+def bench_cpu(holder, parsed_queries) -> float:
+    """Same pre-parsed queries, same duration knob as the TPU side."""
+    ex = Executor(holder)
+    n_done = 0
+    t0 = time.time()
+    while time.time() - t0 < SECONDS:
+        ex.execute("bench", parsed_queries[n_done % len(parsed_queries)])
+        n_done += 1
+    dt = time.time() - t0
+    return n_done / dt
+
+
+def main():
+    h = Holder(None)  # in-memory: bench measures query path, not disk
+    h.open()
+    build_index(h)
+
+    rng = np.random.default_rng(7)
+    queries = [
+        f"Count(Intersect(Row(f={int(rng.integers(0, ROWS))}), Row(g={int(rng.integers(0, ROWS))})))"
+        for _ in range(BATCH)
+    ]
+    parsed = [parse_string(q) for q in queries]
+
+    cpu_qps = bench_cpu(h, parsed)
+    tpu_qps, tpu_first = bench_tpu(h, queries)
+
+    # Correctness cross-check: TPU batch results must equal the CPU oracle.
+    ex = Executor(h)
+    for i in sorted({0, BATCH // 2, BATCH - 1}):
+        want = ex.execute("bench", queries[i])[0]
+        assert tpu_first[i] == want, (i, tpu_first[i], want)
+
+    print(
+        json.dumps(
+            {
+                "metric": "intersect_count_qps",
+                "value": round(tpu_qps, 1),
+                "unit": "queries/s",
+                "vs_baseline": round(tpu_qps / cpu_qps, 2) if cpu_qps else None,
+                "baseline_qps": round(cpu_qps, 1),
+                "config": {
+                    "shards": SHARDS,
+                    "columns": SHARDS * SHARD_WIDTH,
+                    "rows_per_field": ROWS,
+                    "density": DENSITY,
+                    "batch": BATCH,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

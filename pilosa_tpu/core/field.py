@@ -431,10 +431,15 @@ class Field:
         Import :1204, grouping by time quantum :1222-1265)."""
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
-        # Group (view -> indices)
+        if timestamps is None:
+            # Fast path: everything goes to the standard view — skip the
+            # per-bit grouping loop entirely.
+            self._import_view(VIEW_STANDARD, row_ids, column_ids, clear)
+            return
+        # Group (view -> indices) per timestamp quantum.
         groups: dict[str, list[int]] = {}
         for i in range(row_ids.size):
-            ts = timestamps[i] if timestamps is not None else None
+            ts = timestamps[i]
             names = [VIEW_STANDARD] if not self.options.no_standard_view or ts is None else []
             if ts is not None:
                 if not self.options.time_quantum:
@@ -444,13 +449,15 @@ class Field:
                 groups.setdefault(nm, []).append(i)
         for vname, idxs in groups.items():
             sel = np.array(idxs, dtype=np.int64)
-            rows_v, cols_v = row_ids[sel], column_ids[sel]
-            shards = cols_v // np.uint64(SHARD_WIDTH)
-            for shard in np.unique(shards):
-                ssel = shards == shard
-                frag = self.create_view_if_not_exists(vname).create_fragment_if_not_exists(int(shard))
-                frag.bulk_import(rows_v[ssel], cols_v[ssel], clear=clear)
-                self.add_available_shard(int(shard))
+            self._import_view(vname, row_ids[sel], column_ids[sel], clear)
+
+    def _import_view(self, vname: str, rows_v: np.ndarray, cols_v: np.ndarray, clear: bool) -> None:
+        shards = cols_v // np.uint64(SHARD_WIDTH)
+        for shard in np.unique(shards):
+            ssel = shards == shard
+            frag = self.create_view_if_not_exists(vname).create_fragment_if_not_exists(int(shard))
+            frag.bulk_import(rows_v[ssel], cols_v[ssel], clear=clear)
+            self.add_available_shard(int(shard))
 
     def import_roaring(self, shard: int, data: bytes, view_name: str = VIEW_STANDARD, clear: bool = False) -> int:
         frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(shard)
