@@ -1,0 +1,258 @@
+"""Command-line interface (reference cmd/ + ctl/: server, import, export,
+check, inspect, generate-config, config).
+
+Usage: python -m pilosa_tpu.cli <command> [flags]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def cmd_server(args) -> int:
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.config import Config
+    from pilosa_tpu.server.http import Server
+    from pilosa_tpu.utils.logger import StandardLogger
+
+    cfg = Config.from_sources(
+        toml_path=args.config,
+        args={
+            "data_dir": args.data_dir,
+            "bind": args.bind,
+            "executor": args.executor,
+            "verbose": args.verbose or None,
+        },
+    )
+    log = StandardLogger(verbose=cfg.verbose)
+    data_dir = os.path.expanduser(cfg.data_dir)
+    holder = Holder(data_dir).open()
+
+    backend = None
+    if cfg.executor == "tpu":
+        try:
+            from pilosa_tpu.exec.tpu import TPUBackend
+
+            backend = TPUBackend(holder)
+            log.printf("executor=tpu: device backend enabled")
+        except Exception as e:  # no usable device: fall back
+            log.printf("executor=tpu unavailable (%s); falling back to cpu", e)
+    executor = Executor(holder, backend=backend)
+    api = API(holder, executor)
+
+    if cfg.cluster.hosts:
+        try:
+            from pilosa_tpu.cluster import Cluster
+        except ImportError as e:
+            log.printf("clustered config requires the cluster module: %s", e)
+            return 1
+
+        cluster = Cluster(
+            api,
+            self_uri=f"http://{cfg.host}:{cfg.port}",
+            hosts=cfg.cluster.hosts,
+            replicas=cfg.cluster.replicas,
+            coordinator=cfg.cluster.coordinator,
+        )
+        api.cluster = cluster
+        executor.mapper = cluster.mapper
+        cluster.open()
+
+    server = Server(api, host=cfg.host, port=cfg.port)
+    log.printf("listening on http://%s:%d (data: %s)", cfg.host, cfg.port, data_dir)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log.printf("shutting down")
+        holder.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    """CSV import: rows of row_id,column_id (or col,value with -v)
+    (reference ctl/import.go)."""
+    import urllib.error
+    import urllib.request
+
+    host = args.host.rstrip("/")
+    index, field = args.index, args.field
+
+    # create index/field if requested
+    if args.create:
+        for url, body in [
+            (f"{host}/index/{index}", {}),
+            (
+                f"{host}/index/{index}/field/{field}",
+                {"options": {"type": "int", "min": args.min, "max": args.max}}
+                if args.value
+                else {},
+            ),
+        ]:
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req)
+            except urllib.error.HTTPError as e:
+                if e.code != 409:  # only "already exists" is benign
+                    raise
+
+    rows, cols, values = [], [], []
+    for path in args.files:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split(",")
+                if args.value:
+                    cols.append(int(parts[0]))
+                    values.append(int(parts[1]))
+                else:
+                    rows.append(int(parts[0]))
+                    cols.append(int(parts[1]))
+
+    payload = (
+        {"columnIDs": cols, "values": values}
+        if args.value
+        else {"rowIDs": rows, "columnIDs": cols}
+    )
+    req = urllib.request.Request(
+        f"{host}/index/{index}/field/{field}/import",
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    resp = urllib.request.urlopen(req)
+    print(resp.read().decode().strip())
+    return 0
+
+
+def cmd_export(args) -> int:
+    """reference ctl/export.go."""
+    import urllib.request
+
+    url = f"{args.host.rstrip('/')}/export?index={args.index}&field={args.field}&shard={args.shard}"
+    resp = urllib.request.urlopen(urllib.request.Request(url))
+    sys.stdout.write(resp.read().decode())
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Offline consistency check of fragment + cache files
+    (reference ctl/check.go:28-50)."""
+    from pilosa_tpu.roaring.codec import deserialize
+
+    ok = True
+    for path in args.files:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            b = deserialize(data)
+            print(f"{path}: ok ({b.count()} bits, {len(b._cs)} containers, opN={b.op_n})")
+        except Exception as e:
+            ok = False
+            print(f"{path}: CORRUPT: {e}")
+    return 0 if ok else 1
+
+
+def cmd_inspect(args) -> int:
+    """Dump roaring container stats (reference ctl/inspect.go:30-60)."""
+    from pilosa_tpu.roaring.codec import deserialize
+
+    for path in args.files:
+        with open(path, "rb") as f:
+            b = deserialize(f.read())
+        type_counts: dict[str, int] = {}
+        for key in b.keys():
+            c = b.container(key)
+            type_counts[c.typ] = type_counts.get(c.typ, 0) + 1
+        print(f"{path}:")
+        print(f"  bits: {b.count()}")
+        print(f"  containers: {len(b._cs)} {type_counts}")
+        print(f"  ops applied: {b.op_n}")
+        if args.containers:
+            for key in b.keys():
+                c = b.container(key)
+                print(f"  {key:>12} {c.typ:>6} n={c.n}")
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    from pilosa_tpu.server.config import Config
+
+    sys.stdout.write(Config().toml_text())
+    return 0
+
+
+def cmd_config(args) -> int:
+    """Validate a config file (reference `pilosa config`)."""
+    from pilosa_tpu.server.config import Config
+
+    try:
+        cfg = Config.from_sources(toml_path=args.config)
+    except Exception as e:
+        print(f"invalid config: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(cfg.to_dict(), indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pilosa-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("server", help="run the server")
+    sp.add_argument("-d", "--data-dir", default=None)
+    sp.add_argument("-b", "--bind", default=None)
+    sp.add_argument("-c", "--config", default=None)
+    sp.add_argument("--executor", choices=["tpu", "cpu"], default=None)
+    sp.add_argument("--verbose", action="store_true")
+    sp.set_defaults(fn=cmd_server)
+
+    sp = sub.add_parser("import", help="import CSV data")
+    sp.add_argument("--host", default="http://localhost:10101")
+    sp.add_argument("-i", "--index", required=True)
+    sp.add_argument("-f", "--field", required=True)
+    sp.add_argument("--create", action="store_true", help="create index/field first")
+    sp.add_argument("-v", "--value", action="store_true", help="int-field value import")
+    sp.add_argument("--min", type=int, default=0)
+    sp.add_argument("--max", type=int, default=1 << 40)
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_import)
+
+    sp = sub.add_parser("export", help="export a fragment as CSV")
+    sp.add_argument("--host", default="http://localhost:10101")
+    sp.add_argument("-i", "--index", required=True)
+    sp.add_argument("-f", "--field", required=True)
+    sp.add_argument("-s", "--shard", type=int, default=0)
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("check", help="check fragment files for corruption")
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_check)
+
+    sp = sub.add_parser("inspect", help="inspect roaring fragment files")
+    sp.add_argument("--containers", action="store_true")
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("generate-config", help="print default config TOML")
+    sp.set_defaults(fn=cmd_generate_config)
+
+    sp = sub.add_parser("config", help="validate a config file")
+    sp.add_argument("-c", "--config", required=True)
+    sp.set_defaults(fn=cmd_config)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,369 @@
+"""API facade (reference api.go:42).
+
+Sits between the HTTP handler and the holder/executor/cluster: validates
+cluster state per method (reference api.go:119 apiMethod validation),
+performs import-side key translation and existence tracking, and exposes
+schema CRUD. The cluster attribute is None in single-node mode; the
+cluster layer injects itself to gate methods and route imports.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.core.timequantum import parse_time
+from pilosa_tpu.exec import ExecOptions, Executor
+from pilosa_tpu.exec.cpu import QueryError
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class APIError(Exception):
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = status
+
+
+class NotFoundError(APIError):
+    def __init__(self, msg: str):
+        super().__init__(msg, status=404)
+
+
+class ConflictError(APIError):
+    def __init__(self, msg: str):
+        super().__init__(msg, status=409)
+
+
+# Methods allowed in non-NORMAL cluster states (reference api.go:1343+).
+_STATE_EXEMPT = {"Status", "ClusterMessage", "ResizeAbort", "SetCoordinator"}
+
+
+class API:
+    def __init__(self, holder: Holder, executor: Optional[Executor] = None, cluster=None):
+        self.holder = holder
+        self.executor = executor if executor is not None else Executor(holder)
+        self.cluster = cluster  # wired by pilosa_tpu/cluster
+        # Set by the HTTP server once the listener is bound.
+        self.local_host = "localhost"
+        self.local_port = 10101
+
+    def _validate_state(self, method: str) -> None:
+        if self.cluster is None or method in _STATE_EXEMPT:
+            return
+        state = self.cluster.state()
+        if state not in ("NORMAL", "DEGRADED"):
+            raise APIError(f"cluster is in state {state}", status=503)
+
+    # -- query -------------------------------------------------------------
+
+    def query(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[list[int]] = None,
+        column_attrs: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+        remote: bool = False,
+    ) -> dict[str, Any]:
+        self._validate_state("Query")
+        from pilosa_tpu.exec.result import result_to_json
+        from pilosa_tpu.pql import ParseError
+
+        opt = ExecOptions(
+            remote=remote,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns,
+            column_attrs=column_attrs,
+        )
+        try:
+            results = self.executor.execute(index, query, shards=shards, opt=opt)
+        except (ParseError, QueryError, ValueError) as e:
+            raise APIError(str(e)) from e
+        out: dict[str, Any] = {
+            "results": [self._encode_result(r, exclude_columns) for r in results]
+        }
+        if column_attrs and not exclude_columns:
+            out["columnAttrSets"] = self._column_attr_sets(index, results)
+        return out
+
+    def _encode_result(self, r: Any, exclude_columns: bool) -> Any:
+        from pilosa_tpu.core.row import Row
+        from pilosa_tpu.exec.result import result_to_json
+
+        if isinstance(r, Row):
+            out: dict[str, Any] = {"attrs": r.attrs or {}}
+            if r.keys:
+                out["keys"] = r.keys
+            elif not exclude_columns:
+                out["columns"] = r.columns().tolist()
+            else:
+                out["columns"] = []
+            return out
+        return result_to_json(r)
+
+    def _column_attr_sets(self, index: str, results: list) -> list[dict]:
+        from pilosa_tpu.core.row import Row
+
+        idx = self.holder.index(index)
+        if idx is None or idx.column_attr_store is None:
+            return []
+        seen: set[int] = set()
+        for r in results:
+            if isinstance(r, Row):
+                seen.update(int(c) for c in r.columns().tolist())
+        out = []
+        for col in sorted(seen):
+            attrs = idx.column_attr_store.attrs(col)
+            if attrs:
+                out.append({"id": col, "attrs": attrs})
+        return out
+
+    # -- schema ------------------------------------------------------------
+
+    def create_index(self, name: str, options: Optional[dict] = None) -> dict:
+        self._validate_state("CreateIndex")
+        options = options or {}
+        opts = IndexOptions(
+            keys=bool(options.get("keys", False)),
+            track_existence=bool(options.get("trackExistence", True)),
+        )
+        try:
+            idx = self.holder.create_index(name, opts)
+        except ValueError as e:
+            if "exists" in str(e):
+                raise ConflictError(str(e)) from e
+            raise APIError(str(e)) from e
+        if self.cluster is not None:
+            self.cluster.broadcast_schema()
+        return {"name": name, "options": idx.options.to_dict()}
+
+    def delete_index(self, name: str) -> None:
+        self._validate_state("DeleteIndex")
+        try:
+            self.holder.delete_index(name)
+        except KeyError as e:
+            raise NotFoundError(f"index not found: {name}") from e
+        if self.cluster is not None:
+            self.cluster.broadcast_schema()
+
+    def create_field(self, index: str, name: str, options: Optional[dict] = None) -> dict:
+        self._validate_state("CreateField")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        fo = self._field_options(options or {})
+        try:
+            f = idx.create_field(name, fo)
+        except ValueError as e:
+            if "exists" in str(e):
+                raise ConflictError(str(e)) from e
+            raise APIError(str(e)) from e
+        if self.cluster is not None:
+            self.cluster.broadcast_schema()
+        return {"name": name, "options": f.options.to_dict()}
+
+    @staticmethod
+    def _field_options(o: dict) -> FieldOptions:
+        from pilosa_tpu.core import field as field_mod
+
+        typ = o.get("type", "set")
+        if typ == "set":
+            fo = field_mod.options_for_set(
+                o.get("cacheType", "ranked"), o.get("cacheSize", 50000)
+            )
+        elif typ == "int":
+            fo = field_mod.options_for_int(o.get("min", 0), o.get("max", 0))
+        elif typ == "time":
+            fo = field_mod.options_for_time(
+                o.get("timeQuantum", ""), o.get("noStandardView", False)
+            )
+        elif typ == "mutex":
+            fo = field_mod.options_for_mutex(
+                o.get("cacheType", "ranked"), o.get("cacheSize", 50000)
+            )
+        elif typ == "bool":
+            fo = field_mod.options_for_bool()
+        else:
+            raise APIError(f"invalid field type: {typ}")
+        fo.keys = bool(o.get("keys", False))
+        return fo
+
+    def delete_field(self, index: str, name: str) -> None:
+        self._validate_state("DeleteField")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        try:
+            idx.delete_field(name)
+        except KeyError as e:
+            raise NotFoundError(f"field not found: {name}") from e
+        if self.cluster is not None:
+            self.cluster.broadcast_schema()
+
+    def schema(self) -> dict:
+        return {"indexes": self.holder.schema()}
+
+    def apply_schema(self, schema: dict) -> None:
+        """POST /schema: idempotent create of indexes+fields (reference
+        api.go ApplySchema)."""
+        for idx_def in schema.get("indexes", []):
+            idx = self.holder.create_index_if_not_exists(
+                idx_def["name"],
+                IndexOptions(
+                    keys=idx_def.get("options", {}).get("keys", False),
+                    track_existence=idx_def.get("options", {}).get("trackExistence", True),
+                ),
+            )
+            for f_def in idx_def.get("fields", []):
+                if idx.field(f_def["name"]) is None:
+                    idx.create_field(f_def["name"], self._field_options(f_def.get("options", {})))
+
+    # -- imports -----------------------------------------------------------
+
+    def import_bits(
+        self,
+        index: str,
+        field: str,
+        row_ids: list[int],
+        column_ids: list[int],
+        row_keys: Optional[list[str]] = None,
+        column_keys: Optional[list[str]] = None,
+        timestamps: Optional[list[int]] = None,
+        clear: bool = False,
+    ) -> None:
+        """reference api.go Import :920 (key translation + existence)."""
+        self._validate_state("Import")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        if column_keys:
+            if idx.translate_store is None:
+                raise APIError("index does not use string keys")
+            column_ids = [idx.translate_store.translate_key(k) for k in column_keys]
+        if row_keys:
+            if f.translate_store is None:
+                raise APIError("field does not use string keys")
+            row_ids = [f.translate_store.translate_key(k) for k in row_keys]
+        rows = np.asarray(row_ids, dtype=np.uint64)
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        ts = None
+        if timestamps and any(timestamps):
+            ts = [parse_time(t) if t else None for t in timestamps]
+        try:
+            f.import_bits(rows, cols, timestamps=ts, clear=clear)
+        except ValueError as e:
+            raise APIError(str(e)) from e
+        ef = idx.existence_field()
+        if ef is not None and not clear and cols.size:
+            ef.import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
+
+    def import_values(
+        self,
+        index: str,
+        field: str,
+        column_ids: list[int],
+        values: list[int],
+        column_keys: Optional[list[str]] = None,
+        clear: bool = False,
+    ) -> None:
+        self._validate_state("ImportValue")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        if column_keys:
+            if idx.translate_store is None:
+                raise APIError("index does not use string keys")
+            column_ids = [idx.translate_store.translate_key(k) for k in column_keys]
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        try:
+            f.import_value(cols, np.asarray(values, dtype=np.int64), clear=clear)
+        except ValueError as e:
+            raise APIError(str(e)) from e
+        ef = idx.existence_field()
+        if ef is not None and not clear and cols.size:
+            ef.import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
+
+    def import_roaring(
+        self, index: str, field: str, shard: int, views: dict[str, bytes], clear: bool = False
+    ) -> None:
+        """reference api.go ImportRoaring :368."""
+        self._validate_state("ImportRoaring")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        for view_name, data in views.items():
+            name = view_name or "standard"
+            try:
+                f.import_roaring(shard, data, view_name=name, clear=clear)
+            except ValueError as e:
+                raise APIError(str(e)) from e
+
+    # -- info --------------------------------------------------------------
+
+    def status(self) -> dict:
+        nodes = (
+            self.cluster.nodes_json()
+            if self.cluster is not None
+            else [{"id": "local",
+                   "uri": {"scheme": "http", "host": self.local_host, "port": self.local_port},
+                   "isCoordinator": True, "state": "READY"}]
+        )
+        return {
+            "state": self.cluster.state() if self.cluster is not None else "NORMAL",
+            "nodes": nodes,
+            "localID": self.cluster.node_id if self.cluster is not None else "local",
+        }
+
+    def info(self) -> dict:
+        import os
+
+        return {
+            "shardWidth": SHARD_WIDTH,
+            "cpuPhysicalCores": os.cpu_count(),
+            "cpuLogicalCores": os.cpu_count(),
+        }
+
+    def max_shards(self) -> dict:
+        out = {}
+        for name in self.holder.indexes:
+            idx = self.holder.index(name)
+            av = idx.available_shards()
+            out[name] = int(av.max()) if av.any() else 0
+        return {"standard": out}
+
+    def recalculate_caches(self) -> None:
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        frag.cache.invalidate()
+
+    def export_csv(self, index: str, field: str, shard: int) -> str:
+        """reference handler.go handleGetExport / ctl/export.go."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        v = f.view("standard")
+        frag = v.fragment(shard) if v is not None else None
+        if frag is None:
+            return ""
+        lines = []
+        frag.for_each_bit(lambda r, c: lines.append(f"{r},{c}"))
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,155 @@
+"""Server configuration (reference server/config.go:48 Config).
+
+Three sources, lowest to highest precedence: TOML file, environment
+variables (PILOSA_TPU_*), command-line flags — same layering as the
+reference's viper/pflag stack (reference docs/configuration.md:20-34).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ClusterConfig:
+    coordinator: bool = False
+    replicas: int = 1
+    hosts: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa-tpu"
+    bind: str = "localhost:10101"
+    executor: str = "tpu"  # tpu | cpu  (the --executor=tpu switch)
+    max_writes_per_request: int = 5000
+    log_path: str = ""
+    verbose: bool = False
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy_interval: float = 600.0  # seconds (reference: 10m)
+    metric_service: str = "memory"  # memory | none
+    tracing: bool = False
+    long_query_time: float = 0.0
+
+    def _split_bind(self) -> tuple[str, int]:
+        """Handles host:port, :port, bare host, [v6]:port, and bare IPv6."""
+        b = self.bind
+        if b.startswith("["):  # [::1]:10101
+            host, _, rest = b[1:].partition("]")
+            port = int(rest[1:]) if rest.startswith(":") and rest[1:] else 10101
+            return host or "localhost", port
+        if b.count(":") > 1:  # bare IPv6 address, no port
+            return b, 10101
+        host, _, port_s = b.partition(":")
+        return host or "localhost", int(port_s) if port_s else 10101
+
+    @property
+    def host(self) -> str:
+        return self._split_bind()[0]
+
+    @property
+    def port(self) -> int:
+        return self._split_bind()[1]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "data-dir": self.data_dir,
+            "bind": self.bind,
+            "executor": self.executor,
+            "max-writes-per-request": self.max_writes_per_request,
+            "log-path": self.log_path,
+            "verbose": self.verbose,
+            "anti-entropy": {"interval": self.anti_entropy_interval},
+            "metric": {"service": self.metric_service},
+            "cluster": {
+                "coordinator": self.cluster.coordinator,
+                "replicas": self.cluster.replicas,
+                "hosts": self.cluster.hosts,
+            },
+            "long-query-time": self.long_query_time,
+        }
+
+    @staticmethod
+    def from_sources(
+        toml_path: Optional[str] = None, env: Optional[dict] = None, args: Optional[dict] = None
+    ) -> "Config":
+        cfg = Config()
+        if toml_path:
+            with open(toml_path, "rb") as f:
+                data = tomllib.load(f)
+            cfg._apply_toml(data)
+        cfg._apply_env(env if env is not None else dict(os.environ))
+        if args:
+            for k, v in args.items():
+                if v is not None and hasattr(cfg, k):
+                    setattr(cfg, k, v)
+        return cfg
+
+    def _apply_toml(self, data: dict) -> None:
+        simple = {
+            "data-dir": "data_dir",
+            "bind": "bind",
+            "executor": "executor",
+            "max-writes-per-request": "max_writes_per_request",
+            "log-path": "log_path",
+            "verbose": "verbose",
+            "long-query-time": "long_query_time",
+        }
+        for k, attr in simple.items():
+            if k in data:
+                setattr(self, attr, data[k])
+        if "anti-entropy" in data and "interval" in data["anti-entropy"]:
+            self.anti_entropy_interval = float(data["anti-entropy"]["interval"])
+        if "metric" in data and "service" in data["metric"]:
+            self.metric_service = data["metric"]["service"]
+        c = data.get("cluster", {})
+        self.cluster.coordinator = c.get("coordinator", self.cluster.coordinator)
+        self.cluster.replicas = c.get("replicas", self.cluster.replicas)
+        self.cluster.hosts = c.get("hosts", self.cluster.hosts)
+
+    def _apply_env(self, env: dict) -> None:
+        pre = "PILOSA_TPU_"
+        mapping = {
+            pre + "DATA_DIR": ("data_dir", str),
+            pre + "BIND": ("bind", str),
+            pre + "EXECUTOR": ("executor", str),
+            pre + "VERBOSE": ("verbose", lambda v: v.lower() in ("1", "true")),
+            pre + "CLUSTER_COORDINATOR": (
+                "cluster.coordinator",
+                lambda v: v.lower() in ("1", "true"),
+            ),
+            pre + "CLUSTER_REPLICAS": ("cluster.replicas", int),
+            pre + "CLUSTER_HOSTS": ("cluster.hosts", lambda v: v.split(",") if v else []),
+            pre + "ANTI_ENTROPY_INTERVAL": ("anti_entropy_interval", float),
+        }
+        for key, (attr, conv) in mapping.items():
+            if key in env:
+                value = conv(env[key])
+                if "." in attr:
+                    obj_name, sub = attr.split(".")
+                    setattr(getattr(self, obj_name), sub, value)
+                else:
+                    setattr(self, attr, value)
+
+    def toml_text(self) -> str:
+        """generate-config output (reference ctl/generate_config.go)."""
+        c = self
+        return (
+            f'data-dir = "{c.data_dir}"\n'
+            f'bind = "{c.bind}"\n'
+            f'executor = "{c.executor}"\n'
+            f"max-writes-per-request = {c.max_writes_per_request}\n"
+            f"verbose = {str(c.verbose).lower()}\n"
+            f"long-query-time = {c.long_query_time}\n"
+            "\n[anti-entropy]\n"
+            f"interval = {c.anti_entropy_interval}\n"
+            "\n[metric]\n"
+            f'service = "{c.metric_service}"\n'
+            "\n[cluster]\n"
+            f"coordinator = {str(c.cluster.coordinator).lower()}\n"
+            f"replicas = {c.cluster.replicas}\n"
+            f"hosts = {c.cluster.hosts!r}\n".replace("'", '"')
+        )

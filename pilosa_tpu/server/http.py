@@ -1,0 +1,444 @@
+"""HTTP layer: the reference's public route table on stdlib http.server.
+
+Routes mirror reference http/handler.go:274-330 (public + /internal peer
+endpoints). JSON in/out like the reference's handler; import endpoints
+accept the protobuf wire format (Content-Type application/x-protobuf,
+reference http/handler.go handlePostImport) and JSON for convenience.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu import __version__
+from pilosa_tpu.server.api import API, APIError
+from pilosa_tpu.server.wire import (
+    ImportRequest,
+    ImportRoaringRequest,
+    ImportValueRequest,
+    QueryRequest,
+)
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = []
+
+
+def route(method: str, pattern: str):
+    compiled = re.compile("^" + pattern + "$")
+
+    def deco(fn):
+        _ROUTES.append((method, compiled, fn.__name__))
+        return fn
+
+    return deco
+
+
+class Server:
+    """Owns the API + the listening socket (reference server.go Server)."""
+
+    def __init__(self, api: API, host: str = "localhost", port: int = 10101):
+        self.api = api
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _bind(self) -> None:
+        api = self.api
+
+        class Handler(_Handler):
+            pass
+
+        Handler.api = api
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        api.local_host, api.local_port = self.host, self.port
+
+    def open(self) -> "Server":
+        self._bind()
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def serve_forever(self) -> None:
+        """Foreground mode for the CLI."""
+        self._bind()
+        self._httpd.serve_forever()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: API  # injected per-server subclass
+    protocol_version = "HTTP/1.1"
+
+    # quiet default logging
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _json_body(self) -> dict:
+        return self._json_body_from(self._body())
+
+    @staticmethod
+    def _json_body_from(raw: bytes) -> dict:
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise APIError(f"invalid JSON body: {e}") from e
+
+    def _reply(self, obj: Any, status: int = 200, content_type: str = "application/json") -> None:
+        if content_type == "application/json":
+            data = (json.dumps(obj) + "\n").encode()
+        elif isinstance(obj, bytes):
+            data = obj
+        else:
+            data = str(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, msg: str, status: int = 400) -> None:
+        self._reply({"error": msg}, status=status)
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        path = parsed.path
+        self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        for m, pattern, fn_name in _ROUTES:
+            if m != method:
+                continue
+            match = pattern.match(path)
+            if match:
+                try:
+                    getattr(self, fn_name)(**match.groupdict())
+                except APIError as e:
+                    self._error(str(e), status=e.status)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # mirror the reference's panic trap
+                    self._error(f"PANIC: {e}\n{traceback.format_exc()}", status=500)
+                return
+        self._error("not found", status=404)
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+    # -- public routes (reference http/handler.go:276-304) -----------------
+
+    @route("GET", r"/")
+    def handle_home(self):
+        self._reply({"pilosa-tpu": __version__})
+
+    @route("GET", r"/version")
+    def handle_version(self):
+        self._reply({"version": __version__})
+
+    @route("GET", r"/info")
+    def handle_info(self):
+        self._reply(self.api.info())
+
+    @route("GET", r"/status")
+    def handle_status(self):
+        self._reply(self.api.status())
+
+    @route("GET", r"/schema")
+    def handle_get_schema(self):
+        self._reply(self.api.schema())
+
+    @route("POST", r"/schema")
+    def handle_post_schema(self):
+        self.api.apply_schema(self._json_body())
+        self._reply({"success": True})
+
+    @route("GET", r"/index")
+    def handle_get_indexes(self):
+        self._reply(self.api.schema())
+
+    @route("GET", r"/index/(?P<index>[^/]+)")
+    def handle_get_index(self, index):
+        idx = self.api.holder.index(index)
+        if idx is None:
+            self._error(f"index not found: {index}", status=404)
+            return
+        self._reply({"name": index, "options": idx.options.to_dict()})
+
+    @route("POST", r"/index/(?P<index>[^/]+)/?")
+    def handle_post_index(self, index):
+        body = self._json_body()
+        out = self.api.create_index(index, body.get("options", {}))
+        self._reply(out)
+
+    @route("DELETE", r"/index/(?P<index>[^/]+)")
+    def handle_delete_index(self, index):
+        self.api.delete_index(index)
+        self._reply({"success": True})
+
+    @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/?")
+    def handle_post_field(self, index, field):
+        body = self._json_body()
+        out = self.api.create_field(index, field, body.get("options", {}))
+        self._reply(out)
+
+    @route("DELETE", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)")
+    def handle_delete_field(self, index, field):
+        self.api.delete_field(index, field)
+        self._reply({"success": True})
+
+    @route("POST", r"/index/(?P<index>[^/]+)/query")
+    def handle_post_query(self, index):
+        body = self._body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype == "application/x-protobuf":
+            req = QueryRequest.from_bytes(body)
+            query = req.query
+            shards = req.shards or None
+            column_attrs = req.column_attrs
+            exclude_row_attrs = req.exclude_row_attrs
+            exclude_columns = req.exclude_columns
+            remote = req.remote
+        else:
+            try:
+                query = body.decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise APIError(f"query body is not valid UTF-8: {e}") from e
+            shards = None
+            if "shards" in self.query:
+                shards = [int(s) for s in self.query["shards"].split(",")]
+            column_attrs = self.query.get("columnAttrs") == "true"
+            exclude_row_attrs = self.query.get("excludeRowAttrs") == "true"
+            exclude_columns = self.query.get("excludeColumns") == "true"
+            remote = self.query.get("remote") == "true"
+        out = self.api.query(
+            index,
+            query,
+            shards=shards,
+            column_attrs=column_attrs,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns,
+            remote=remote,
+        )
+        self._reply(out)
+
+    @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
+    def handle_post_import(self, index, field):
+        body = self._body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        clear = self.query.get("clear") == "true"
+        if ctype == "application/x-protobuf":
+            # Value import is signaled by the field type on the wire level
+            # in the reference client; sniff by field schema.
+            idx = self.api.holder.index(index)
+            f = idx.field(field) if idx else None
+            if f is not None and f.options.type == "int":
+                req = ImportValueRequest.from_bytes(body)
+                self.api.import_values(
+                    index, field, req.column_ids, req.values,
+                    column_keys=req.column_keys or None, clear=clear,
+                )
+            else:
+                req = ImportRequest.from_bytes(body)
+                self.api.import_bits(
+                    index, field, req.row_ids, req.column_ids,
+                    row_keys=req.row_keys or None,
+                    column_keys=req.column_keys or None,
+                    timestamps=req.timestamps or None, clear=clear,
+                )
+        else:
+            payload = self._json_body_from(body)
+            if "values" in payload:
+                self.api.import_values(
+                    index, field,
+                    payload.get("columnIDs", []), payload.get("values", []),
+                    column_keys=payload.get("columnKeys"), clear=clear,
+                )
+            else:
+                self.api.import_bits(
+                    index, field,
+                    payload.get("rowIDs", []), payload.get("columnIDs", []),
+                    row_keys=payload.get("rowKeys"),
+                    column_keys=payload.get("columnKeys"),
+                    timestamps=payload.get("timestamps"), clear=clear,
+                )
+        self._reply({"success": True})
+
+    @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>\d+)")
+    def handle_post_import_roaring(self, index, field, shard):
+        body = self._body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype == "application/x-protobuf":
+            req = ImportRoaringRequest.from_bytes(body)
+            views = {v.name: v.data for v in req.views}
+            clear = req.clear
+        else:
+            payload = self._json_body_from(body)
+            import base64
+
+            views = {
+                k: base64.b64decode(v) for k, v in payload.get("views", {}).items()
+            }
+            clear = bool(payload.get("clear", False))
+        self.api.import_roaring(index, field, int(shard), views, clear=clear)
+        self._reply({"success": True})
+
+    @route("GET", r"/export")
+    def handle_get_export(self):
+        index = self.query.get("index", "")
+        field = self.query.get("field", "")
+        shard = int(self.query.get("shard", "0"))
+        csv = self.api.export_csv(index, field, shard)
+        self._reply(csv, content_type="text/csv")
+
+    @route("POST", r"/recalculate-caches")
+    def handle_recalculate_caches(self):
+        self.api.recalculate_caches()
+        self._reply({"success": True})
+
+    @route("GET", r"/metrics")
+    def handle_metrics(self):
+        from pilosa_tpu.utils.stats import global_stats
+
+        self._reply(global_stats.prometheus_text(), content_type="text/plain; version=0.0.4")
+
+    # -- internal routes (reference http/handler.go:307-318) ---------------
+
+    @route("GET", r"/internal/shards/max")
+    def handle_get_shards_max(self):
+        self._reply(self.api.max_shards())
+
+    @route("GET", r"/internal/nodes")
+    def handle_get_nodes(self):
+        self._reply(self.api.status()["nodes"])
+
+    @route("GET", r"/internal/fragment/nodes")
+    def handle_get_fragment_nodes(self):
+        index = self.query.get("index", "")
+        shard = int(self.query.get("shard", "0"))
+        if self.api.cluster is None:
+            self._reply(self.api.status()["nodes"])
+            return
+        self._reply(self.api.cluster.shard_nodes_json(index, shard))
+
+    @route("GET", r"/internal/fragment/data")
+    def handle_get_fragment_data(self):
+        index = self.query.get("index", "")
+        field = self.query.get("field", "")
+        view = self.query.get("view", "standard")
+        shard = int(self.query.get("shard", "0"))
+        idx = self.api.holder.index(index)
+        f = idx.field(field) if idx else None
+        v = f.view(view) if f else None
+        frag = v.fragment(shard) if v else None
+        if frag is None:
+            self._error("fragment not found", status=404)
+            return
+        from pilosa_tpu.roaring import serialize
+
+        self._reply(serialize(frag.storage), content_type="application/octet-stream")
+
+    @route("GET", r"/internal/fragment/blocks")
+    def handle_get_fragment_blocks(self):
+        index = self.query.get("index", "")
+        field = self.query.get("field", "")
+        view = self.query.get("view", "standard")
+        shard = int(self.query.get("shard", "0"))
+        idx = self.api.holder.index(index)
+        f = idx.field(field) if idx else None
+        v = f.view(view) if f else None
+        frag = v.fragment(shard) if v else None
+        if frag is None:
+            self._error("fragment not found", status=404)
+            return
+        blocks = [{"id": b, "checksum": str(c)} for b, c in frag.checksum_blocks()]
+        self._reply({"blocks": blocks})
+
+    @route("GET", r"/internal/fragment/block/data")
+    def handle_get_fragment_block_data(self):
+        index = self.query.get("index", "")
+        field = self.query.get("field", "")
+        view = self.query.get("view", "standard")
+        shard = int(self.query.get("shard", "0"))
+        block = int(self.query.get("block", "0"))
+        idx = self.api.holder.index(index)
+        f = idx.field(field) if idx else None
+        v = f.view(view) if f else None
+        frag = v.fragment(shard) if v else None
+        if frag is None:
+            self._error("fragment not found", status=404)
+            return
+        self._reply(frag.block_data(block), content_type="application/octet-stream")
+
+    @route("POST", r"/internal/cluster/message")
+    def handle_post_cluster_message(self):
+        if self.api.cluster is None:
+            self._error("not clustered", status=400)
+            return
+        self.api.cluster.receive_message(self._body())
+        self._reply({"success": True})
+
+    @route("POST", r"/internal/translate/keys")
+    def handle_post_translate_keys(self):
+        body = self._json_body()
+        index = body.get("index", "")
+        field = body.get("field", "")
+        keys = body.get("keys", [])
+        idx = self.api.holder.index(index)
+        if idx is None:
+            self._error(f"index not found: {index}", status=404)
+            return
+        if field:
+            f = idx.field(field)
+            store = f.translate_store if f else None
+        else:
+            store = idx.translate_store
+        if store is None:
+            self._error("no translate store", status=400)
+            return
+        self._reply({"ids": [store.translate_key(k) for k in keys]})
+
+    @route("GET", r"/internal/translate/data")
+    def handle_get_translate_data(self):
+        index = self.query.get("index", "")
+        field = self.query.get("field", "")
+        since = int(self.query.get("offset", "0"))
+        idx = self.api.holder.index(index)
+        if idx is None:
+            self._error(f"index not found: {index}", status=404)
+            return
+        store = idx.translate_store
+        if field:
+            f = idx.field(field)
+            store = f.translate_store if f else None
+        if store is None:
+            self._error("no translate store", status=400)
+            return
+        self._reply({"entries": store.entries_since(since)})

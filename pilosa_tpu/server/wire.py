@@ -1,0 +1,311 @@
+"""Minimal protobuf wire codec for the import/query messages.
+
+Implements just the varint/length-delimited subset the reference's wire
+contract needs (field numbers from reference internal/public.proto:57-122;
+gogo-protobuf on the Go side). Hand-rolled instead of protoc-generated so
+the framework stays dependency-light; the wire format is the compat
+surface, not the codegen.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Iterator, Optional
+
+
+def _encode_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _decode_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def _iter_fields(data: bytes) -> Iterator[tuple[int, int, object]]:
+    """Yields (field_number, wire_type, value)."""
+    pos = 0
+    while pos < len(data):
+        tag, pos = _decode_varint(data, pos)
+        fnum, wtype = tag >> 3, tag & 7
+        if wtype == 0:  # varint
+            v, pos = _decode_varint(data, pos)
+            yield fnum, wtype, v
+        elif wtype == 2:  # length-delimited
+            ln, pos = _decode_varint(data, pos)
+            yield fnum, wtype, data[pos : pos + ln]
+            pos += ln
+        elif wtype == 1:  # 64-bit
+            yield fnum, wtype, data[pos : pos + 8]
+            pos += 8
+        elif wtype == 5:  # 32-bit
+            yield fnum, wtype, data[pos : pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+
+
+def _repeated_uint64(value, wtype) -> list[int]:
+    """Handles both packed and unpacked repeated uint64."""
+    if wtype == 0:
+        return [value]
+    out = []
+    pos = 0
+    while pos < len(value):
+        v, pos = _decode_varint(value, pos)
+        out.append(v)
+    return out
+
+
+def _zigzag_decode(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _signed(v: int) -> int:
+    """int64 fields are two's-complement varints (not zigzag)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _field_str(v: bytes) -> str:
+    return v.decode("utf-8")
+
+
+def _encode_tag(fnum: int, wtype: int) -> bytes:
+    return _encode_varint((fnum << 3) | wtype)
+
+
+def _encode_string(fnum: int, s: str) -> bytes:
+    b = s.encode("utf-8")
+    return _encode_tag(fnum, 2) + _encode_varint(len(b)) + b
+
+
+def _encode_bytes(fnum: int, b: bytes) -> bytes:
+    return _encode_tag(fnum, 2) + _encode_varint(len(b)) + b
+
+
+def _encode_packed_uint64(fnum: int, vals) -> bytes:
+    if not len(vals):
+        return b""
+    body = b"".join(_encode_varint(int(v)) for v in vals)
+    return _encode_tag(fnum, 2) + _encode_varint(len(body)) + body
+
+
+def _encode_packed_int64(fnum: int, vals) -> bytes:
+    if not len(vals):
+        return b""
+    body = b"".join(_encode_varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in vals)
+    return _encode_tag(fnum, 2) + _encode_varint(len(body)) + body
+
+
+def _encode_uint64(fnum: int, v: int) -> bytes:
+    return _encode_tag(fnum, 0) + _encode_varint(v)
+
+
+def _encode_bool(fnum: int, v: bool) -> bytes:
+    return _encode_tag(fnum, 0) + _encode_varint(1 if v else 0)
+
+
+# ---------------------------------------------------------------------------
+# Messages (field numbers from reference internal/public.proto)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ImportRequest:
+    """reference internal/public.proto:84."""
+
+    index: str = ""
+    field: str = ""
+    shard: int = 0
+    row_ids: list[int] = dc_field(default_factory=list)
+    column_ids: list[int] = dc_field(default_factory=list)
+    row_keys: list[str] = dc_field(default_factory=list)
+    column_keys: list[str] = dc_field(default_factory=list)
+    timestamps: list[int] = dc_field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        out = b""
+        if self.index:
+            out += _encode_string(1, self.index)
+        if self.field:
+            out += _encode_string(2, self.field)
+        if self.shard:
+            out += _encode_uint64(3, self.shard)
+        out += _encode_packed_uint64(4, self.row_ids)
+        out += _encode_packed_uint64(5, self.column_ids)
+        out += _encode_packed_int64(6, self.timestamps)
+        for k in self.row_keys:
+            out += _encode_string(7, k)
+        for k in self.column_keys:
+            out += _encode_string(8, k)
+        return out
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "ImportRequest":
+        m = ImportRequest()
+        for fnum, wtype, v in _iter_fields(data):
+            if fnum == 1:
+                m.index = _field_str(v)
+            elif fnum == 2:
+                m.field = _field_str(v)
+            elif fnum == 3:
+                m.shard = v
+            elif fnum == 4:
+                m.row_ids.extend(_repeated_uint64(v, wtype))
+            elif fnum == 5:
+                m.column_ids.extend(_repeated_uint64(v, wtype))
+            elif fnum == 6:
+                m.timestamps.extend(_signed(x) for x in _repeated_uint64(v, wtype))
+            elif fnum == 7:
+                m.row_keys.append(_field_str(v))
+            elif fnum == 8:
+                m.column_keys.append(_field_str(v))
+        return m
+
+
+@dataclass
+class ImportValueRequest:
+    """reference internal/public.proto:95."""
+
+    index: str = ""
+    field: str = ""
+    shard: int = 0
+    column_ids: list[int] = dc_field(default_factory=list)
+    column_keys: list[str] = dc_field(default_factory=list)
+    values: list[int] = dc_field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        out = b""
+        if self.index:
+            out += _encode_string(1, self.index)
+        if self.field:
+            out += _encode_string(2, self.field)
+        if self.shard:
+            out += _encode_uint64(3, self.shard)
+        out += _encode_packed_uint64(5, self.column_ids)
+        out += _encode_packed_int64(6, self.values)
+        for k in self.column_keys:
+            out += _encode_string(7, k)
+        return out
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "ImportValueRequest":
+        m = ImportValueRequest()
+        for fnum, wtype, v in _iter_fields(data):
+            if fnum == 1:
+                m.index = _field_str(v)
+            elif fnum == 2:
+                m.field = _field_str(v)
+            elif fnum == 3:
+                m.shard = v
+            elif fnum == 5:
+                m.column_ids.extend(_repeated_uint64(v, wtype))
+            elif fnum == 6:
+                m.values.extend(_signed(x) for x in _repeated_uint64(v, wtype))
+            elif fnum == 7:
+                m.column_keys.append(_field_str(v))
+        return m
+
+
+@dataclass
+class ImportRoaringRequestView:
+    name: str = ""
+    data: bytes = b""
+
+
+@dataclass
+class ImportRoaringRequest:
+    """reference internal/public.proto:119."""
+
+    clear: bool = False
+    views: list[ImportRoaringRequestView] = dc_field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        out = b""
+        if self.clear:
+            out += _encode_bool(1, True)
+        for v in self.views:
+            body = b""
+            if v.name:
+                body += _encode_string(1, v.name)
+            body += _encode_bytes(2, v.data)
+            out += _encode_bytes(2, body)
+        return out
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "ImportRoaringRequest":
+        m = ImportRoaringRequest()
+        for fnum, wtype, v in _iter_fields(data):
+            if fnum == 1:
+                m.clear = bool(v)
+            elif fnum == 2:
+                view = ImportRoaringRequestView()
+                for f2, w2, v2 in _iter_fields(v):
+                    if f2 == 1:
+                        view.name = _field_str(v2)
+                    elif f2 == 2:
+                        view.data = v2
+                m.views.append(view)
+        return m
+
+
+@dataclass
+class QueryRequest:
+    """reference internal/public.proto:57."""
+
+    query: str = ""
+    shards: list[int] = dc_field(default_factory=list)
+    column_attrs: bool = False
+    remote: bool = False
+    exclude_row_attrs: bool = False
+    exclude_columns: bool = False
+
+    def to_bytes(self) -> bytes:
+        out = _encode_string(1, self.query)
+        out += _encode_packed_uint64(2, self.shards)
+        if self.column_attrs:
+            out += _encode_bool(3, True)
+        if self.remote:
+            out += _encode_bool(5, True)
+        if self.exclude_row_attrs:
+            out += _encode_bool(6, True)
+        if self.exclude_columns:
+            out += _encode_bool(7, True)
+        return out
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "QueryRequest":
+        m = QueryRequest()
+        for fnum, wtype, v in _iter_fields(data):
+            if fnum == 1:
+                m.query = _field_str(v)
+            elif fnum == 2:
+                m.shards.extend(_repeated_uint64(v, wtype))
+            elif fnum == 3:
+                m.column_attrs = bool(v)
+            elif fnum == 5:
+                m.remote = bool(v)
+            elif fnum == 6:
+                m.exclude_row_attrs = bool(v)
+            elif fnum == 7:
+                m.exclude_columns = bool(v)
+        return m

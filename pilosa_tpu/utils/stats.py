@@ -1,0 +1,150 @@
+"""Stats clients (reference stats/stats.go:31 StatsClient interface).
+
+Backends: in-memory (serves /metrics in prometheus text format, replacing
+the reference's prometheus/ and expvar backends), and nop. Tag scoping via
+with_tags mirrors the reference's per-index/field tagging.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional, Sequence
+
+
+class StatsClient:
+    """In-memory counters/gauges/timers with prometheus text export."""
+
+    def __init__(self, tags: Optional[Sequence[str]] = None, _root: Optional["StatsClient"] = None):
+        self.tags = tuple(sorted(tags or ()))
+        root = _root or self
+        self._root = root
+        if _root is None:
+            self._lock = threading.Lock()
+            self._counters: dict[tuple, float] = defaultdict(float)
+            self._gauges: dict[tuple, float] = {}
+            self._timings: dict[tuple, list[float]] = defaultdict(list)
+            # Monotonic count/sum per timing series — the exported
+            # prometheus counters; the samples list is only for quantiles
+            # and may be trimmed.
+            self._timing_totals: dict[tuple, tuple[int, float]] = defaultdict(
+                lambda: (0, 0.0)
+            )
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        child = StatsClient(self.tags + tuple(tags), _root=self._root)
+        return child
+
+    def _key(self, name: str) -> tuple:
+        return (name, self.tags)
+
+    def count(self, name: str, value: float = 1, rate: float = 1.0) -> None:
+        r = self._root
+        with r._lock:
+            r._counters[self._key(name)] += value
+
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
+        r = self._root
+        with r._lock:
+            r._gauges[self._key(name)] = value
+
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
+        r = self._root
+        key = self._key(name)
+        with r._lock:
+            samples = r._timings[key]
+            samples.append(value)
+            if len(samples) > 1024:
+                del samples[:512]
+            n, total = r._timing_totals[key]
+            r._timing_totals[key] = (n + 1, total + value)
+
+    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+        self.timing(name, value, rate)
+
+    class _Timer:
+        def __init__(self, client: "StatsClient", name: str):
+            self.client = client
+            self.name = name
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.client.timing(self.name, time.perf_counter() - self.t0)
+
+    def timer(self, name: str) -> "_Timer":
+        return self._Timer(self, name)
+
+    @staticmethod
+    def _fmt_tags(tags: tuple) -> str:
+        if not tags:
+            return ""
+        pairs = []
+        for t in tags:
+            if ":" in t:
+                k, v = t.split(":", 1)
+            else:
+                k, v = t, "true"
+            pairs.append(f'{k}="{v}"')
+        return "{" + ",".join(pairs) + "}"
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format for /metrics (reference
+        prometheus/prometheus.go backend + /metrics route)."""
+        r = self._root
+        out = []
+        with r._lock:
+            for (name, tags), v in sorted(r._counters.items()):
+                metric = "pilosa_" + name.replace(".", "_").replace("-", "_")
+                out.append(f"{metric}{self._fmt_tags(tags)} {v}")
+            for (name, tags), v in sorted(r._gauges.items()):
+                metric = "pilosa_" + name.replace(".", "_").replace("-", "_")
+                out.append(f"{metric}{self._fmt_tags(tags)} {v}")
+            for (name, tags), samples in sorted(r._timings.items()):
+                if not samples:
+                    continue
+                metric = "pilosa_" + name.replace(".", "_").replace("-", "_")
+                s = sorted(samples)
+                n, total = r._timing_totals[(name, tags)]
+                out.append(f"{metric}_count{self._fmt_tags(tags)} {n}")
+                out.append(f"{metric}_sum{self._fmt_tags(tags)} {total}")
+                p50 = s[len(s) // 2]
+                p99 = s[min(len(s) - 1, int(len(s) * 0.99))]
+                out.append(f'{metric}_p50{self._fmt_tags(tags)} {p50}')
+                out.append(f'{metric}_p99{self._fmt_tags(tags)} {p99}')
+        return "\n".join(out) + "\n"
+
+
+class NopStatsClient:
+    """reference stats/stats.go:69 NopStatsClient."""
+
+    tags: tuple = ()
+
+    def with_tags(self, *tags):
+        return self
+
+    def count(self, name, value=1, rate=1.0):
+        pass
+
+    def gauge(self, name, value, rate=1.0):
+        pass
+
+    def timing(self, name, value, rate=1.0):
+        pass
+
+    def histogram(self, name, value, rate=1.0):
+        pass
+
+    def timer(self, name):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def prometheus_text(self):
+        return "\n"
+
+
+global_stats = StatsClient()

@@ -1,0 +1,129 @@
+"""Vendor-neutral tracing seam (reference tracing/tracing.go:22-50).
+
+A global Tracer with start_span(); spans carry cross-node context via HTTP
+headers (inject/extract), exactly the reference's shape. The default
+in-memory tracer records recent spans for /debug inspection; jax.profiler
+traces can be layered per query by the TPU backend in a later round.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+
+class Span:
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str, parent_id: Optional[str]):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.t0 = time.perf_counter()
+        self.tags: dict = {}
+        self.duration = None
+
+    def set_tag(self, k, v) -> "Span":
+        self.tags[k] = v
+        return self
+
+    def finish(self) -> None:
+        self.duration = time.perf_counter() - self.t0
+        self.tracer._record(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+
+    def inject_headers(self) -> dict[str, str]:
+        """Cross-node propagation (reference tracing.go:36-40)."""
+        return {"X-Trace-Id": self.trace_id, "X-Span-Id": self.span_id}
+
+
+class Tracer:
+    """In-memory ring of recent spans."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = capacity
+        self._spans: list[Span] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        return stack
+
+    def start_span(self, name: str, headers: Optional[dict] = None) -> Span:
+        trace_id = None
+        parent_id = None
+        if headers:
+            trace_id = headers.get("X-Trace-Id")
+            parent_id = headers.get("X-Span-Id")
+        stack = self._stack()
+        if trace_id is None and stack:
+            trace_id = stack[-1].trace_id
+            parent_id = stack[-1].span_id
+        if trace_id is None:
+            trace_id = uuid.uuid4().hex
+        span = Span(self, name, trace_id, parent_id)
+        stack.append(span)
+        return span
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self.capacity:
+                del self._spans[: self.capacity // 2]
+        # Pop back to the parent so sibling spans keep the trace context.
+        stack = self._stack()
+        if span in stack:
+            stack.remove(span)
+
+    def recent(self, n: int = 50) -> list[dict]:
+        with self._lock:
+            spans = self._spans[-n:]
+        return [
+            {
+                "name": s.name,
+                "traceID": s.trace_id,
+                "spanID": s.span_id,
+                "parentID": s.parent_id,
+                "duration": s.duration,
+                "tags": s.tags,
+            }
+            for s in spans
+        ]
+
+
+class NopTracer:
+    class _NopSpan:
+        def set_tag(self, k, v):
+            return self
+
+        def finish(self):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            pass
+
+        def inject_headers(self):
+            return {}
+
+    def start_span(self, name: str, headers=None):
+        return self._NopSpan()
+
+    def recent(self, n: int = 50):
+        return []
+
+
+global_tracer = Tracer()

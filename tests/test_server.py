@@ -1,0 +1,250 @@
+"""HTTP server + API tests: drive the real socket surface with urllib,
+mirroring the reference's http/handler_test.go + api_test.go coverage."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import Server
+from pilosa_tpu.server.wire import (
+    ImportRequest,
+    ImportRoaringRequest,
+    ImportRoaringRequestView,
+    ImportValueRequest,
+    QueryRequest,
+)
+
+
+@pytest.fixture
+def server(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    srv = Server(API(holder, Executor(holder)), host="localhost", port=0).open()
+    yield srv
+    srv.close()
+    holder.close()
+
+
+def req(srv, method, path, body=None, ctype="application/json", raw=False):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(
+        srv.uri + path, data=data, method=method, headers={"Content-Type": ctype}
+    )
+    resp = urllib.request.urlopen(r)
+    payload = resp.read()
+    return payload if raw else json.loads(payload)
+
+
+class TestSchemaRoutes:
+    def test_crud(self, server):
+        out = req(server, "POST", "/index/myidx", {"options": {"trackExistence": True}})
+        assert out["name"] == "myidx"
+        out = req(server, "POST", "/index/myidx/field/f", {})
+        assert out["name"] == "f"
+        schema = req(server, "GET", "/schema")
+        assert schema["indexes"][0]["name"] == "myidx"
+        assert schema["indexes"][0]["fields"][0]["name"] == "f"
+        out = req(server, "GET", "/index/myidx")
+        assert out["name"] == "myidx"
+        req(server, "DELETE", "/index/myidx/field/f")
+        req(server, "DELETE", "/index/myidx")
+        assert req(server, "GET", "/schema") == {"indexes": []}
+
+    def test_conflict_and_missing(self, server):
+        req(server, "POST", "/index/i", {})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(server, "POST", "/index/i", {})
+        assert e.value.code == 409
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(server, "DELETE", "/index/nope")
+        assert e.value.code == 404
+
+    def test_int_field_options(self, server):
+        req(server, "POST", "/index/i", {})
+        out = req(
+            server, "POST", "/index/i/field/v",
+            {"options": {"type": "int", "min": -10, "max": 100}},
+        )
+        assert out["options"]["type"] == "int"
+        assert out["options"]["min"] == -10
+
+    def test_post_schema_idempotent(self, server):
+        schema = {
+            "indexes": [
+                {"name": "i", "options": {}, "fields": [{"name": "f", "options": {}}]}
+            ]
+        }
+        req(server, "POST", "/schema", schema)
+        req(server, "POST", "/schema", schema)  # idempotent
+        got = req(server, "GET", "/schema")
+        assert got["indexes"][0]["fields"][0]["name"] == "f"
+
+
+class TestQueryRoutes:
+    def test_query_flow(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        out = req(server, "POST", "/index/i/query", b"Set(10, f=1)", ctype="text/plain")
+        assert out == {"results": [True]}
+        out = req(server, "POST", "/index/i/query", b"Row(f=1)", ctype="text/plain")
+        assert out == {"results": [{"attrs": {}, "columns": [10]}]}
+        out = req(server, "POST", "/index/i/query", b"Count(Row(f=1))", ctype="text/plain")
+        assert out == {"results": [1]}
+
+    def test_query_error(self, server):
+        req(server, "POST", "/index/i", {})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(server, "POST", "/index/i/query", b"Row(", ctype="text/plain")
+        assert e.value.code == 400
+        body = json.loads(e.value.read())
+        assert "error" in body
+
+    def test_query_protobuf(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(server, "POST", "/index/i/query", b"Set(3, f=9)", ctype="text/plain")
+        qr = QueryRequest(query="Count(Row(f=9))")
+        out = req(
+            server, "POST", "/index/i/query", qr.to_bytes(),
+            ctype="application/x-protobuf",
+        )
+        assert out == {"results": [1]}
+
+    def test_shards_param(self, server):
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(server, "POST", "/index/i/query", f"Set({SHARD_WIDTH+1}, f=1)".encode(), ctype="text/plain")
+        req(server, "POST", "/index/i/query", b"Set(1, f=1)", ctype="text/plain")
+        out = req(server, "POST", "/index/i/query?shards=1", b"Count(Row(f=1))", ctype="text/plain")
+        assert out == {"results": [1]}
+
+
+class TestImportRoutes:
+    def test_json_import(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(
+            server, "POST", "/index/i/field/f/import",
+            {"rowIDs": [1, 1, 2], "columnIDs": [10, 20, 10]},
+        )
+        out = req(server, "POST", "/index/i/query", b"Row(f=1)", ctype="text/plain")
+        assert out["results"][0]["columns"] == [10, 20]
+        # existence tracked
+        out = req(server, "POST", "/index/i/query", b"All()", ctype="text/plain")
+        assert out["results"][0]["columns"] == [10, 20]
+
+    def test_protobuf_import(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        msg = ImportRequest(index="i", field="f", row_ids=[5, 5], column_ids=[1, 2])
+        req(
+            server, "POST", "/index/i/field/f/import", msg.to_bytes(),
+            ctype="application/x-protobuf",
+        )
+        out = req(server, "POST", "/index/i/query", b"Row(f=5)", ctype="text/plain")
+        assert out["results"][0]["columns"] == [1, 2]
+
+    def test_protobuf_value_import(self, server):
+        req(server, "POST", "/index/i", {})
+        req(
+            server, "POST", "/index/i/field/v",
+            {"options": {"type": "int", "min": -100, "max": 100}},
+        )
+        msg = ImportValueRequest(index="i", field="v", column_ids=[1, 2], values=[42, -7])
+        req(
+            server, "POST", "/index/i/field/v/import", msg.to_bytes(),
+            ctype="application/x-protobuf",
+        )
+        out = req(server, "POST", "/index/i/query", b"Sum(field=v)", ctype="text/plain")
+        assert out["results"][0] == {"value": 35, "count": 2}
+
+    def test_import_roaring(self, server):
+        from pilosa_tpu.roaring import Bitmap, serialize
+
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        bm = Bitmap(np.array([1, 2, 3], dtype=np.uint64))
+        msg = ImportRoaringRequest(
+            views=[ImportRoaringRequestView(name="", data=serialize(bm))]
+        )
+        req(
+            server, "POST", "/index/i/field/f/import-roaring/0", msg.to_bytes(),
+            ctype="application/x-protobuf",
+        )
+        out = req(server, "POST", "/index/i/query", b"Row(f=0)", ctype="text/plain")
+        assert out["results"][0]["columns"] == [1, 2, 3]
+
+    def test_keyed_import(self, server):
+        req(server, "POST", "/index/k", {"options": {"keys": True}})
+        req(server, "POST", "/index/k/field/f", {"options": {"keys": True}})
+        req(
+            server, "POST", "/index/k/field/f/import",
+            {"rowKeys": ["red", "red"], "columnKeys": ["a", "b"]},
+        )
+        out = req(server, "POST", "/index/k/query", b'Row(f="red")', ctype="text/plain")
+        assert sorted(out["results"][0]["keys"]) == ["a", "b"]
+
+
+class TestInfoRoutes:
+    def test_status_info_version(self, server):
+        out = req(server, "GET", "/status")
+        assert out["state"] == "NORMAL"
+        assert out["nodes"][0]["isCoordinator"] is True
+        out = req(server, "GET", "/info")
+        assert "shardWidth" in out
+        out = req(server, "GET", "/version")
+        assert "version" in out
+
+    def test_shards_max(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(server, "POST", "/index/i/query", b"Set(1, f=1)", ctype="text/plain")
+        out = req(server, "GET", "/internal/shards/max")
+        assert out == {"standard": {"i": 0}}
+
+    def test_metrics(self, server):
+        raw = req(server, "GET", "/metrics", raw=True)
+        assert isinstance(raw, bytes)
+
+    def test_export(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(server, "POST", "/index/i/query", b"Set(7, f=3)", ctype="text/plain")
+        raw = req(server, "GET", "/export?index=i&field=f&shard=0", raw=True)
+        assert raw.decode().strip() == "3,7"
+
+    def test_fragment_internal_routes(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(server, "POST", "/index/i/query", b"Set(7, f=3)", ctype="text/plain")
+        out = req(server, "GET", "/internal/fragment/blocks?index=i&field=f&view=standard&shard=0")
+        assert len(out["blocks"]) == 1
+        raw = req(server, "GET", "/internal/fragment/data?index=i&field=f&view=standard&shard=0", raw=True)
+        from pilosa_tpu.roaring.codec import deserialize
+
+        bm = deserialize(raw)
+        assert bm.count() == 1
+
+
+class TestWireCodec:
+    def test_roundtrips(self):
+        m = ImportRequest(index="i", field="f", shard=3, row_ids=[1, 2], column_ids=[9],
+                          row_keys=["a"], column_keys=["b"], timestamps=[0, -5])
+        m2 = ImportRequest.from_bytes(m.to_bytes())
+        assert m2 == m
+        v = ImportValueRequest(index="i", field="v", column_ids=[1], values=[-42])
+        assert ImportValueRequest.from_bytes(v.to_bytes()) == v
+        q = QueryRequest(query="Row(f=1)", shards=[0, 5], remote=True)
+        assert QueryRequest.from_bytes(q.to_bytes()) == q
+        r = ImportRoaringRequest(clear=True, views=[ImportRoaringRequestView("x", b"\x01\x02")])
+        r2 = ImportRoaringRequest.from_bytes(r.to_bytes())
+        assert r2.clear and r2.views[0].name == "x" and r2.views[0].data == b"\x01\x02"
