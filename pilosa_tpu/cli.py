@@ -46,22 +46,32 @@ def cmd_server(args) -> int:
     api = API(holder, executor)
 
     if cfg.cluster.hosts:
-        try:
-            from pilosa_tpu.cluster import Cluster
-        except ImportError as e:
-            log.printf("clustered config requires the cluster module: %s", e)
-            return 1
+        from pilosa_tpu.cluster import Cluster, Node, Topology, URI
 
-        cluster = Cluster(
-            api,
-            self_uri=f"http://{cfg.host}:{cfg.port}",
-            hosts=cfg.cluster.hosts,
-            replicas=cfg.cluster.replicas,
-            coordinator=cfg.cluster.coordinator,
-        )
+        # Node IDs derive from the URI so every host computes the same
+        # ID-sorted ring without an out-of-band registry (the reference
+        # persists a UUID and gossips it; static topology needs neither).
+        nodes = []
+        for h in cfg.cluster.hosts:
+            u = URI.parse(h)
+            nodes.append(Node(id=f"node-{u.host}-{u.port}", uri=u))
+        if nodes:
+            min(nodes, key=lambda n: n.id).is_coordinator = True
+        local_id = f"node-{cfg.host}-{cfg.port}"
+        topo = Topology(nodes, replica_n=cfg.cluster.replicas)
+        local = topo.node_by_id(local_id)
+        if local is None:
+            log.printf(
+                "bind %s:%d is not in cluster.hosts %s", cfg.host, cfg.port, cfg.cluster.hosts
+            )
+            return 1
+        cluster = Cluster(local, topo, holder)
+        cluster.attach(executor, api)
         api.cluster = cluster
-        executor.mapper = cluster.mapper
-        cluster.open()
+        log.printf(
+            "clustered: %d nodes, replicas=%d, coordinator=%s",
+            len(nodes), cfg.cluster.replicas, cluster.coordinator().id,
+        )
 
     server = Server(api, host=cfg.host, port=cfg.port)
     log.printf("listening on http://%s:%d (data: %s)", cfg.host, cfg.port, data_dir)

@@ -1,0 +1,49 @@
+"""Cluster layer: topology, peer RPC, scatter-gather, resize, anti-entropy.
+
+The reference's distribution model (SURVEY.md §2.2): the column space is
+cut into 2^20-wide shards, shards hash to one of 256 partitions
+(fnv64a(index, shard) % 256, reference cluster.go:871), partitions map to
+a ring offset via jump-consistent-hash (cluster.go:947), and ReplicaN
+consecutive ring nodes own each partition. Queries scatter shards to
+owning nodes and stream-reduce; writes fan out to every replica.
+
+Here the intra-host parallelism is the TPU mesh (pilosa_tpu.parallel);
+this package is the DCN plane across hosts.
+"""
+
+from pilosa_tpu.cluster.topology import (
+    URI,
+    Node,
+    Topology,
+    JmpHasher,
+    ModHasher,
+    STATE_STARTING,
+    STATE_NORMAL,
+    STATE_DEGRADED,
+    STATE_RESIZING,
+)
+from pilosa_tpu.cluster.cluster import Cluster
+from pilosa_tpu.cluster.client import InternalClient, ClientError
+from pilosa_tpu.cluster.broadcast import (
+    Message,
+    NopBroadcaster,
+    HTTPBroadcaster,
+)
+
+__all__ = [
+    "URI",
+    "Node",
+    "Topology",
+    "JmpHasher",
+    "ModHasher",
+    "Cluster",
+    "InternalClient",
+    "ClientError",
+    "Message",
+    "NopBroadcaster",
+    "HTTPBroadcaster",
+    "STATE_STARTING",
+    "STATE_NORMAL",
+    "STATE_DEGRADED",
+    "STATE_RESIZING",
+]

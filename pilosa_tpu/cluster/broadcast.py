@@ -1,0 +1,138 @@
+"""Control-plane broadcast messages (reference broadcast.go:30-140).
+
+The reference encodes 16 protobuf message types with a 1-byte type prefix
+and delivers them sync (HTTP POST /internal/cluster/message to every
+node, server.go:666) or async (piggybacked on gossip). Here messages are
+JSON objects with a "type" field — the control plane is low-rate schema/
+topology traffic, so self-describing JSON beats protobuf for
+debuggability; the data plane (imports, fragments) stays binary.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional, Protocol
+
+# Message types (reference broadcast.go:55-122).
+MSG_CREATE_SHARD = "create-shard"
+MSG_CREATE_INDEX = "create-index"
+MSG_DELETE_INDEX = "delete-index"
+MSG_CREATE_FIELD = "create-field"
+MSG_DELETE_FIELD = "delete-field"
+MSG_DELETE_AVAILABLE_SHARD = "delete-available-shard"
+MSG_CLUSTER_STATUS = "cluster-status"
+MSG_RESIZE_INSTRUCTION = "resize-instruction"
+MSG_RESIZE_COMPLETE = "resize-complete"
+MSG_SET_COORDINATOR = "set-coordinator"
+MSG_UPDATE_COORDINATOR = "update-coordinator"
+MSG_NODE_EVENT = "node-event"
+MSG_NODE_STATE = "node-state"
+MSG_NODE_STATUS = "node-status"
+MSG_RECALCULATE_CACHES = "recalculate-caches"
+MSG_RESIZE_ABORT = "resize-abort"
+
+# Node events (reference event.go).
+EVENT_JOIN = "join"
+EVENT_LEAVE = "leave"
+EVENT_UPDATE = "update"
+
+
+class Message(dict):
+    """A typed control message; plain dict with a required 'type'."""
+
+    @staticmethod
+    def make(msg_type: str, **fields) -> "Message":
+        m = Message(fields)
+        m["type"] = msg_type
+        return m
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self).encode()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Message":
+        return Message(json.loads(data))
+
+
+class Broadcaster(Protocol):
+    """reference broadcast.go:30 broadcaster interface."""
+
+    def send_sync(self, msg: Message) -> None: ...
+    def send_async(self, msg: Message) -> None: ...
+    def send_to(self, node, msg: Message) -> None: ...
+
+
+class NopBroadcaster:
+    """Default no-op (reference broadcast.go:41) so single-node servers and
+    tests need no cluster plumbing."""
+
+    def send_sync(self, msg: Message) -> None:
+        pass
+
+    def send_async(self, msg: Message) -> None:
+        pass
+
+    def send_to(self, node, msg: Message) -> None:
+        pass
+
+
+class HTTPBroadcaster:
+    """Delivers messages over the internal client to every peer
+    (reference server.go SendSync :666).
+
+    send_sync raises on the first failed peer; send_async fires
+    best-effort threads (the gossip-queue analog — same at-most-once
+    semantics from the sender's view).
+    """
+
+    def __init__(self, cluster, client=None):
+        self.cluster = cluster
+        from pilosa_tpu.cluster.client import InternalClient
+
+        self.client = client or InternalClient()
+
+    def _peers(self):
+        local_id = self.cluster.local_node.id
+        return [n for n in self.cluster.topology.nodes if n.id != local_id]
+
+    def send_sync(self, msg: Message) -> None:
+        payload = msg.to_bytes()
+        peers = self._peers()
+        if not peers:
+            return
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def send(node):
+            try:
+                self.client.send_message(node, payload)
+            except Exception as e:  # collected, not fatal per-peer
+                with lock:
+                    errors.append(f"{node.id}: {e}")
+
+        # One RTT total, not N sequential RTTs.
+        threads = [threading.Thread(target=send, args=(n,), daemon=True) for n in peers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError("broadcast failed: " + "; ".join(errors))
+
+    def send_async(self, msg: Message) -> None:
+        payload = msg.to_bytes()
+        for node in self._peers():
+            t = threading.Thread(
+                target=self._send_quiet, args=(node, payload), daemon=True
+            )
+            t.start()
+
+    def _send_quiet(self, node, payload: bytes) -> None:
+        try:
+            self.client.send_message(node, payload)
+        except Exception:
+            pass
+
+    def send_to(self, node, msg: Message) -> None:
+        self.client.send_message(node, msg.to_bytes())

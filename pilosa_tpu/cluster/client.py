@@ -1,0 +1,228 @@
+"""Internal peer-to-peer HTTP client (reference http/client.go InternalClient).
+
+The DCN data plane: query fan-out (QueryNode with shards pinned +
+remote=true, reference http/client.go:268), imports, fragment block sync
+for anti-entropy, whole-fragment retrieval for resize, control-plane
+message delivery, and key-translation RPCs. stdlib urllib with persistent
+behavior left to the OS; every call raises ClientError on transport or
+HTTP-status failure so the scatter-gather layer can retry replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional, Sequence, Union
+
+from pilosa_tpu.cluster.topology import URI, Node
+
+
+class ClientError(Exception):
+    def __init__(self, msg: str, status: int = 0):
+        super().__init__(msg)
+        self.status = status
+
+
+def _uri_str(uri: Union[URI, Node, str]) -> str:
+    if isinstance(uri, Node):
+        uri = uri.uri
+    return str(uri)
+
+
+def _ts_epoch(t) -> int:
+    """Timestamp (int seconds / PQL string / datetime / falsy) -> unix
+    seconds for the wire (reference ImportRequest.Timestamps int64)."""
+    if not t:
+        return 0
+    if isinstance(t, int):
+        return t
+    import datetime as dt
+
+    from pilosa_tpu.core.timequantum import parse_time
+
+    return int(parse_time(t).replace(tzinfo=dt.timezone.utc).timestamp())
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _do(
+        self,
+        method: str,
+        uri: Union[URI, Node, str],
+        path: str,
+        body: Optional[bytes] = None,
+        content_type: str = "application/json",
+        raw: bool = False,
+    ):
+        url = _uri_str(uri) + path
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        req.add_header("Accept", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode("utf-8", "replace")
+            except Exception:
+                pass
+            raise ClientError(
+                f"{method} {url}: status {e.code}: {detail}", status=e.code
+            ) from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ClientError(f"{method} {url}: {e}") from e
+        if raw:
+            return data
+        if not data:
+            return {}
+        try:
+            return json.loads(data)
+        except json.JSONDecodeError as e:
+            raise ClientError(f"{method} {url}: invalid JSON response: {e}") from e
+
+    # -- queries (reference http/client.go QueryNode :268) -----------------
+
+    def query_node(
+        self,
+        uri: Union[URI, Node, str],
+        index: str,
+        query: str,
+        shards: Optional[Sequence[int]] = None,
+        remote: bool = True,
+    ) -> dict:
+        path = f"/index/{index}/query"
+        params = []
+        if shards is not None:
+            params.append("shards=" + ",".join(str(s) for s in shards))
+        if remote:
+            params.append("remote=true")
+        if params:
+            path += "?" + "&".join(params)
+        out = self._do("POST", uri, path, query.encode(), content_type="text/plain")
+        if "error" in out:
+            raise ClientError(out["error"])
+        return out
+
+    # -- schema ------------------------------------------------------------
+
+    def create_index(self, uri, index: str, options: Optional[dict] = None) -> None:
+        body = json.dumps({"options": options or {}}).encode()
+        self._do("POST", uri, f"/index/{index}", body)
+
+    def create_field(self, uri, index: str, field: str, options: Optional[dict] = None) -> None:
+        body = json.dumps({"options": options or {}}).encode()
+        self._do("POST", uri, f"/index/{index}/field/{field}", body)
+
+    def schema(self, uri) -> dict:
+        return self._do("GET", uri, "/schema")
+
+    def status(self, uri) -> dict:
+        return self._do("GET", uri, "/status")
+
+    def max_shards(self, uri) -> dict:
+        return self._do("GET", uri, "/internal/shards/max")
+
+    # -- imports (reference http/client.go Import/ImportRoaring) -----------
+
+    def import_roaring(
+        self,
+        uri,
+        index: str,
+        field: str,
+        shard: int,
+        views: dict[str, bytes],
+        clear: bool = False,
+    ) -> None:
+        from pilosa_tpu.server.wire import ImportRoaringRequest, ImportRoaringRequestView
+
+        req = ImportRoaringRequest(
+            clear=clear,
+            views=[ImportRoaringRequestView(name, data) for name, data in views.items()],
+        )
+        path = f"/index/{index}/field/{field}/import-roaring/{shard}?remote=true"
+        self._do("POST", uri, path, req.to_bytes(), content_type="application/x-protobuf")
+
+    def import_bits(self, uri, index: str, field: str, shard: int,
+                    row_ids: Sequence[int], column_ids: Sequence[int],
+                    timestamps: Optional[Sequence] = None,
+                    clear: bool = False) -> None:
+        """Peer-routed import: always marked remote so the receiver applies
+        locally instead of re-routing (reference api.go Import forwarding)."""
+        from pilosa_tpu.server.wire import ImportRequest
+
+        req = ImportRequest(
+            index=index, field=field, shard=shard,
+            row_ids=list(row_ids), column_ids=list(column_ids),
+            timestamps=[_ts_epoch(t) for t in timestamps] if timestamps else [],
+        )
+        path = f"/index/{index}/field/{field}/import?remote=true"
+        if clear:
+            path += "&clear=true"
+        self._do("POST", uri, path, req.to_bytes(), content_type="application/x-protobuf")
+
+    def import_values(self, uri, index: str, field: str, shard: int,
+                      column_ids: Sequence[int], values: Sequence[int],
+                      clear: bool = False) -> None:
+        from pilosa_tpu.server.wire import ImportValueRequest
+
+        req = ImportValueRequest(
+            index=index, field=field, shard=shard,
+            column_ids=list(column_ids), values=list(values),
+        )
+        path = f"/index/{index}/field/{field}/import?remote=true"
+        if clear:
+            path += "&clear=true"
+        self._do("POST", uri, path, req.to_bytes(), content_type="application/x-protobuf")
+
+    # -- fragment sync (reference http/client.go:591-780) ------------------
+
+    def fragment_blocks(self, uri, index: str, field: str, view: str, shard: int) -> list[tuple[int, int]]:
+        out = self._do(
+            "GET", uri,
+            f"/internal/fragment/blocks?index={index}&field={field}&view={view}&shard={shard}",
+        )
+        return [(int(b["id"]), int(b["checksum"])) for b in out.get("blocks", [])]
+
+    def block_data(self, uri, index: str, field: str, view: str, shard: int, block: int) -> bytes:
+        return self._do(
+            "GET", uri,
+            f"/internal/fragment/block/data?index={index}&field={field}&view={view}"
+            f"&shard={shard}&block={block}",
+            raw=True,
+        )
+
+    def retrieve_shard(self, uri, index: str, field: str, view: str, shard: int) -> bytes:
+        """Whole-fragment roaring payload (reference RetrieveShardFromURI
+        http/client.go:742, used by resize cluster.go:1297)."""
+        return self._do(
+            "GET", uri,
+            f"/internal/fragment/data?index={index}&field={field}&view={view}&shard={shard}",
+            raw=True,
+        )
+
+    # -- control plane -----------------------------------------------------
+
+    def send_message(self, uri, payload: bytes) -> None:
+        self._do("POST", uri, "/internal/cluster/message", payload,
+                 content_type="application/octet-stream")
+
+    # -- translation -------------------------------------------------------
+
+    def translate_keys(self, uri, index: str, field: str, keys: Sequence[str]) -> list[int]:
+        body = json.dumps({"index": index, "field": field, "keys": list(keys)}).encode()
+        out = self._do("POST", uri, "/internal/translate/keys", body)
+        return [int(v) for v in out.get("ids", [])]
+
+    def translate_data(self, uri, index: str, field: str = "", offset: int = 0) -> list:
+        out = self._do(
+            "GET", uri,
+            f"/internal/translate/data?index={index}&field={field}&offset={offset}",
+        )
+        return out.get("entries", [])

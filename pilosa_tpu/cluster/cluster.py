@@ -1,0 +1,439 @@
+"""Cluster runtime: scatter-gather mapReduce, write replication, control
+messages (reference cluster.go:186 + executor.go:2419-2613).
+
+A Cluster binds a local node into a Topology and installs two seams on
+the Executor:
+
+- ``mapper`` — the distributed mapReduce. Shards are grouped by owning
+  node (reference shardsByNode executor.go:2440); local shards run
+  through the backend in-process (on TPU that is one batched XLA program
+  over the mesh), remote groups become one QueryNode HTTP call each with
+  shards pinned and remote=true (reference remoteExec :2419). Responses
+  stream-reduce as they arrive; a failed node is filtered out and its
+  shards re-split across remaining replicas (reference :2497-2507).
+- ``router`` — write replication. Set/Clear apply on every replica of
+  the target shard (reference executeSetBitField :2096-2135); attribute
+  writes fan to all nodes (attr stores are fully replicated).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, Message, NopBroadcaster
+from pilosa_tpu.cluster.client import ClientError, InternalClient
+from pilosa_tpu.cluster.topology import (
+    Node,
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    STATE_STARTING,
+    Topology,
+)
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.exec.result import (
+    FieldRow,
+    GroupCount,
+    PairField,
+    PairsField,
+    RowIDs,
+    ValCount,
+)
+
+
+class ShardUnavailableError(Exception):
+    """No live node owns a shard (reference errShardUnavailable)."""
+
+
+@dataclass
+class _MapResponse:
+    node: Node
+    shards: list[int]
+    result: Any = None
+    err: Optional[Exception] = None
+
+
+class Cluster:
+    def __init__(
+        self,
+        local_node: Node,
+        topology: Topology,
+        holder=None,
+        client: Optional[InternalClient] = None,
+        use_broadcast: bool = True,
+        state: str = STATE_NORMAL,
+    ):
+        self.local_node = local_node
+        self.topology = topology
+        self.holder = holder
+        self.client = client or InternalClient()
+        self._state = state
+        self._state_lock = threading.RLock()
+        self.executor = None
+        self.broadcaster = (
+            HTTPBroadcaster(self, self.client) if use_broadcast else NopBroadcaster()
+        )
+        # Seams the resize/anti-entropy layers hook (set by attach_* below).
+        self.resizer = None
+        self.api = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self, executor, api=None) -> None:
+        """Install the cluster seams on an executor + holder + API."""
+        self.executor = executor
+        executor.mapper = self.map_shards
+        executor.router = self
+        self.api = api
+        if self.holder is not None:
+            self.holder.broadcast_shard = self._on_local_new_shard
+
+    # -- identity / state --------------------------------------------------
+
+    @property
+    def node_id(self) -> str:
+        return self.local_node.id
+
+    def state(self) -> str:
+        with self._state_lock:
+            return self._state
+
+    def set_state(self, state: str) -> None:
+        with self._state_lock:
+            self._state = state
+
+    def is_coordinator(self) -> bool:
+        return self.local_node.is_coordinator
+
+    def coordinator(self) -> Optional[Node]:
+        for n in self.topology.nodes:
+            if n.is_coordinator:
+                return n
+        return self.topology.nodes[0] if self.topology.nodes else None
+
+    def nodes_json(self) -> list[dict]:
+        return [n.to_json() for n in self.topology.nodes]
+
+    def shard_nodes_json(self, index: str, shard: int) -> list[dict]:
+        return [n.to_json() for n in self.topology.shard_nodes(index, shard)]
+
+    # -- mapReduce (reference executor.go:2460-2613) -----------------------
+
+    def map_shards(self, index, shards, c, map_fn, reduce_fn, opt):
+        nodes = list(self.topology.nodes)
+        ch: "queue.Queue[_MapResponse]" = queue.Queue()
+        self._launch(ch, nodes, index, shards, c, map_fn, reduce_fn, opt)
+
+        result = None
+        got_any = False
+        done = 0
+        while done < len(shards):
+            resp = ch.get(timeout=self.client.timeout + 30)
+            if resp.err is not None:
+                # Filter the failed node, re-split its shards across the
+                # remaining replicas (reference :2497-2507).
+                nodes = [n for n in nodes if n.id != resp.node.id]
+                try:
+                    self._launch(ch, nodes, index, resp.shards, c, map_fn, reduce_fn, opt)
+                except ShardUnavailableError:
+                    raise resp.err
+                continue
+            if got_any:
+                result = reduce_fn(result, resp.result)
+            else:
+                result = resp.result
+                got_any = True
+            done += len(resp.shards)
+        return result
+
+    def _shards_by_node(self, nodes: Sequence[Node], index: str, shards: Sequence[int]):
+        m: dict[str, tuple[Node, list[int]]] = {}
+        live = {n.id for n in nodes}
+        for shard in shards:
+            owner = None
+            for n in self.topology.shard_nodes(index, shard):
+                if n.id in live:
+                    owner = n
+                    break
+            if owner is None:
+                raise ShardUnavailableError(f"shard {shard} unavailable")
+            m.setdefault(owner.id, (owner, []))[1].append(shard)
+        return m
+
+    def _launch(self, ch, nodes, index, shards, c, map_fn, reduce_fn, opt) -> None:
+        groups = self._shards_by_node(nodes, index, shards)
+        for node, node_shards in groups.values():
+            t = threading.Thread(
+                target=self._map_node,
+                args=(ch, node, node_shards, index, c, map_fn, reduce_fn, opt),
+                daemon=True,
+            )
+            t.start()
+
+    def _map_node(self, ch, node, node_shards, index, c, map_fn, reduce_fn, opt) -> None:
+        resp = _MapResponse(node=node, shards=node_shards)
+        try:
+            if node.id == self.local_node.id:
+                result = None
+                first = True
+                for shard in node_shards:
+                    v = map_fn(shard)
+                    result = v if first else reduce_fn(result, v)
+                    first = False
+                resp.result = result
+            else:
+                resp.result = self._remote_exec(node, index, c, node_shards)
+        except Exception as e:  # transport or peer error -> retried upstream
+            resp.err = e
+        ch.put(resp)
+
+    def _remote_exec(self, node, index, c, shards):
+        out = self.client.query_node(
+            node, index, c.to_string(), shards=shards, remote=True
+        )
+        results = out.get("results", [])
+        raw = results[0] if results else None
+        return decode_result(c, raw)
+
+    # -- write replication (reference executor.go:2072-2141) ---------------
+
+    def _parallel_peer_writes(self, peers: Sequence[Node], index: str, pql: str,
+                              shards: Optional[dict[str, list[int]]] = None) -> list[Any]:
+        """Fire one remote-exec per peer concurrently; first error raised.
+        shards maps node id -> pinned shard list (None = unpinned)."""
+        results: list[Any] = [None] * len(peers)
+        errs: list[Exception] = []
+        lock = threading.Lock()
+
+        def send(i, node):
+            try:
+                out = self.client.query_node(
+                    node, index, pql,
+                    shards=shards.get(node.id) if shards else None,
+                    remote=True,
+                )
+                rs = out.get("results", [])
+                results[i] = rs[0] if rs else None
+            except Exception as e:
+                with lock:
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=send, args=(i, n), daemon=True)
+            for i, n in enumerate(peers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return results
+
+    def route_write(self, index: str, c, shard: int, local_fn: Callable[[], Any]):
+        """Apply a single-shard write on every replica; OR the changed
+        flags (reference executeSetBitField: ret = changed on any node)."""
+        replicas = self.topology.shard_nodes(index, shard)
+        peers = [n for n in replicas if n.id != self.local_node.id]
+        ret = None
+        if any(n.id == self.local_node.id for n in replicas):
+            ret = local_fn()
+        for r in self._parallel_peer_writes(peers, index, c.to_string()):
+            if ret is None:
+                ret = r
+            elif isinstance(r, bool):
+                ret = bool(ret) or r
+        return ret
+
+    def route_write_shards(self, index: str, c, shards: Sequence[int],
+                           local_fn: Callable[[int], Any]):
+        """Multi-shard write (ClearRow/Store) applied on EVERY replica of
+        every shard: local shards via local_fn, remote groups as one
+        pinned remote-exec per node. The reference routes these through
+        plain mapReduce (one owner per shard, executor.go:1871-1953),
+        which silently diverges replicas until anti-entropy — replicating
+        here keeps replicas consistent at write time."""
+        by_node: dict[str, tuple[Node, list[int]]] = {}
+        for shard in shards:
+            for node in self.topology.shard_nodes(index, shard):
+                by_node.setdefault(node.id, (node, []))[1].append(shard)
+        ret = None
+        local = by_node.pop(self.local_node.id, None)
+        if local is not None:
+            for shard in local[1]:
+                r = local_fn(shard)
+                ret = r if ret is None else (bool(ret) or bool(r))
+        peers = [node for node, _ in by_node.values()]
+        pinned = {node.id: ss for node, ss in by_node.values()}
+        for r in self._parallel_peer_writes(peers, index, c.to_string(), pinned):
+            if ret is None:
+                ret = r
+            elif isinstance(r, bool):
+                ret = bool(ret) or r
+        return ret
+
+    def fan_out_all(self, index: str, c, local_fn: Callable[[], Any]):
+        """Apply on every node (attr writes; stores fully replicated,
+        reference executeSetRowAttrs remote fan-out)."""
+        ret = local_fn()
+        peers = [n for n in self.topology.nodes if n.id != self.local_node.id]
+        self._parallel_peer_writes(peers, index, c.to_string())
+        return ret
+
+    # -- schema / shard propagation ----------------------------------------
+
+    def broadcast_schema(self) -> None:
+        """Push the full schema to peers after a local DDL (the reference
+        broadcasts per-op messages, broadcast.go:57-79; a full-schema sync
+        is simpler and idempotent — receivers apply_schema)."""
+        if self.holder is None:
+            return
+        msg = Message.make(bc.MSG_NODE_STATUS, schema={"indexes": self.holder.schema()})
+        try:
+            self.broadcaster.send_sync(msg)
+        except RuntimeError:
+            pass  # peers down; anti-entropy re-syncs schema later
+
+    def _on_local_new_shard(self, index: str, field: str, shard: int) -> None:
+        # Sync so a query routed through any node right after a write sees
+        # the new shard in its fan-out set; down peers are repaired by
+        # anti-entropy later.
+        try:
+            self.broadcaster.send_sync(
+                Message.make(bc.MSG_CREATE_SHARD, index=index, field=field, shard=shard)
+            )
+        except RuntimeError:
+            pass
+
+    # -- message receive (reference server.go receiveMessage :569) ---------
+
+    def receive_message(self, payload: bytes) -> None:
+        msg = Message.from_bytes(payload)
+        typ = msg.get("type")
+        if typ == bc.MSG_CREATE_SHARD:
+            idx = self.holder.index(msg["index"]) if self.holder else None
+            f = idx.field(msg["field"]) if idx else None
+            if f is not None:
+                f.add_available_shard(int(msg["shard"]))
+        elif typ == bc.MSG_DELETE_AVAILABLE_SHARD:
+            idx = self.holder.index(msg["index"]) if self.holder else None
+            f = idx.field(msg["field"]) if idx else None
+            if f is not None:
+                f.remove_available_shard(int(msg["shard"]))
+        elif typ == bc.MSG_NODE_STATUS:
+            if self.api is not None and "schema" in msg:
+                self.api.apply_schema(msg["schema"])
+        elif typ == bc.MSG_CLUSTER_STATUS:
+            self.set_state(msg.get("state", self.state()))
+            if "nodes" in msg:
+                self.topology.nodes = sorted(
+                    (Node.from_json(d) for d in msg["nodes"]), key=lambda n: n.id
+                )
+        elif typ == bc.MSG_RECALCULATE_CACHES:
+            if self.api is not None:
+                self.api.recalculate_caches()
+        elif typ == bc.MSG_RESIZE_INSTRUCTION:
+            if self.resizer is not None:
+                self.resizer.follow_instruction(msg)
+        elif typ == bc.MSG_RESIZE_COMPLETE:
+            if self.resizer is not None:
+                self.resizer.mark_complete(msg)
+        elif typ == bc.MSG_RESIZE_ABORT:
+            if self.resizer is not None:
+                self.resizer.abort()
+        elif typ == bc.MSG_NODE_EVENT:
+            self._handle_node_event(msg)
+        elif typ == bc.MSG_SET_COORDINATOR:
+            new_id = msg.get("id")
+            for n in self.topology.nodes:
+                n.is_coordinator = n.id == new_id
+            self.local_node.is_coordinator = self.local_node.id == new_id
+        # unknown types ignored (forward compatibility)
+
+    def _handle_node_event(self, msg: Message) -> None:
+        event = msg.get("event")
+        node = Node.from_json(msg["node"]) if "node" in msg else None
+        if node is None:
+            return
+        if event == bc.EVENT_JOIN and self.is_coordinator() and self.resizer is not None:
+            self.resizer.handle_join(node)
+        elif event == bc.EVENT_LEAVE:
+            existing = self.topology.node_by_id(node.id)
+            if existing is not None:
+                existing.state = "DOWN"
+            # Degraded until repaired/resized (reference determineClusterState
+            # cluster.go:571: missing node + replicas -> DEGRADED).
+            if self.topology.replica_n > 1:
+                self.set_state(STATE_DEGRADED)
+
+
+# ---------------------------------------------------------------------------
+# remote result decoding (reference QueryResponse protobuf -> result types)
+# ---------------------------------------------------------------------------
+
+_ROW_CALLS = frozenset(
+    ("Row", "Range", "Union", "Intersect", "Difference", "Xor", "Not", "Shift", "All")
+)
+
+
+def decode_result(c, raw: Any) -> Any:
+    """JSON result from a peer -> the executor's native result type, so the
+    coordinator's reduce functions work unchanged."""
+    name = c.name
+    if name == "Count":
+        return int(raw or 0)
+    if name in ("Sum", "Min", "Max"):
+        raw = raw or {}
+        return ValCount(val=int(raw.get("value", 0)), count=int(raw.get("count", 0)))
+    if name in ("MinRow", "MaxRow"):
+        raw = raw or {}
+        field_name = str(c.args.get("_field") or c.args.get("field") or "")
+        return PairField(
+            Pair(id=int(raw.get("id", 0)), count=int(raw.get("count", 0))),
+            field_name,
+        )
+    if name == "TopN":
+        # Shard-level merge type is a plain pair list (add_pairs); the
+        # coordinator wraps the final PairsField.
+        return [
+            Pair(id=int(p.get("id", 0)), count=int(p.get("count", 0)),
+                 key=p.get("key", ""))
+            for p in (raw or [])
+        ]
+    if name == "Rows":
+        raw = raw or {}
+        out = RowIDs(int(r) for r in raw.get("rows", []))
+        if "keys" in raw:
+            out.keys = list(raw["keys"])
+        return out
+    if name == "GroupBy":
+        out_groups = []
+        for g in raw or []:
+            frs = [
+                FieldRow(
+                    field=fr["field"],
+                    row_id=int(fr.get("rowID", 0)),
+                    row_key=fr.get("rowKey", ""),
+                )
+                for fr in g.get("group", [])
+            ]
+            out_groups.append(GroupCount(frs, int(g.get("count", 0))))
+        return out_groups
+    if name in ("Set", "Clear", "Store", "ClearRow"):
+        return bool(raw)
+    if name in ("SetRowAttrs", "SetColumnAttrs"):
+        return None
+    if name == "Options":
+        return decode_result(c.children[0], raw) if c.children else raw
+    if name in _ROW_CALLS:
+        raw = raw or {}
+        row = Row(int(v) for v in raw.get("columns", []))
+        if raw.get("attrs"):
+            row.attrs = raw["attrs"]
+        return row
+    return raw

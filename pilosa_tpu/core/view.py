@@ -90,14 +90,18 @@ class View:
 
     def create_fragment_if_not_exists(self, shard: int) -> Fragment:
         """reference view.go CreateFragmentIfNotExists :263."""
+        created = False
         with self.lock:
             frag = self.fragments.get(shard)
             if frag is None:
                 frag = self._new_fragment(shard).open()
                 self.fragments[shard] = frag
-                if self.broadcast_shard is not None:
-                    self.broadcast_shard(self.index, self.field, shard)
-            return frag
+                created = True
+        # Broadcast outside the lock: peer RPCs must not block other
+        # fragment lookups on this view.
+        if created and self.broadcast_shard is not None:
+            self.broadcast_shard(self.index, self.field, shard)
+        return frag
 
     def available_shards(self) -> list[int]:
         return sorted(self.fragments)

@@ -60,6 +60,10 @@ class Executor:
         # owning nodes (reference mapper :2522). Signature:
         # (index, shards, call, map_fn, reduce_fn, opt) -> reduced value.
         self.mapper: Optional[Callable] = None
+        # Cluster seam for write replication: Set/Clear apply on every
+        # replica of the target shard, attr writes on every node
+        # (reference executeSetBitField :2096-2135). None = single node.
+        self.router = None
 
     # ------------------------------------------------------------------
     # entry
@@ -83,9 +87,14 @@ class Executor:
 
         results = []
         for call in query.calls:
-            self._translate_call(idx, call)
+            # Remote (peer-issued) requests arrive pre-translated and are
+            # returned raw; translation happens only at the coordinator
+            # (reference executor.go:121-127).
+            if not opt.remote:
+                self._translate_call(idx, call)
             result = self.execute_call(index, call, shards, opt)
-            result = self._translate_result(idx, call, result)
+            if not opt.remote:
+                result = self._translate_result(idx, call, result)
             results.append(result)
         return results
 
@@ -441,7 +450,11 @@ class Executor:
             other = c.clone()
             other.args["ids"] = ids
             pairs = self._execute_topn_shards(index, other, shards, opt)
-        pairs.pairs = top_n_pairs(pairs.pairs, n)
+        # Remote (per-node) responses stay untrimmed: a candidate's count
+        # may be split across nodes, so only the coordinator may cut to n
+        # (reference fragment.go:1574 forces N=0 under pinned ids).
+        if not opt.remote:
+            pairs.pairs = top_n_pairs(pairs.pairs, n)
         return pairs
 
     def _execute_topn_shards(self, index, c, shards, opt) -> PairsField:
@@ -636,6 +649,16 @@ class Executor:
         col_id, ok = c.uint64_arg("_col")
         if not ok:
             raise QueryError("Set() column argument 'col' required")
+        if self.router is not None and not opt.remote:
+            return bool(
+                self.router.route_write(
+                    index, c, col_id // SHARD_WIDTH,
+                    lambda: self._execute_set_local(index, c, col_id),
+                )
+            )
+        return self._execute_set_local(index, c, col_id)
+
+    def _execute_set_local(self, index, c, col_id: int) -> bool:
         field_name = c.field_arg()
         idx = self.holder.index(index)
         f = idx.field(field_name)
@@ -666,6 +689,16 @@ class Executor:
         col_id, ok = c.uint64_arg("_col")
         if not ok:
             raise QueryError("Clear() column argument 'col' required")
+        if self.router is not None and not opt.remote:
+            return bool(
+                self.router.route_write(
+                    index, c, col_id // SHARD_WIDTH,
+                    lambda: self._execute_clear_local(index, c, col_id),
+                )
+            )
+        return self._execute_clear_local(index, c, col_id)
+
+    def _execute_clear_local(self, index, c, col_id: int) -> bool:
         field_name = c.field_arg()
         idx = self.holder.index(index)
         f = idx.field(field_name)
@@ -701,6 +734,9 @@ class Executor:
                     changed = frag.clear_row(row_id) or changed
             return changed
 
+        # Replicated multi-shard write (see Cluster.route_write_shards).
+        if self.router is not None and not opt.remote:
+            return bool(self.router.route_write_shards(index, c, shards, map_fn))
         return bool(self.map_reduce(index, shards, c, opt, map_fn, lambda a, b: a or b))
 
     def _execute_store(self, index, c, shards, opt) -> bool:
@@ -723,9 +759,19 @@ class Executor:
             f.add_available_shard(shard)
             return frag.set_row(row, row_id)
 
+        # Replicated multi-shard write (see Cluster.route_write_shards).
+        if self.router is not None and not opt.remote:
+            return bool(self.router.route_write_shards(index, c, shards, map_fn))
         return bool(self.map_reduce(index, shards, c, opt, map_fn, lambda a, b: a or b))
 
     def _execute_set_row_attrs(self, index, c, opt) -> None:
+        if self.router is not None and not opt.remote:
+            return self.router.fan_out_all(
+                index, c, lambda: self._execute_set_row_attrs_local(index, c)
+            )
+        return self._execute_set_row_attrs_local(index, c)
+
+    def _execute_set_row_attrs_local(self, index, c) -> None:
         field_name = c.args.get("_field")
         idx = self.holder.index(index)
         f = idx.field(field_name)
@@ -739,6 +785,13 @@ class Executor:
         return None
 
     def _execute_set_column_attrs(self, index, c, opt) -> None:
+        if self.router is not None and not opt.remote:
+            return self.router.fan_out_all(
+                index, c, lambda: self._execute_set_column_attrs_local(index, c)
+            )
+        return self._execute_set_column_attrs_local(index, c)
+
+    def _execute_set_column_attrs_local(self, index, c) -> None:
         idx = self.holder.index(index)
         col_id, ok = c.uint64_arg("_col")
         if not ok:

@@ -167,7 +167,9 @@ def _fmt_val(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, str):
-        return f'"{v}"'
+        # Escape so Call.to_string() round-trips through the parser — the
+        # cluster RPC layer re-parses serialized calls on peers.
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
     if isinstance(v, list):
         return "[" + ",".join(_fmt_val(x) for x in v) + "]"
     if isinstance(v, Call):

@@ -80,10 +80,17 @@ class API:
             exclude_columns=exclude_columns,
             column_attrs=column_attrs,
         )
+        from pilosa_tpu.cluster.client import ClientError
+        from pilosa_tpu.cluster.cluster import ShardUnavailableError
+
         try:
             results = self.executor.execute(index, query, shards=shards, opt=opt)
         except (ParseError, QueryError, ValueError) as e:
             raise APIError(str(e)) from e
+        except ShardUnavailableError as e:
+            raise APIError(str(e), status=503) from e
+        except ClientError as e:
+            raise APIError(f"remote node error: {e}", status=502) from e
         out: dict[str, Any] = {
             "results": [self._encode_result(r, exclude_columns) for r in results]
         }
@@ -235,8 +242,11 @@ class API:
         column_keys: Optional[list[str]] = None,
         timestamps: Optional[list[int]] = None,
         clear: bool = False,
+        remote: bool = False,
     ) -> None:
-        """reference api.go Import :920 (key translation + existence)."""
+        """reference api.go Import :920 (key translation + shard routing +
+        existence). remote=True marks a peer-routed request that must
+        apply locally without re-routing."""
         self._validate_state("Import")
         idx = self.holder.index(index)
         if idx is None:
@@ -252,6 +262,9 @@ class API:
             if f.translate_store is None:
                 raise APIError("field does not use string keys")
             row_ids = [f.translate_store.translate_key(k) for k in row_keys]
+        if self.cluster is not None and not remote:
+            self._route_import(index, field, row_ids, column_ids, timestamps, clear)
+            return
         rows = np.asarray(row_ids, dtype=np.uint64)
         cols = np.asarray(column_ids, dtype=np.uint64)
         ts = None
@@ -273,6 +286,7 @@ class API:
         values: list[int],
         column_keys: Optional[list[str]] = None,
         clear: bool = False,
+        remote: bool = False,
     ) -> None:
         self._validate_state("ImportValue")
         idx = self.holder.index(index)
@@ -285,6 +299,9 @@ class API:
             if idx.translate_store is None:
                 raise APIError("index does not use string keys")
             column_ids = [idx.translate_store.translate_key(k) for k in column_keys]
+        if self.cluster is not None and not remote:
+            self._route_import_values(index, field, column_ids, values, clear)
+            return
         cols = np.asarray(column_ids, dtype=np.uint64)
         try:
             f.import_value(cols, np.asarray(values, dtype=np.int64), clear=clear)
@@ -294,8 +311,57 @@ class API:
         if ef is not None and not clear and cols.size:
             ef.import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
 
+    # -- cluster import routing (reference api.go:920-1127: bits grouped by
+    # shard, each group sent to every owning node) ------------------------
+
+    def _owners_by_node(self, index: str, shards: set[int]):
+        """node id -> (node, is_local, set of its shards), over replicas."""
+        topo = self.cluster.topology
+        local_id = self.cluster.local_node.id
+        out: dict[str, tuple] = {}
+        for shard in shards:
+            for node in topo.shard_nodes(index, shard):
+                entry = out.setdefault(node.id, (node, node.id == local_id, set()))
+                entry[2].add(shard)
+        return out.values()
+
+    def _route_import(self, index, field, row_ids, column_ids, timestamps, clear) -> None:
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        shard_of = [c // SHARD_WIDTH for c in column_ids]
+        for node, is_local, node_shards in self._owners_by_node(index, set(shard_of)):
+            sel = [i for i, s in enumerate(shard_of) if s in node_shards]
+            sub_rows = [row_ids[i] for i in sel]
+            sub_cols = [column_ids[i] for i in sel]
+            sub_ts = [timestamps[i] for i in sel] if timestamps else None
+            if is_local:
+                self.import_bits(index, field, sub_rows, sub_cols,
+                                 timestamps=sub_ts, clear=clear, remote=True)
+            else:
+                self.cluster.client.import_bits(
+                    node, index, field, 0, sub_rows, sub_cols,
+                    timestamps=sub_ts, clear=clear,
+                )
+
+    def _route_import_values(self, index, field, column_ids, values, clear) -> None:
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        shard_of = [c // SHARD_WIDTH for c in column_ids]
+        for node, is_local, node_shards in self._owners_by_node(index, set(shard_of)):
+            sel = [i for i, s in enumerate(shard_of) if s in node_shards]
+            sub_cols = [column_ids[i] for i in sel]
+            sub_vals = [values[i] for i in sel]
+            if is_local:
+                self.import_values(index, field, sub_cols, sub_vals,
+                                   clear=clear, remote=True)
+            else:
+                self.cluster.client.import_values(
+                    node, index, field, 0, sub_cols, sub_vals, clear=clear
+                )
+
     def import_roaring(
-        self, index: str, field: str, shard: int, views: dict[str, bytes], clear: bool = False
+        self, index: str, field: str, shard: int, views: dict[str, bytes],
+        clear: bool = False, remote: bool = False,
     ) -> None:
         """reference api.go ImportRoaring :368."""
         self._validate_state("ImportRoaring")
@@ -305,6 +371,16 @@ class API:
         f = idx.field(field)
         if f is None:
             raise NotFoundError(f"field not found: {field}")
+        if self.cluster is not None and not remote:
+            for node, is_local, _ in self._owners_by_node(index, {shard}):
+                if is_local:
+                    self.import_roaring(index, field, shard, views,
+                                        clear=clear, remote=True)
+                else:
+                    self.cluster.client.import_roaring(
+                        node, index, field, shard, views, clear=clear
+                    )
+            return
         for view_name, data in views.items():
             name = view_name or "standard"
             try:

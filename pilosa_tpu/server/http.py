@@ -254,6 +254,7 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body()
         ctype = (self.headers.get("Content-Type") or "").split(";")[0]
         clear = self.query.get("clear") == "true"
+        remote = self.query.get("remote") == "true"
         if ctype == "application/x-protobuf":
             # Value import is signaled by the field type on the wire level
             # in the reference client; sniff by field schema.
@@ -263,7 +264,7 @@ class _Handler(BaseHTTPRequestHandler):
                 req = ImportValueRequest.from_bytes(body)
                 self.api.import_values(
                     index, field, req.column_ids, req.values,
-                    column_keys=req.column_keys or None, clear=clear,
+                    column_keys=req.column_keys or None, clear=clear, remote=remote,
                 )
             else:
                 req = ImportRequest.from_bytes(body)
@@ -271,7 +272,7 @@ class _Handler(BaseHTTPRequestHandler):
                     index, field, req.row_ids, req.column_ids,
                     row_keys=req.row_keys or None,
                     column_keys=req.column_keys or None,
-                    timestamps=req.timestamps or None, clear=clear,
+                    timestamps=req.timestamps or None, clear=clear, remote=remote,
                 )
         else:
             payload = self._json_body_from(body)
@@ -279,7 +280,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.api.import_values(
                     index, field,
                     payload.get("columnIDs", []), payload.get("values", []),
-                    column_keys=payload.get("columnKeys"), clear=clear,
+                    column_keys=payload.get("columnKeys"), clear=clear, remote=remote,
                 )
             else:
                 self.api.import_bits(
@@ -287,7 +288,7 @@ class _Handler(BaseHTTPRequestHandler):
                     payload.get("rowIDs", []), payload.get("columnIDs", []),
                     row_keys=payload.get("rowKeys"),
                     column_keys=payload.get("columnKeys"),
-                    timestamps=payload.get("timestamps"), clear=clear,
+                    timestamps=payload.get("timestamps"), clear=clear, remote=remote,
                 )
         self._reply({"success": True})
 
@@ -307,7 +308,8 @@ class _Handler(BaseHTTPRequestHandler):
                 k: base64.b64decode(v) for k, v in payload.get("views", {}).items()
             }
             clear = bool(payload.get("clear", False))
-        self.api.import_roaring(index, field, int(shard), views, clear=clear)
+        remote = self.query.get("remote") == "true"
+        self.api.import_roaring(index, field, int(shard), views, clear=clear, remote=remote)
         self._reply({"success": True})
 
     @route("GET", r"/export")

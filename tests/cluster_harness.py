@@ -1,0 +1,107 @@
+"""In-process multi-node cluster harness (reference test/pilosa.go:88
+MustRunCluster): n real servers in one process on ephemeral ports, static
+topology (no gossip), deterministic ModHasher placement available for
+tests that assert specific owners."""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import time
+
+from pilosa_tpu.cluster import Cluster, Node, Topology, URI
+from pilosa_tpu.cluster.topology import JmpHasher
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import Server
+
+
+class ClusterNode:
+    def __init__(self, i: int, data_dir: str):
+        self.i = i
+        self.data_dir = data_dir
+        self.holder = Holder(data_dir).open()
+        self.executor = Executor(self.holder)
+        self.api = API(self.holder, self.executor)
+        self.server = Server(self.api, host="127.0.0.1", port=0).open()
+        self.node = Node(
+            id=f"node{i}",
+            uri=URI(scheme="http", host="127.0.0.1", port=self.server.port),
+            is_coordinator=(i == 0),
+        )
+        self.cluster = None  # attached by TestCluster
+
+    def close(self) -> None:
+        self.server.close()
+        self.holder.close()
+
+
+class TestCluster:
+    """n wired nodes sharing one static topology."""
+
+    __test__ = False  # not a pytest class
+
+    def __init__(self, n: int, replica_n: int = 1, hasher=None):
+        self._tmp = tempfile.mkdtemp(prefix="pilosa-tpu-cluster-")
+        self.nodes: list[ClusterNode] = [
+            ClusterNode(i, f"{self._tmp}/node{i}") for i in range(n)
+        ]
+        members = [cn.node for cn in self.nodes]
+        for cn in self.nodes:
+            topo = Topology(
+                nodes=[Node(m.id, m.uri, m.is_coordinator) for m in members],
+                replica_n=replica_n,
+                hasher=hasher or JmpHasher(),
+            )
+            cn.cluster = Cluster(
+                local_node=topo.node_by_id(cn.node.id),
+                topology=topo,
+                holder=cn.holder,
+            )
+            cn.cluster.attach(cn.executor, cn.api)
+            cn.api.cluster = cn.cluster
+
+    def __getitem__(self, i: int) -> ClusterNode:
+        return self.nodes[i]
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def create_index(self, name: str, options=None) -> None:
+        self.nodes[0].api.create_index(name, options)
+
+    def create_field(self, index: str, field: str, options=None) -> None:
+        self.nodes[0].api.create_field(index, field, options)
+
+    def query(self, i: int, index: str, pql: str) -> dict:
+        return self.nodes[i].api.query(index, pql)
+
+    def await_shard_convergence(self, index: str, timeout: float = 5.0) -> None:
+        """Wait until every node reports the same available-shard set."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            sets = []
+            for cn in self.nodes:
+                idx = cn.holder.index(index)
+                sets.append(
+                    tuple(idx.available_shards().to_array().tolist()) if idx else ()
+                )
+            if len(set(sets)) == 1:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"shards never converged: {sets}")
+
+    def close(self) -> None:
+        for cn in self.nodes:
+            try:
+                cn.close()
+            except Exception:
+                pass
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+    def __enter__(self) -> "TestCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,310 @@
+"""Cluster layer tests: topology math, distributed queries, replication,
+node-failure retry (reference cluster_internal_test.go + executor_test.go
+cluster cases via test.MustRunCluster)."""
+
+import pytest
+
+from pilosa_tpu.cluster import (
+    Cluster,
+    InternalClient,
+    JmpHasher,
+    ModHasher,
+    Node,
+    Topology,
+    URI,
+)
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from tests.cluster_harness import TestCluster
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+
+def _nodes(n):
+    return [Node(f"node{i}", URI(port=10101 + i)) for i in range(n)]
+
+
+class TestURI:
+    def test_parse_full(self):
+        u = URI.parse("http://example.com:8080")
+        assert (u.scheme, u.host, u.port) == ("http", "example.com", 8080)
+
+    def test_parse_defaults(self):
+        assert URI.parse("example.com").port == 10101
+        assert URI.parse("example.com:81").scheme == "http"
+        assert str(URI.parse("https://h:1")) == "https://h:1"
+
+    def test_parse_invalid(self):
+        with pytest.raises(ValueError):
+            URI.parse("http://host:port:extra")
+
+
+class TestJumpHash:
+    def test_spread_and_stability(self):
+        # Jump hash: adding a bucket moves only ~1/n of keys.
+        h = JmpHasher()
+        before = [h.hash(k, 4) for k in range(1000)]
+        after = [h.hash(k, 5) for k in range(1000)]
+        moved = sum(1 for a, b in zip(before, after) if a != b)
+        assert 100 < moved < 350  # ~1/5 of keys
+        # Every moved key moved to the NEW bucket (jump hash property).
+        assert all(b == 4 for a, b in zip(before, after) if a != b)
+
+    def test_range(self):
+        h = JmpHasher()
+        for k in range(100):
+            assert 0 <= h.hash(k, 3) < 3
+
+
+class TestTopology:
+    def test_partition_deterministic(self):
+        t = Topology(_nodes(3))
+        assert t.partition("i", 0) == t.partition("i", 0)
+        assert 0 <= t.partition("i", 12345) < 256
+        # different index -> different partition for at least some shards
+        assert any(t.partition("i", s) != t.partition("j", s) for s in range(32))
+
+    def test_replica_ring(self):
+        t = Topology(_nodes(4), replica_n=3)
+        nodes = t.partition_nodes(7)
+        assert len(nodes) == 3
+        assert len({n.id for n in nodes}) == 3
+        # consecutive on the ID-sorted ring
+        ids = [n.id for n in t.nodes]
+        i0 = ids.index(nodes[0].id)
+        assert [n.id for n in nodes] == [ids[(i0 + k) % 4] for k in range(3)]
+
+    def test_replica_clamped_to_cluster_size(self):
+        t = Topology(_nodes(2), replica_n=5)
+        assert len(t.partition_nodes(0)) == 2
+
+    def test_mod_hasher_placement(self):
+        t = Topology(_nodes(3), hasher=ModHasher())
+        p = t.partition("i", 9)
+        assert t.partition_nodes(p)[0].id == f"node{p % 3}"
+
+    def test_owns_shard_covers_all_nodes(self):
+        t = Topology(_nodes(3))
+        owners = {t.primary_for_shard("i", s).id for s in range(64)}
+        assert owners == {"node0", "node1", "node2"}  # jump hash spreads
+
+    def test_add_remove_node(self):
+        t = Topology(_nodes(2))
+        t.add_node(Node("node9", URI(port=1)))
+        assert [n.id for n in t.nodes] == ["node0", "node1", "node9"]
+        assert t.remove_node("node9")
+        assert not t.remove_node("node9")
+
+
+# ---------------------------------------------------------------------------
+# distributed execution
+# ---------------------------------------------------------------------------
+
+N_SHARDS = 6
+
+
+def _populate(tc: TestCluster, index="i", field="f"):
+    """Bits spread over N_SHARDS shards, writes routed through different
+    nodes round-robin to exercise replication + forwarding."""
+    tc.create_index(index)
+    tc.create_field(index, field)
+    expected_cols = []
+    for s in range(N_SHARDS):
+        col = s * SHARD_WIDTH + s + 1
+        expected_cols.append(col)
+        tc.query(s % len(tc), index, f"Set({col}, {field}=1)")
+    # row 2: only even shards
+    for s in range(0, N_SHARDS, 2):
+        tc.query(0, index, f"Set({s * SHARD_WIDTH + 7}, {field}=2)")
+    tc.await_shard_convergence(index)
+    return expected_cols
+
+
+class TestDistributedQueries:
+    def test_count_and_row_from_every_node(self):
+        with TestCluster(3) as tc:
+            cols = _populate(tc)
+            for i in range(3):
+                out = tc.query(i, "i", "Count(Row(f=1))")
+                assert out["results"][0] == N_SHARDS, f"node {i}"
+                out = tc.query(i, "i", "Row(f=1)")
+                assert out["results"][0]["columns"] == sorted(cols)
+
+    def test_intersect_count_across_nodes(self):
+        with TestCluster(3) as tc:
+            _populate(tc)
+            # Row 3 = same columns as row 1 on shards 0..2
+            for s in range(3):
+                tc.query(1, "i", f"Set({s * SHARD_WIDTH + s + 1}, f=3)")
+            tc.await_shard_convergence("i")
+            out = tc.query(2, "i", "Count(Intersect(Row(f=1), Row(f=3)))")
+            assert out["results"][0] == 3
+
+    def test_topn_distributed(self):
+        with TestCluster(3) as tc:
+            _populate(tc)
+            out = tc.query(1, "i", "TopN(f, n=2)")
+            pairs = out["results"][0]
+            assert pairs[0] == {"id": 1, "count": N_SHARDS}
+            assert pairs[1] == {"id": 2, "count": N_SHARDS // 2}
+
+    def test_sum_bsi_distributed(self):
+        with TestCluster(3) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            tc.create_field("i", "v", {"type": "int", "min": 0, "max": 1000})
+            total = 0
+            for s in range(N_SHARDS):
+                col = s * SHARD_WIDTH + 3
+                val = 10 * (s + 1)
+                total += val
+                tc.query(s % 3, "i", f"Set({col}, v={val})")
+                tc.query(s % 3, "i", f"Set({col}, f=1)")
+            tc.await_shard_convergence("i")
+            out = tc.query(2, "i", "Sum(field=v)")
+            assert out["results"][0] == {"value": total, "count": N_SHARDS}
+            out = tc.query(1, "i", "Max(field=v)")
+            assert out["results"][0] == {"value": 10 * N_SHARDS, "count": 1}
+
+    def test_rows_and_groupby_distributed(self):
+        with TestCluster(3) as tc:
+            _populate(tc)
+            out = tc.query(0, "i", "Rows(f)")
+            assert out["results"][0] == {"rows": [1, 2]}
+            out = tc.query(1, "i", "GroupBy(Rows(f))")
+            groups = out["results"][0]
+            assert {g["group"][0]["rowID"]: g["count"] for g in groups} == {
+                1: N_SHARDS,
+                2: N_SHARDS // 2,
+            }
+
+    def test_clear_and_clearrow_distributed(self):
+        with TestCluster(3) as tc:
+            cols = _populate(tc)
+            out = tc.query(1, "i", f"Clear({cols[0]}, f=1)")
+            assert out["results"][0] is True
+            assert tc.query(2, "i", "Count(Row(f=1))")["results"][0] == N_SHARDS - 1
+            tc.query(0, "i", "ClearRow(f=2)")
+            assert tc.query(1, "i", "Count(Row(f=2))")["results"][0] == 0
+
+
+class TestReplication:
+    def test_writes_reach_all_replicas(self):
+        with TestCluster(3, replica_n=2) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            col = 5
+            tc.query(0, "i", f"Set({col}, f=1)")
+            owners = tc[0].cluster.topology.shard_nodes("i", 0)
+            assert len(owners) == 2
+            for owner in owners:
+                cn = next(n for n in tc.nodes if n.node.id == owner.id)
+                f = cn.holder.index("i").field("f")
+                assert f.row(1, 0).includes_column(col), owner.id
+
+    def test_clearrow_replicated_survives_primary_down(self):
+        with TestCluster(3, replica_n=2) as tc:
+            _populate(tc)
+            tc.query(0, "i", "ClearRow(f=2)")
+            # Kill each node in turn conceptually: clearing must have hit
+            # every replica, so any single-node outage can't resurrect row 2.
+            tc[1].server.close()
+            assert tc.query(0, "i", "Count(Row(f=2))")["results"][0] == 0
+
+    def test_import_routed_to_owners(self):
+        with TestCluster(3, replica_n=2) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 11 for s in range(N_SHARDS)]
+            # Import through node 0 regardless of ownership.
+            tc[0].api.import_bits("i", "f", [1] * len(cols), cols)
+            tc.await_shard_convergence("i")
+            # Visible cluster-wide from every node.
+            for i in range(3):
+                assert tc.query(i, "i", "Count(Row(f=1))")["results"][0] == len(cols)
+            # And present on BOTH replicas of each shard locally.
+            for s in range(N_SHARDS):
+                for owner in tc[0].cluster.topology.shard_nodes("i", s):
+                    cn = next(n for n in tc.nodes if n.node.id == owner.id)
+                    f = cn.holder.index("i").field("f")
+                    assert f.row(1, s).includes_column(cols[s]), (s, owner.id)
+
+    def test_import_values_routed(self):
+        with TestCluster(3) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "v", {"type": "int", "min": 0, "max": 10**6})
+            cols = [s * SHARD_WIDTH + 1 for s in range(N_SHARDS)]
+            vals = [100 * (s + 1) for s in range(N_SHARDS)]
+            tc[1].api.import_values("i", "v", cols, vals)
+            tc.await_shard_convergence("i")
+            out = tc.query(2, "i", "Sum(field=v)")
+            assert out["results"][0] == {"value": sum(vals), "count": len(vals)}
+
+    def test_count_survives_node_down(self):
+        with TestCluster(3, replica_n=2) as tc:
+            cols = _populate(tc)
+            # Kill a non-coordinator node's server; query through node 0.
+            tc[2].server.close()
+            out = tc.query(0, "i", "Count(Row(f=1))")
+            assert out["results"][0] == len(cols)
+
+    def test_unreplicated_shard_unavailable_raises(self):
+        with TestCluster(3, replica_n=1) as tc:
+            _populate(tc)
+            tc[2].server.close()
+            # Some shard owned solely by node2 -> error (reference
+            # errShardUnavailable path) unless node 0/1 own everything.
+            owned_by_2 = [
+                s for s in range(N_SHARDS)
+                if tc[0].cluster.topology.primary_for_shard("i", s).id == "node2"
+            ]
+            if owned_by_2:
+                from pilosa_tpu.server.api import APIError
+
+                # Must surface as a clean APIError (503/502), not a 500
+                # PANIC traceback.
+                with pytest.raises(APIError):
+                    tc.query(0, "i", "Count(Row(f=1))")
+
+
+class TestSchemaPropagation:
+    def test_ddl_broadcast(self):
+        with TestCluster(3) as tc:
+            tc.create_index("idx1")
+            tc.create_field("idx1", "fld1")
+            for cn in tc.nodes:
+                idx = cn.holder.index("idx1")
+                assert idx is not None
+                assert idx.field("fld1") is not None
+
+    def test_attrs_replicated(self):
+        with TestCluster(3) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            tc.query(0, "i", 'SetRowAttrs(f, 1, color="red")')
+            for cn in tc.nodes:
+                f = cn.holder.index("i").field("f")
+                assert f.row_attr_store.attrs(1) == {"color": "red"}
+
+
+class TestInternalClientHTTP:
+    def test_query_node_over_http(self):
+        with TestCluster(2) as tc:
+            _populate(tc)
+            client = InternalClient()
+            out = client.query_node(
+                tc[1].node, "i", "Count(Row(f=1))", shards=[0], remote=False
+            )
+            # Non-remote query through node1 fans out cluster-wide for
+            # shard 0 only.
+            assert out["results"][0] == 1
+
+    def test_status_and_nodes(self):
+        with TestCluster(2) as tc:
+            client = InternalClient()
+            st = client.status(tc[0].node)
+            assert st["state"] == "NORMAL"
+            assert len(st["nodes"]) == 2
