@@ -283,6 +283,20 @@ class Executor:
         result = self.map_reduce(index, shards, c, opt, map_fn, lambda a, b: a + b)
         return int(result or 0)
 
+    def _bsi_fast(self, kind, index, f, c, shards) -> Optional[ValCount]:
+        """Device fast path for Sum/Min/Max: fused plane popcounts in one
+        dispatch (+psum over ICI on a mesh) instead of per-shard host
+        scans. None = not lowerable; caller runs the map-reduce path."""
+        if self.mapper is not None or not hasattr(self.backend, kind):
+            return None
+        r = getattr(self.backend, kind)(
+            index, f.name, shards, c.children[0] if c.children else None
+        )
+        if r is None:
+            return None
+        val, cnt = r
+        return ValCount(val, cnt) if cnt else ValCount()
+
     def _agg_field(self, index, c):
         field_name, ok = c.string_arg("field")
         if not ok:
@@ -302,6 +316,10 @@ class Executor:
         if len(c.children) > 1:
             raise QueryError("Sum() only accepts a single bitmap input")
 
+        fast = self._bsi_fast("bsi_sum", index, f, c, shards)
+        if fast is not None:
+            return fast
+
         def map_fn(shard):
             filt = self._filter_row_shard(index, c, shard)
             s, cnt = f.sum(filt, shard)
@@ -319,6 +337,10 @@ class Executor:
         f = self._agg_field(index, c)
         if len(c.children) > 1:
             raise QueryError("Min() only accepts a single bitmap input")
+
+        fast = self._bsi_fast("bsi_min", index, f, c, shards)
+        if fast is not None:
+            return fast
 
         def map_fn(shard):
             filt = self._filter_row_shard(index, c, shard)
@@ -342,6 +364,10 @@ class Executor:
         f = self._agg_field(index, c)
         if len(c.children) > 1:
             raise QueryError("Max() only accepts a single bitmap input")
+
+        fast = self._bsi_fast("bsi_max", index, f, c, shards)
+        if fast is not None:
+            return fast
 
         def map_fn(shard):
             filt = self._filter_row_shard(index, c, shard)

@@ -9,19 +9,30 @@ Execution model (the part that makes this TPU-first rather than a port):
   jitted function: Row leaves become dynamic row-gathers from the stacked
   blocks (row ids are traced scalars, so consecutive queries with
   different rows reuse the compiled program), bitmap verbs are fused
-  bitwise ops over [S, W] slabs, and Count/TopN reduce on device. One
+  bitwise ops over [S, W] slabs, BSI comparisons are plane scans with
+  traced predicate bits, and Count/TopN/Sum reduce on device. One
   dispatch + one small transfer per query — essential when the chip is
   reached over a relay where every dispatch costs a round trip.
 - The reference's per-shard mapReduce loop (executor.go:2460) therefore
-  disappears into XLA: the shard axis is just the leading array dim
-  (single chip) or the mesh axis (multi-chip, pilosa_tpu/parallel).
+  disappears into XLA: the shard axis is the leading array dim on a
+  single chip, or a jax.sharding.Mesh axis on multiple chips. With a
+  mesh, blocks are placed with NamedSharding(P('shards')) so each device
+  holds its shards in local HBM, and reductions run under shard_map with
+  lax.psum over ICI — the XLA-collective replacement for the reference's
+  HTTP scatter-gather (SURVEY.md §2.2, BASELINE.json north star).
 
 TopN is *exact* on this backend: popcount of every row is one fused
 kernel, so the reference's approximate rank-cache candidates + 2-pass
 recount (executor.go:860) collapses into one exact pass (SURVEY.md §3.4).
 
-BSI comparison scans and time-quantum unions currently delegate to the
-CPU oracle — correct first; device lowering is a later round.
+BSI aggregates (Sum/Min/Max) and comparisons (==, !=, <, <=, >, >=,
+BETWEEN) lower to masked bitwise+popcount plane passes mirroring the
+reference's algorithms (fragment.go:1111-1537); predicate magnitudes ride
+in as traced uint32 bit-vectors so one compiled program serves any
+predicate value of the same (op, sign, bit-depth) shape.
+
+HBM residency: stacks are LRU-tracked against a byte budget; stacks too
+large to ever fit fall back to the CPU oracle (SURVEY.md §7 hard part c).
 """
 
 from __future__ import annotations
@@ -32,58 +43,115 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.core.fragment import BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT
 from pilosa_tpu.core.row import Row
-from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.core.timequantum import parse_time, views_by_time_range
+from pilosa_tpu.core.view import VIEW_STANDARD, bsi_view_name
 from pilosa_tpu.exec.cpu import CPUBackend, QueryError
 from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, _padded_rows, pack_fragment, unpack_row
-from pilosa_tpu.pql.ast import Call, Condition
+from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
 
-_DEVICE_LOWERED = ("Row", "Union", "Intersect", "Difference", "Xor", "Not", "All")
+_DEVICE_LOWERED = ("Row", "Range", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift")
 
 # Per-(shard,row) popcounts are ≤2^20, so an on-device uint32 reduction over
 # the shard axis is exact up to 4095 shards (4096·2^20 = 2^32). Beyond that
 # the programs return per-shard partials and the host sums in Python ints.
 MAX_DEVICE_SUM_SHARDS = 4095
 
+# BSI min/max assemble values from per-plane decision bits on the host, so
+# depth is bounded only by the spec key; sums weight plane counts in exact
+# Python ints. Depths beyond this are out of int64 BSI range anyway.
+MAX_BSI_DEPTH = 63
+
+
+class _Unsupported(Exception):
+    """Raised by the spec builder when a call can't be device-lowered."""
+
 
 class _StackedBlocks:
-    """Device cache: (index, field, shards) -> uint32[S, R, W] + freshness."""
+    """Device cache: (index, field, view) -> uint32[S, R, W] + freshness.
 
-    def __init__(self, device=None):
+    With a mesh, the shard axis is padded to a multiple of the device count
+    and placed with NamedSharding(P('shards')) so each device holds its
+    shards in local HBM. An optional byte budget LRU-evicts whole stacks
+    (the HBM residency policy; resident_bytes feeds /metrics).
+    """
+
+    def __init__(self, device=None, mesh=None, max_bytes: Optional[int] = None):
         self.device = device
+        self.mesh = mesh  # ShardMesh or None
+        self.max_bytes = max_bytes
         self._entries: dict[tuple, tuple[tuple, object, int]] = {}
+        self.evictions = 0
 
-    def get(self, index: str, field_obj, shards: tuple[int, ...]):
-        """Returns (block [S,R,W], rows_p). Missing fragments pack as zeros."""
-        v = field_obj.view(VIEW_STANDARD)
+    def _pad_shards(self, n: int) -> int:
+        if self.mesh is None or self.mesh.n <= 1:
+            return n
+        m = self.mesh.n
+        return ((n + m - 1) // m) * m
+
+    def _put(self, host: np.ndarray):
+        if self.mesh is not None and self.mesh.n > 1:
+            sharding = NamedSharding(self.mesh.mesh, P(self.mesh.axis, None, None))
+            return jax.device_put(host, sharding)
+        return jax.device_put(host, self.device)
+
+    def get(self, index: str, field_obj, shards: tuple[int, ...],
+            view_name: str = VIEW_STANDARD, min_rows: int = 1):
+        """Returns (block [S_pad,R,W], rows_p). Missing fragments pack as
+        zeros; padded shards are all-zero (they contribute nothing to any
+        count/bitwise result). min_rows forces taller stacks (BSI plane
+        count independent of stored max row)."""
+        v = field_obj.view(view_name)
         frags = {s: (v.fragment(s) if v is not None else None) for s in shards}
         n_rows = max(
-            [fr.max_row_id + 1 for fr in frags.values() if fr is not None] or [1]
+            [fr.max_row_id + 1 for fr in frags.values() if fr is not None] + [min_rows]
         )
         rows_p = _padded_rows(n_rows)
+        s_pad = self._pad_shards(len(shards))
         # Freshness via the fragment's process-unique uid + version (id()
         # could be reused by a new object after GC and serve stale blocks).
         fingerprint = tuple(
             (s, (fr.uid, fr.version) if fr is not None else None)
             for s, fr in frags.items()
-        ) + (rows_p,)
-        # Keyed by (index, field) only: a changed shard set REPLACES the
-        # cached stack rather than accumulating per-subset copies in HBM.
-        key = (index, field_obj.name)
+        ) + (rows_p, s_pad)
+        # Keyed by (index, field, view) only: a changed shard set REPLACES
+        # the cached stack rather than accumulating per-subset copies in HBM.
+        key = (index, field_obj.name, view_name)
         cached = self._entries.get(key)
         if cached is not None and cached[0] == fingerprint:
+            # LRU touch.
+            self._entries[key] = self._entries.pop(key)
             return cached[1], cached[2]
-        host = np.zeros((len(shards), rows_p, WORDS_PER_SHARD), dtype=np.uint32)
+        nbytes = s_pad * rows_p * WORDS_PER_SHARD * 4
+        if self.max_bytes is not None and nbytes > self.max_bytes:
+            # Stack can never be resident under the budget: the caller
+            # falls back to the CPU oracle instead of blowing HBM.
+            return None, rows_p
+        host = np.zeros((s_pad, rows_p, WORDS_PER_SHARD), dtype=np.uint32)
         for i, s in enumerate(shards):
             fr = frags[s]
             if fr is not None:
                 host[i] = pack_fragment(fr, n_rows=rows_p)
-        arr = jax.device_put(host, self.device)
+        arr = self._put(host)
+        self._entries.pop(key, None)
         self._entries[key] = (fingerprint, arr, rows_p)
+        self._evict(keep=key)
         return arr, rows_p
+
+    def _evict(self, keep: tuple) -> None:
+        if self.max_bytes is None:
+            return
+        while self.resident_bytes() > self.max_bytes and len(self._entries) > 1:
+            victim = next(k for k in self._entries if k != keep)
+            self._entries.pop(victim)
+            self.evictions += 1
 
     def resident_bytes(self) -> int:
         return sum(int(np.prod(e[1].shape)) * 4 for e in self._entries.values())
@@ -92,52 +160,211 @@ class _StackedBlocks:
         self._entries.clear()
 
 
-def _tree_key(c: Call):
-    """Canonical structural key for a call tree; Row leaves keyed by field
-    so one compiled program serves any row ids of that field."""
-    if c.name == "Row":
-        return ("R", c.field_arg())
-    if c.name == "All":
-        return ("A",)
-    if c.name == "Not":
-        return ("N", _tree_key(c.children[0]))
-    return (c.name[0], tuple(_tree_key(ch) for ch in c.children))
-
-
-def _spec_needs_existence(spec) -> bool:
-    if spec[0] in ("A", "N"):
+def _spec_batchable(spec) -> bool:
+    """Batched (vectorized-row) programs support plain-row trees only."""
+    tag = spec[0]
+    if tag == "R":
         return True
-    if spec[0] in ("U", "I", "D", "X"):
-        return any(_spec_needs_existence(ch) for ch in spec[1])
+    if tag in ("U", "I", "D", "X"):
+        return all(_spec_batchable(ch) for ch in spec[1])
     return False
 
 
-def _eval_spec(spec, blocks_it, rows_it, exist_slab, batched=False):
+# ---------------------------------------------------------------------------
+# trace-time evaluation of a spec tree
+# ---------------------------------------------------------------------------
+
+
+def _where(cond, a, b):
+    return jnp.where(cond, a, b)
+
+
+def _bsi_slabs(block, depth):
+    """exists/sign/plane slabs from a stacked BSI view block [S, R, W]."""
+    exists = block[:, BSI_EXISTS_BIT, :]
+    sign = block[:, BSI_SIGN_BIT, :]
+    planes = [block[:, BSI_OFFSET_BIT + i, :] for i in range(depth)]
+    return exists, sign, planes
+
+
+def _lt_unsigned(filt, planes, bits, depth, allow_eq):
+    """Traced-predicate port of fragment.rangeLTUnsigned (fragment.go:1440)
+    with the documented strict-<0 fix (see core/fragment.py:481)."""
+    zeros = jnp.zeros_like(filt)
+    keep = zeros
+    lz = jnp.bool_(True)
+    if not allow_eq:
+        zero_pred = jnp.bool_(True)
+        for i in range(depth):
+            zero_pred = zero_pred & (bits[i] == 0)
+    for i in range(depth - 1, -1, -1):
+        plane = planes[i]
+        bit = bits[i] != 0
+        skip = lz & ~bit
+        if i == 0 and not allow_eq:
+            res = _where(skip, filt & ~plane, _where(bit, filt & ~(plane & ~keep), keep))
+            return _where(zero_pred, zeros, res)
+        new_filt = _where(skip, filt & ~plane, _where(bit, filt, filt & ~(plane & ~keep)))
+        if i > 0:
+            keep = _where(~skip & bit, keep | (filt & ~plane), keep)
+        filt = new_filt
+        lz = lz & ~bit
+    if not allow_eq:
+        return _where(zero_pred, zeros, filt)
+    return filt
+
+
+def _gt_unsigned(filt, planes, bits, depth, allow_eq):
+    """Traced-predicate port of fragment.rangeGTUnsigned (fragment.go:1471)."""
+    keep = jnp.zeros_like(filt)
+    for i in range(depth - 1, -1, -1):
+        plane = planes[i]
+        bit = bits[i] != 0
+        if i == 0 and not allow_eq:
+            return _where(bit, keep, filt & ~((filt & ~plane) & ~keep))
+        new_filt = _where(bit, filt & ~((filt & ~plane) & ~keep), filt)
+        if i > 0:
+            keep = _where(bit, keep, keep | (filt & plane))
+        filt = new_filt
+    return filt
+
+
+def _between_unsigned(filt, planes, lo_bits, hi_bits, depth):
+    """Traced-predicate port of fragment.rangeBetweenUnsigned (:1504)."""
+    keep1 = jnp.zeros_like(filt)
+    keep2 = jnp.zeros_like(filt)
+    for i in range(depth - 1, -1, -1):
+        plane = planes[i]
+        b1 = lo_bits[i] != 0
+        b2 = hi_bits[i] != 0
+        new_filt = _where(b1, filt & ~((filt & ~plane) & ~keep1), filt)
+        if i > 0:
+            keep1 = _where(b1, keep1, keep1 | (filt & plane))
+        filt = new_filt
+        new_filt = _where(b2, filt, filt & ~(plane & ~keep2))
+        if i > 0:
+            keep2 = _where(b2, keep2 | (filt & ~plane), keep2)
+        filt = new_filt
+    return filt
+
+
+def _eq_slab(exists, sign, planes, bits, depth, neg):
+    b = (exists & sign) if neg else (exists & ~sign)
+    for i in range(depth - 1, -1, -1):
+        bit = bits[i] != 0
+        b = _where(bit, b & planes[i], b & ~planes[i])
+    return b
+
+
+def _shift_slab(slab, n: int):
+    """Shift all bits up by n within each shard slab (word axis is last;
+    little-endian bit order within uint32 words). Bits crossing the shard
+    boundary drop, matching segment-local Row.Shift (core/row.py:77)."""
+    if n == 0:
+        return slab
+    s_words, s_bits = divmod(n, 32)
+    W = slab.shape[-1]
+    pad = [(0, 0)] * (slab.ndim - 1)
+
+    def word_shifted(k):
+        if k >= W:
+            return jnp.zeros_like(slab)
+        return jnp.pad(slab, pad + [(k, 0)])[..., :W]
+
+    lo = word_shifted(s_words)
+    if s_bits == 0:
+        return lo
+    hi = word_shifted(s_words + 1)
+    return (lo << np.uint32(s_bits)) | (hi >> np.uint32(32 - s_bits))
+
+
+def _eval_spec(spec, blocks_it, scalars_it, batched=False):
     """Trace-time recursive evaluation of a tree spec.
 
     Unbatched: row scalars, result [S, W]. Batched: row vectors [Q],
     result [S, Q, W] — Q same-shape queries fused into one program (the
     serving-style batching that amortizes dispatch+readback round trips).
+    Both iterators are consumed in the exact order _build_spec emitted.
     """
     tag = spec[0]
     if tag == "R":
         block = next(blocks_it)  # [S, R, W]
-        row = next(rows_it)  # scalar or [Q]
-        mask = next(rows_it)
+        row = next(scalars_it)  # scalar or [Q]
+        mask = next(scalars_it)
         slab = jnp.take(block, row, axis=1)  # [S, W] or [S, Q, W]
         if batched:
             return slab * mask[None, :, None]
         return slab * mask  # mask=0 zeroes rows beyond the packed range
+    if tag == "T":
+        # Time-range row: union of per-view row slabs (executor.go:1441).
+        n_views = spec[2]
+        acc = None
+        for _ in range(n_views):
+            block = next(blocks_it)
+            row = next(scalars_it)
+            mask = next(scalars_it)
+            slab = jnp.take(block, row, axis=1) * mask
+            acc = slab if acc is None else acc | slab
+        return acc
     if tag == "A":
-        return exist_slab[:, None, :] if batched else exist_slab
+        block = next(blocks_it)  # existence stack
+        ex = block[:, 0, :]
+        return ex[:, None, :] if batched else ex
     if tag == "N":
-        inner = _eval_spec(spec[1], blocks_it, rows_it, exist_slab, batched)
-        ex = exist_slab[:, None, :] if batched else exist_slab
+        block = next(blocks_it)  # existence stack
+        ex = block[:, 0, :]
+        inner = _eval_spec(spec[1], blocks_it, scalars_it, batched)
+        if batched:
+            ex = ex[:, None, :]
         return ex & ~inner
+    if tag == "E":
+        block = next(blocks_it)  # consumed for shape only
+        return jnp.zeros_like(block[:, 0, :])
+    if tag == "NN":
+        block = next(blocks_it)  # BSI view stack
+        return block[:, BSI_EXISTS_BIT, :]
+    if tag == "C":
+        # BSI comparison: ("C", field, op, neg_pred, allow_eq, depth)
+        _, _fname, op, neg, allow_eq, depth = spec
+        block = next(blocks_it)
+        bits = next(scalars_it)  # uint32[depth]
+        exists, sign, planes = _bsi_slabs(block, depth)
+        if op == "==":
+            return _eq_slab(exists, sign, planes, bits, depth, neg)
+        if op == "!=":
+            return exists & ~_eq_slab(exists, sign, planes, bits, depth, neg)
+        if op == "<":
+            if not neg:
+                pos = _lt_unsigned(exists & ~sign, planes, bits, depth, allow_eq)
+                return (sign & exists) | pos
+            return _gt_unsigned(exists & sign, planes, bits, depth, allow_eq)
+        # op == ">"
+        if not neg:
+            return _gt_unsigned(exists & ~sign, planes, bits, depth, allow_eq)
+        negs = _lt_unsigned(exists & sign, planes, bits, depth, allow_eq)
+        return (exists & ~sign) | negs
+    if tag == "CB":
+        # BSI between: ("CB", field, cls, depth) — fragment.rangeBetween :1504
+        _, _fname, cls, depth = spec
+        block = next(blocks_it)
+        lo_bits = next(scalars_it)
+        hi_bits = next(scalars_it)
+        exists, sign, planes = _bsi_slabs(block, depth)
+        if cls == "pos":
+            return _between_unsigned(exists & ~sign, planes, lo_bits, hi_bits, depth)
+        if cls == "neg":
+            # negative range: magnitudes swap (|hi| <= mag <= |lo|)
+            return _between_unsigned(exists & sign, planes, hi_bits, lo_bits, depth)
+        pos = _lt_unsigned(exists & ~sign, planes, hi_bits, depth, True)
+        neg = _lt_unsigned(exists & sign, planes, lo_bits, depth, True)
+        return pos | neg
+    if tag == "S":
+        inner = _eval_spec(spec[2], blocks_it, scalars_it, batched)
+        return _shift_slab(inner, spec[1])
     children = spec[1]
-    acc = _eval_spec(children[0], blocks_it, rows_it, exist_slab, batched)
+    acc = _eval_spec(children[0], blocks_it, scalars_it, batched)
     for ch in children[1:]:
-        v = _eval_spec(ch, blocks_it, rows_it, exist_slab, batched)
+        v = _eval_spec(ch, blocks_it, scalars_it, batched)
         if tag == "U":
             acc = acc | v
         elif tag == "I":
@@ -149,171 +376,431 @@ def _eval_spec(spec, blocks_it, rows_it, exist_slab, batched=False):
     return acc
 
 
+def _pred_bits(value: int, depth: int) -> np.ndarray:
+    return np.array([(value >> i) & 1 for i in range(depth)], dtype=np.uint32)
+
+
 class TPUBackend:
     """Drop-in replacement for CPUBackend with device execution.
 
     Anything not device-lowered falls back to the CPU oracle — results are
-    identical (differentially tested in tests/test_tpu.py).
+    identical (differentially tested in tests/test_tpu.py). Pass a
+    ShardMesh to shard the stacked blocks over multiple devices; count
+    programs then run under shard_map with psum over ICI.
     """
 
-    def __init__(self, holder, device=None):
+    def __init__(self, holder, device=None, mesh=None, max_bytes: Optional[int] = None):
         self.holder = holder
         self.cpu = CPUBackend(holder)
-        self.blocks = _StackedBlocks(device)
+        self.mesh = mesh if (mesh is not None and mesh.n > 1) else None
+        self.blocks = _StackedBlocks(device, self.mesh, max_bytes)
         self._fns: dict = {}
 
-    # -- support checks ----------------------------------------------------
+    # -- spec + leaf assembly ---------------------------------------------
 
-    def _device_supported(self, c: Call) -> bool:
+    def _get_block(self, index, field_obj, shards, view_name=VIEW_STANDARD, min_rows=1):
+        """Stack fetch that falls back (raises) when the stack can't be
+        resident under the HBM budget."""
+        block, rows_p = self.blocks.get(index, field_obj, shards, view_name, min_rows)
+        if block is None:
+            raise _Unsupported("stack exceeds HBM budget")
+        return block, rows_p
+
+    def _field(self, index: str, name: str):
+        idx = self.holder.index(index)
+        f = idx.field(name) if idx else None
+        if f is None:
+            raise QueryError(f"field not found: {name}")
+        return f
+
+    def _build(self, index: str, c: Call, shards: tuple[int, ...],
+               blocks: list, scalars: list):
+        """One pass building (spec, device leaves). Raises _Unsupported for
+        anything without a device lowering; callers fall back to the CPU
+        oracle, which also produces the reference's error strings."""
         if c.name not in _DEVICE_LOWERED:
-            return False
-        if c.name == "Row":
-            if any(isinstance(v, Condition) for v in c.args.values()):
-                return False
-            if "from" in c.args or "to" in c.args:
-                return False
-            try:
-                c.field_arg()
-            except ValueError:
-                return False
-            return True
-        if c.name in ("Union", "Intersect", "Difference", "Xor") and not c.children:
-            return False  # CPU path produces the reference error/empty result
-        if c.name == "Not" and len(c.children) != 1:
-            return False  # CPU path raises the reference arity error
-        return all(self._device_supported(ch) for ch in c.children)
+            raise _Unsupported(c.name)
+        if c.name in ("Row", "Range"):
+            return self._build_row(index, c, shards, blocks, scalars)
+        if c.name == "All":
+            if c.args:
+                raise _Unsupported("All with args")
+            self._push_existence(index, shards, blocks)
+            return ("A",)
+        if c.name == "Not":
+            if len(c.children) != 1:
+                raise _Unsupported("Not arity")
+            self._push_existence(index, shards, blocks)
+            child = self._build(index, c.children[0], shards, blocks, scalars)
+            return ("N", child)
+        if c.name == "Shift":
+            n, _ = c.int_arg("n")
+            if n < 0 or len(c.children) != 1:
+                raise _Unsupported("Shift")
+            child = self._build(index, c.children[0], shards, blocks, scalars)
+            return ("S", n, child)
+        # n-ary bitwise verbs
+        if not c.children:
+            raise _Unsupported("empty verb")  # CPU path yields reference error/empty
+        kids = tuple(
+            self._build(index, ch, shards, blocks, scalars) for ch in c.children
+        )
+        return ({"Union": "U", "Intersect": "I", "Difference": "D", "Xor": "X"}[c.name], kids)
 
-    # -- assembly ----------------------------------------------------------
-
-    def _collect_leaves(self, index: str, c: Call, shards: tuple[int, ...],
-                        blocks: list, rows: list) -> None:
-        """Depth-first leaf collection matching _eval_spec's iteration order."""
-        if c.name == "Row":
-            field_name = c.field_arg()
-            row_id, ok = c.uint64_arg(field_name)
-            if not ok:
-                raise QueryError("Row() must specify row")
-            idx = self.holder.index(index)
-            f = idx.field(field_name) if idx else None
-            if f is None:
-                raise QueryError(f"field not found: {field_name}")
-            block, rows_p = self.blocks.get(index, f, shards)
-            blocks.append(block)
-            rows.append(np.uint32(min(row_id, rows_p - 1)))
-            rows.append(np.uint32(1 if row_id < rows_p else 0))
-            return
-        for ch in c.children:
-            self._collect_leaves(index, ch, shards, blocks, rows)
-
-    def _existence_block(self, index: str, shards: tuple[int, ...]):
+    def _push_existence(self, index: str, shards, blocks) -> None:
         idx = self.holder.index(index)
         ef = idx.existence_field() if idx else None
         if ef is None:
-            raise QueryError(f"index does not support existence tracking: {index}")
-        block, _ = self.blocks.get(index, ef, shards)
-        return block
+            raise _Unsupported("no existence field")
+        block, _ = self._get_block(index, ef, shards)
+        blocks.append(block)
 
-    def _assemble(self, index: str, c: Call, shards: tuple[int, ...], spec):
+    def _build_row(self, index, c, shards, blocks, scalars):
+        cond_args = [(k, v) for k, v in c.args.items() if isinstance(v, Condition)]
+        if cond_args:
+            return self._build_bsi(index, c, shards, blocks, scalars, cond_args)
+
+        field_name = c.field_arg()
+        f = self._field(index, field_name)
+        row_id, ok = c.uint64_arg(field_name)
+        if not ok:
+            raise QueryError("Row() must specify row")
+
+        if "from" in c.args or "to" in c.args:
+            return self._build_time_row(index, c, f, row_id, shards, blocks, scalars)
+
+        block, rows_p = self._get_block(index, f, shards)
+        blocks.append(block)
+        scalars.append(np.uint32(min(row_id, rows_p - 1)))
+        scalars.append(np.uint32(1 if row_id < rows_p else 0))
+        return ("R", field_name)
+
+    def _build_time_row(self, index, c, f, row_id, shards, blocks, scalars):
+        """Row(f=r, from=, to=) — union over quantum views (executor.go:1441)."""
+        import datetime as dt
+
+        if not f.options.time_quantum:
+            # Reference returns empty for non-time fields with a range.
+            self._push_bsi_or_field_block(index, f, shards, blocks)
+            return ("E",)
+        from_t = parse_time(c.args["from"]) if "from" in c.args else dt.datetime(1, 1, 1)
+        to_t = (
+            parse_time(c.args["to"])
+            if "to" in c.args
+            else dt.datetime.utcnow() + dt.timedelta(days=1)
+        )
+        views = [
+            vn
+            for vn in views_by_time_range(VIEW_STANDARD, from_t, to_t, f.options.time_quantum)
+            if f.view(vn) is not None
+        ]
+        if not views:
+            self._push_bsi_or_field_block(index, f, shards, blocks)
+            return ("E",)
+        for vn in views:
+            block, rows_p = self._get_block(index, f, shards, view_name=vn)
+            blocks.append(block)
+            scalars.append(np.uint32(min(row_id, rows_p - 1)))
+            scalars.append(np.uint32(1 if row_id < rows_p else 0))
+        return ("T", f.name, len(views))
+
+    def _push_bsi_or_field_block(self, index, f, shards, blocks) -> None:
+        """Push any block purely as a shape carrier for an ("E",) node."""
+        block, _ = self._get_block(index, f, shards)
+        blocks.append(block)
+
+    def _build_bsi(self, index, c, shards, blocks, scalars, cond_args):
+        """BSI condition → resolved spec. Mirrors executeRowBSIGroupShard
+        (executor.go:1533) + bsiGroup.baseValue (field.go:1584); the
+        resolution (out-of-range/encompassing) happens here at assembly so
+        the compiled program shape encodes only (op, sign, depth)."""
+        if len(c.args) > 1:
+            raise _Unsupported("Row(): too many arguments")
+        field_name, cond = cond_args[0]
+        f = self._field(index, field_name)
+        if f.options.type != FIELD_TYPE_INT:
+            raise _Unsupported("condition on non-int field")
+        opts = f.bsi_group()
+        depth = opts.bit_depth
+        if depth > MAX_BSI_DEPTH:
+            raise _Unsupported("bit depth")
+        vname = bsi_view_name(field_name)
+
+        def push_block():
+            block, _ = self._get_block(
+                index, f, shards, view_name=vname, min_rows=BSI_OFFSET_BIT + depth
+            )
+            blocks.append(block)
+
+        if cond.op == NEQ and cond.value is None:
+            push_block()
+            return ("NN", field_name)
+
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            if len(predicates) != 2:
+                raise QueryError(
+                    "Row(): BETWEEN condition requires exactly two integer values"
+                )
+            lo, hi = predicates
+            base_lo, base_hi, out_of_range = CPUBackend._base_value_between(f, lo, hi)
+            push_block()
+            if out_of_range:
+                return ("E",)
+            if lo <= opts.min and hi >= opts.max:
+                return ("NN", field_name)
+            if base_lo >= 0:
+                cls = "pos"
+                b1, b2 = abs(base_lo), abs(base_hi)
+            elif base_hi < 0:
+                cls = "neg"
+                # magnitudes swap for the all-negative range; _eval_spec
+                # swaps the operand order, so emit (|lo|, |hi|) as-is.
+                b1, b2 = abs(base_lo), abs(base_hi)
+            else:
+                cls = "mixed"
+                b1, b2 = abs(base_lo), abs(base_hi)
+            scalars.append(_pred_bits(b1, depth))
+            scalars.append(_pred_bits(b2, depth))
+            return ("CB", field_name, cls, depth)
+
+        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
+            raise QueryError("Row(): conditions only support integer values")
+        value = cond.value
+        base_value, out_of_range = CPUBackend._base_value(f, cond.op, value)
+        push_block()
+        if out_of_range and cond.op != NEQ:
+            return ("E",)
+        if (
+            (cond.op == LT and value > opts.max)
+            or (cond.op == LTE and value >= opts.max)
+            or (cond.op == GT and value < opts.min)
+            or (cond.op == GTE and value <= opts.min)
+        ):
+            return ("NN", field_name)
+        if out_of_range and cond.op == NEQ:
+            return ("NN", field_name)
+        op = {EQ: "==", NEQ: "!=", LT: "<", LTE: "<", GT: ">", GTE: ">"}[cond.op]
+        allow_eq = cond.op in (LTE, GTE)
+        neg = base_value < 0
+        scalars.append(_pred_bits(abs(base_value), depth))
+        return ("C", field_name, op, neg, allow_eq, depth)
+
+    def _assemble(self, index: str, c: Call, shards: tuple[int, ...]):
         blocks: list = []
-        rows: list = []
-        self._collect_leaves(index, c, shards, blocks, rows)
-        if _spec_needs_existence(spec):
-            exist = self._existence_block(index, shards)
-        else:
-            exist = None
-        return tuple(blocks), tuple(rows), exist
+        scalars: list = []
+        spec = self._build(index, c, shards, blocks, scalars)
+        return spec, tuple(blocks), tuple(scalars)
 
     # -- compiled programs -------------------------------------------------
 
-    def _program(self, kind: str, spec, with_exist: bool):
-        """One jitted program per (kind, tree-shape, existence-presence)."""
-        key = (kind, spec, with_exist)
+    def _wrap(self, body, extra_block: bool, out_specs):
+        """jit the body; under a mesh, run it per-device via shard_map with
+        psum collectives (out_specs describes the reduced outputs)."""
+        if self.mesh is None:
+            return jax.jit(body)
+        ax = self.mesh.axis
+        blk = P(ax)  # prefix spec: leading dim sharded, rest replicated
+        in_specs = (blk, P()) if not extra_block else (blk, blk, P())
+        return jax.jit(
+            shard_map(body, mesh=self.mesh.mesh, in_specs=in_specs, out_specs=out_specs)
+        )
+
+    def _psum(self, x):
+        return jax.lax.psum(x, self.mesh.axis) if self.mesh is not None else x
+
+    def _program(self, kind: str, spec, reduce_dev: bool, extra=None):
+        """One compiled program per (kind, tree-shape, reduction mode);
+        the spec tree fixes the leaf count, so it alone keys the shape."""
+        key = (kind, spec, reduce_dev, extra)
         fn = self._fns.get(key)
         if fn is not None:
             return fn
 
+        mesh = self.mesh
+        ax = P(mesh.axis) if mesh is not None else None
+
         if kind == "count":
 
-            @jax.jit
-            def fn(blocks, rows, exist_block):
-                exist_slab = (
-                    exist_block[:, 0, :] if exist_block is not None else None
-                )
-                slab = _eval_spec(spec, iter(blocks), iter(rows), exist_slab)
+            def body(blocks, scalars):
+                slab = _eval_spec(spec, iter(blocks), iter(scalars))
                 per_shard = jnp.sum(
                     jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32
                 )
-                # Shape is static at trace time: scalar-reduce on device
-                # only while the uint32 sum is exact; else return [S]
-                # partials for an exact host sum.
-                if per_shard.shape[0] <= MAX_DEVICE_SUM_SHARDS:
-                    return jnp.sum(per_shard, dtype=jnp.uint32)
+                if reduce_dev:
+                    return self._psum(jnp.sum(per_shard, dtype=jnp.uint32))
                 return per_shard
+
+            out = (P() if reduce_dev else ax) if mesh is not None else None
+            fn = self._wrap(body, False, out)
 
         elif kind == "vec":
 
-            @jax.jit
-            def fn(blocks, rows, exist_block):
-                exist_slab = (
-                    exist_block[:, 0, :] if exist_block is not None else None
+            def body(blocks, scalars):
+                return _eval_spec(spec, iter(blocks), iter(scalars))
+
+            fn = self._wrap(body, False, ax)
+
+        elif kind == "count_batch":
+
+            def body(blocks, scalars):
+                slab = _eval_spec(spec, iter(blocks), iter(scalars), batched=True)
+                per = jnp.sum(
+                    jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32
+                )  # [S, Q]
+                if reduce_dev:
+                    return self._psum(jnp.sum(per, axis=0, dtype=jnp.uint32))  # [Q]
+                return per
+
+            out = (P() if reduce_dev else ax) if mesh is not None else None
+            fn = self._wrap(body, False, out)
+
+        elif kind == "topn_plain":
+
+            def body(field_block):
+                per = jnp.sum(
+                    jax.lax.population_count(field_block), axis=-1, dtype=jnp.uint32
+                )  # [S, R]
+                if reduce_dev:
+                    return self._psum(jnp.sum(per, axis=0, dtype=jnp.uint32))
+                return per
+
+            if mesh is not None:
+                fn = jax.jit(
+                    shard_map(
+                        body,
+                        mesh=mesh.mesh,
+                        in_specs=(P(mesh.axis),),
+                        out_specs=P() if reduce_dev else P(mesh.axis),
+                    )
                 )
-                return _eval_spec(spec, iter(blocks), iter(rows), exist_slab)
+            else:
+                fn = jax.jit(body)
 
         elif kind == "topn_src":
 
-            @jax.jit
-            def fn(field_block, blocks, rows, exist_block):
-                exist_slab = (
-                    exist_block[:, 0, :] if exist_block is not None else None
-                )
-                src = _eval_spec(spec, iter(blocks), iter(rows), exist_slab)
+            def body(field_block, blocks, scalars):
+                src = _eval_spec(spec, iter(blocks), iter(scalars))
                 per = jnp.sum(
                     jax.lax.population_count(field_block & src[:, None, :]),
                     axis=-1,
                     dtype=jnp.uint32,
                 )  # [S, R]
-                if per.shape[0] <= MAX_DEVICE_SUM_SHARDS:
-                    return jnp.sum(per, axis=0, dtype=jnp.uint32)
+                if reduce_dev:
+                    return self._psum(jnp.sum(per, axis=0, dtype=jnp.uint32))
                 return per
 
-        elif kind == "count_batch":
+            out = (P() if reduce_dev else ax) if mesh is not None else None
+            fn = self._wrap(body, True, out)
 
-            @jax.jit
-            def fn(blocks, rows, exist_block):
-                exist_slab = (
-                    exist_block[:, 0, :] if exist_block is not None else None
+        elif kind == "bsi_sum":
+            depth = extra
+
+            def body(bsi_block, blocks, scalars):
+                exists, sign, planes = _bsi_slabs(bsi_block, depth)
+                consider = exists
+                if spec is not None:
+                    consider = consider & _eval_spec(spec, iter(blocks), iter(scalars))
+                neg = sign & consider
+                pos = consider & ~neg
+                plane_stack = jnp.stack(planes, axis=1) if depth else jnp.zeros(
+                    (exists.shape[0], 0, exists.shape[1]), dtype=exists.dtype
                 )
-                slab = _eval_spec(spec, iter(blocks), iter(rows), exist_slab, batched=True)
-                per = jnp.sum(
-                    jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32
-                )  # [S, Q]
-                if per.shape[0] <= MAX_DEVICE_SUM_SHARDS:
-                    return jnp.sum(per, axis=0, dtype=jnp.uint32)  # [Q]
-                return per
+                pos_c = jnp.sum(
+                    jax.lax.population_count(plane_stack & pos[:, None, :]),
+                    axis=(0, 2),
+                    dtype=jnp.uint32,
+                )
+                neg_c = jnp.sum(
+                    jax.lax.population_count(plane_stack & neg[:, None, :]),
+                    axis=(0, 2),
+                    dtype=jnp.uint32,
+                )
+                cnt = jnp.sum(jax.lax.population_count(consider), dtype=jnp.uint32)
+                return self._psum(pos_c), self._psum(neg_c), self._psum(cnt)
 
-        else:  # topn_plain
+            out = (P(), P(), P()) if mesh is not None else None
+            fn = self._wrap(body, True, out)
 
-            @jax.jit
-            def fn(field_block):
-                per = jnp.sum(
-                    jax.lax.population_count(field_block), axis=-1, dtype=jnp.uint32
-                )  # [S, R]
-                if per.shape[0] <= MAX_DEVICE_SUM_SHARDS:
-                    return jnp.sum(per, axis=0, dtype=jnp.uint32)
-                return per
+        elif kind in ("bsi_min", "bsi_max"):
+            depth = extra
+
+            def body(bsi_block, blocks, scalars):
+                exists, sign, planes = _bsi_slabs(bsi_block, depth)
+                consider = exists
+                if spec is not None:
+                    consider = consider & _eval_spec(spec, iter(blocks), iter(scalars))
+
+                def pc(slab):  # [S, W] -> [S]
+                    return jnp.sum(
+                        jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32
+                    )
+
+                branch_mask = (
+                    (sign & consider) if kind == "bsi_min" else (consider & ~sign)
+                )
+                # Branch A: maxUnsigned over branch_mask (fragment.go:1216).
+                filt = branch_mask
+                bits_a = []
+                for i in range(depth - 1, -1, -1):
+                    row = planes[i] & filt
+                    took = pc(row) > 0  # [S]
+                    filt = _where(took[:, None], row, filt)
+                    bits_a.append(took)
+                bits_a = (
+                    jnp.stack(bits_a[::-1], axis=1)
+                    if depth
+                    else jnp.zeros((exists.shape[0], 0), dtype=jnp.bool_)
+                )
+                cnt_a = pc(filt)
+                # Branch B: minUnsigned over consider (fragment.go:1198).
+                filt = consider
+                bits_b = []
+                for i in range(depth - 1, -1, -1):
+                    row = filt & ~planes[i]
+                    empty = pc(row) == 0  # bit set when no zero-plane columns
+                    filt = _where(empty[:, None], filt, row)
+                    bits_b.append(empty)
+                bits_b = (
+                    jnp.stack(bits_b[::-1], axis=1)
+                    if depth
+                    else jnp.zeros((exists.shape[0], 0), dtype=jnp.bool_)
+                )
+                cnt_b = pc(filt)
+                branch_any = pc(branch_mask) > 0
+                consider_any = pc(consider) > 0
+                return bits_a, cnt_a, bits_b, cnt_b, branch_any, consider_any
+
+            out = (ax, ax, ax, ax, ax, ax) if mesh is not None else None
+            fn = self._wrap(body, True, out)
+
+        else:
+            raise ValueError(kind)
 
         self._fns[key] = fn
         return fn
 
     # -- backend interface -------------------------------------------------
 
+    def _resident_shards(self, index: str, shard: int) -> tuple[tuple[int, ...], int]:
+        """Shard tuple to assemble against for a single-shard call: the
+        index's full available set, so shard-by-shard bitmap calls reuse
+        ONE resident stack instead of thrashing the cache with per-shard
+        repacks (each would replace the (index, field, view) entry)."""
+        idx = self.holder.index(index)
+        shards = idx.available_shards().to_array().tolist() if idx else []
+        if shard in shards:
+            return tuple(shards), shards.index(shard)
+        return (shard,), 0
+
     def bitmap_call_shard(self, index: str, c: Call, shard: int) -> Row:
-        if not self._device_supported(c):
+        shards_t, pos = self._resident_shards(index, shard)
+        try:
+            spec, blocks, scalars = self._assemble(index, c, shards_t)
+        except _Unsupported:
             return self.cpu.bitmap_call_shard(index, c, shard)
-        spec = _tree_key(c)
-        blocks, rows, exist = self._assemble(index, c, (shard,), spec)
-        slab = self._program("vec", spec, exist is not None)(blocks, rows, exist)
-        return Row.from_segment(shard, Bitmap(unpack_row(np.asarray(slab[0]))))
+        slab = self._program("vec", spec, False)(blocks, scalars)
+        return Row.from_segment(shard, Bitmap(unpack_row(np.asarray(slab[pos]))))
 
     def count_shard(self, index: str, c: Call, shard: int) -> int:
         return self.count_shards(index, c, [shard])
@@ -322,11 +809,13 @@ class TPUBackend:
         """Whole-query count: ONE jitted dispatch over all shards + one
         scalar readback — the reference's scatter-gather mapReduce
         collapsed into device arithmetic (BASELINE.json north star)."""
-        if not self._device_supported(c):
+        try:
+            spec, blocks, scalars = self._assemble(index, c, tuple(shards))
+        except _Unsupported:
             return sum(self.cpu.count_shard(index, c, s) for s in shards)
-        spec = _tree_key(c)
-        blocks, rows, exist = self._assemble(index, c, tuple(shards), spec)
-        partials = self._program("count", spec, exist is not None)(blocks, rows, exist)
+        s_pad = blocks[0].shape[0]
+        reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
+        partials = self._program("count", spec, reduce_dev)(blocks, scalars)
         # Host sum in Python ints: exact for any shard count.
         return int(np.asarray(partials, dtype=np.uint64).sum())
 
@@ -337,23 +826,25 @@ class TPUBackend:
         past the per-dispatch round-trip floor."""
         if not calls:
             return []
-        spec = _tree_key(calls[0])
-        assert all(_tree_key(c) == spec for c in calls), "count_batch requires same-shape queries"
-        if not self._device_supported(calls[0]):
-            return [self.count_shards(index, c, shards) for c in calls]
         shards_t = tuple(shards)
-        per_call = [self._assemble(index, c, shards_t, spec) for c in calls]
-        blocks = per_call[0][0]
-        n_leaves = len(per_call[0][1]) // 2
-        rows = []
-        for leaf in range(n_leaves):
-            rows.append(np.array([pc[1][2 * leaf] for pc in per_call], dtype=np.uint32))
-            rows.append(np.array([pc[1][2 * leaf + 1] for pc in per_call], dtype=np.uint32))
-        exist = per_call[0][2]
+        try:
+            per_call = [self._assemble(index, c, shards_t) for c in calls]
+        except _Unsupported:
+            return [self.count_shards(index, c, shards) for c in calls]
+        spec = per_call[0][0]
+        assert all(pc[0] == spec for pc in per_call), "count_batch requires same-shape queries"
+        if not _spec_batchable(spec):
+            return [self.count_shards(index, c, shards) for c in calls]
+        blocks = per_call[0][1]
+        n_scalars = len(per_call[0][2])
+        scalars = tuple(
+            np.array([pc[2][j] for pc in per_call], dtype=np.uint32)
+            for j in range(n_scalars)
+        )
+        s_pad = blocks[0].shape[0]
+        reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
         out = np.asarray(
-            self._program("count_batch", spec, exist is not None)(
-                blocks, tuple(rows), exist
-            ),
+            self._program("count_batch", spec, reduce_dev)(blocks, scalars),
             dtype=np.uint64,
         )
         if out.ndim == 2:  # [S, Q] partials past the device-sum bound
@@ -373,8 +864,6 @@ class TPUBackend:
         """Exact TopN in one dispatch: per-row popcounts of the stacked
         field block (optionally masked by a src tree), reduced over the
         shard axis on device; the counts vector reads back once."""
-        if src_call is not None and not self._device_supported(src_call):
-            return None
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx else None
         if f is None:
@@ -382,15 +871,22 @@ class TPUBackend:
         if f.view(VIEW_STANDARD) is None:
             return []
         shards_t = tuple(shards)
-        block, _ = self.blocks.get(index, f, shards_t)
+        if src_call is not None:
+            try:
+                spec, blocks, scalars = self._assemble(index, src_call, shards_t)
+            except _Unsupported:
+                return None
+        block, rp = self.blocks.get(index, f, shards_t)
+        if block is None:
+            return None  # over HBM budget: executor uses the 2-pass CPU path
+        s_pad = block.shape[0]
+        reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
 
         if src_call is None:
-            counts = self._program("topn_plain", ("plain",), False)(block)
+            counts = self._program("topn_plain", None, reduce_dev)(block)
         else:
-            spec = _tree_key(src_call)
-            blocks, rows, exist = self._assemble(index, src_call, shards_t, spec)
-            counts = self._program("topn_src", spec, exist is not None)(
-                block, blocks, rows, exist
+            counts = self._program("topn_src", spec, reduce_dev)(
+                block, blocks, scalars
             )
         counts = np.asarray(counts, dtype=np.uint64)
         if counts.ndim == 2:  # [S, R] partials past the device-sum bound
@@ -398,3 +894,107 @@ class TPUBackend:
         order = np.lexsort((np.arange(counts.size), -counts.astype(np.int64)))
         pairs = [Pair(id=int(r), count=int(counts[r])) for r in order if counts[r] > 0]
         return pairs[:n] if n else pairs
+
+    # -- BSI aggregates (device fast path; fragment.go:1111-1268) ----------
+
+    def _bsi_setup(self, index, field_name, shards, filter_call):
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx else None
+        if f is None:
+            raise QueryError(f"field not found: {field_name}")
+        if f.options.type != FIELD_TYPE_INT:
+            raise _Unsupported("not an int field")
+        opts = f.bsi_group()
+        if opts.bit_depth > MAX_BSI_DEPTH:
+            raise _Unsupported("bit depth")
+        shards_t = tuple(shards)
+        if filter_call is not None:
+            spec, blocks, scalars = self._assemble(index, filter_call, shards_t)
+        else:
+            spec, blocks, scalars = None, (), ()
+        bsi_block, _ = self._get_block(
+            index, f, shards_t, view_name=bsi_view_name(field_name),
+            min_rows=BSI_OFFSET_BIT + opts.bit_depth,
+        )
+        return f, opts, spec, blocks, scalars, bsi_block
+
+    def bsi_sum(self, index, field_name, shards, filter_call=None):
+        """Distributed Sum(field): per-plane popcounts fused on device
+        (+psum over ICI with a mesh), exact host weighting. Returns
+        (sum, count) or None when not lowerable."""
+        try:
+            f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
+                index, field_name, shards, filter_call
+            )
+        except _Unsupported:
+            return None
+        if bsi_block.shape[0] > MAX_DEVICE_SUM_SHARDS:
+            return None
+        depth = opts.bit_depth
+        pos_c, neg_c, cnt = self._program(
+            "bsi_sum", spec, True, extra=depth
+        )(bsi_block, blocks, scalars)
+        pos_c = np.asarray(pos_c, dtype=np.uint64)
+        neg_c = np.asarray(neg_c, dtype=np.uint64)
+        total = sum((int(pos_c[i]) - int(neg_c[i])) << i for i in range(depth))
+        count = int(cnt)
+        return total + opts.base * count, count
+
+    def bsi_min(self, index, field_name, shards, filter_call=None):
+        return self._bsi_minmax("bsi_min", index, field_name, shards, filter_call)
+
+    def bsi_max(self, index, field_name, shards, filter_call=None):
+        return self._bsi_minmax("bsi_max", index, field_name, shards, filter_call)
+
+    def _bsi_minmax(self, kind, index, field_name, shards, filter_call):
+        """Per-shard Min/Max via plane narrowing with on-device selects (no
+        host sync inside the scan), host reduce across shards with the
+        executor's tie semantics. Returns (val, count) or None."""
+        try:
+            f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
+                index, field_name, shards, filter_call
+            )
+        except _Unsupported:
+            return None
+        if bsi_block.shape[0] > MAX_DEVICE_SUM_SHARDS:
+            return None
+        depth = opts.bit_depth
+        bits_a, cnt_a, bits_b, cnt_b, branch_any, consider_any = (
+            np.asarray(x)
+            for x in self._program(kind, spec, True, extra=depth)(
+                bsi_block, blocks, scalars
+            )
+        )
+
+        def assemble_max(bits) -> int:  # maxUnsigned decision bits
+            return sum(1 << i for i in range(depth) if bits[i])
+
+        def assemble_min(bits) -> int:  # minUnsigned: bit set when plane forced 1
+            return sum(1 << i for i in range(depth) if bits[i])
+
+        best_val, best_cnt = 0, 0
+        for s in range(len(shards)):
+            if not consider_any[s]:
+                continue
+            if kind == "bsi_min":
+                if branch_any[s]:  # negatives exist: min = -maxUnsigned(neg)
+                    val, cnt = -assemble_max(bits_a[s]), int(cnt_a[s])
+                else:
+                    val, cnt = assemble_min(bits_b[s]), int(cnt_b[s])
+            else:
+                if branch_any[s]:  # positives exist: max = maxUnsigned(pos)
+                    val, cnt = assemble_max(bits_a[s]), int(cnt_a[s])
+                else:  # all negative: max = -minUnsigned(consider)
+                    val, cnt = -assemble_min(bits_b[s]), int(cnt_b[s])
+            val += opts.base
+            if cnt == 0:
+                continue
+            if best_cnt == 0:
+                best_val, best_cnt = val, cnt
+            elif (kind == "bsi_min" and val < best_val) or (
+                kind == "bsi_max" and val > best_val
+            ):
+                best_val, best_cnt = val, cnt
+            elif val == best_val:
+                best_cnt += cnt
+        return best_val, best_cnt

@@ -123,13 +123,139 @@ class TestTPUBackendDifferential:
         # still agrees with oracle
         assert ex_cpu.execute("i", "Count(Row(f=1))")[0] == after
 
-    def test_bsi_falls_back_to_cpu(self, holder, rng):
+    BSI_QUERIES = [
+        "Sum(field=v)",
+        "Sum(Row(f=1), field=v)",
+        "Min(field=v)",
+        "Max(field=v)",
+        "Min(Row(f=1), field=v)",
+        "Max(Row(f=1), field=v)",
+        "Row(v > 0)",
+        "Row(v >= 0)",
+        "Row(v < 0)",
+        "Row(v <= 0)",
+        "Row(v == 42)",
+        "Row(v != 42)",
+        "Row(v != null)",
+        "Row(v > -50)",
+        "Row(v < -50)",
+        "Row(v >= -10)",
+        "Row(v <= -10)",
+        "Row(v > 1000)",  # out of range
+        "Row(v < 1000)",  # encompassing -> notNull
+        "Row(v >< [-20, 30])",  # mixed between
+        "Row(v >< [5, 60])",  # positive between
+        "Row(v >< [-60, -5])",  # negative between
+        "Row(v >< [-500, 500])",  # full range -> notNull
+        "Count(Intersect(Row(f=1), Row(v > 0)))",
+    ]
+
+    def _setup_bsi(self, holder, rng):
         ex_cpu, ex_tpu = self._setup(holder, rng)
-        ex_tpu.execute("i", "Set(5, v=42) Set(6, v=-10)")
-        for q in ["Sum(field=v)", "Row(v > 0)", "Min(field=v)"]:
+        cols = np.unique(rng.integers(0, 3 * SHARD_WIDTH, 800, dtype=np.uint64))
+        vals = rng.integers(-500, 501, cols.size)
+        holder.index("i").field("v").import_value(cols, vals)
+        ex_cpu.execute("i", "Set(5, v=42) Set(6, v=-10)")
+        return ex_cpu, ex_tpu
+
+    @pytest.mark.parametrize("q", BSI_QUERIES)
+    def test_bsi_runs_on_device(self, holder, rng, q):
+        ex_cpu, ex_tpu = self._setup_bsi(holder, rng)
+        want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+        got = [result_to_json(r) for r in ex_tpu.execute("i", q)]
+        assert got == want, q
+
+    def test_shift_on_device(self, holder, rng):
+        ex_cpu, ex_tpu = self._setup(holder, rng)
+        for q in ["Shift(Row(f=1), n=1)", "Shift(Row(f=2), n=40)", "Count(Shift(Row(f=1), n=3))"]:
             want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
             got = [result_to_json(r) for r in ex_tpu.execute("i", q)]
             assert got == want, q
+
+    def test_time_range_on_device(self, holder, rng):
+        from pilosa_tpu.core.field import options_for_time
+
+        ex_cpu, ex_tpu = self._setup(holder, rng)
+        idx = holder.index("i")
+        idx.create_field("t", options_for_time("YMDH"))
+        ex_cpu.execute("i", 'Set(3, t=9, 2019-08-03T10:00)')
+        ex_cpu.execute("i", 'Set(1048579, t=9, 2019-08-05T12:00)')
+        q = "Row(t=9, from='2019-08-01T00:00', to='2019-08-31T00:00')"
+        want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+        got = [result_to_json(r) for r in ex_tpu.execute("i", q)]
+        assert got == want
+
+    def test_hbm_budget_evicts(self, holder, rng):
+        ex_cpu, _ = self._setup(holder, rng)
+        # Budget fits roughly one stack: queries still correct, stacks evict.
+        be = TPUBackend(holder, max_bytes=3 * 8 * WORDS_PER_SHARD * 4)
+        ex_tpu = Executor(holder, backend=be)
+        for q in ["Count(Row(f=1))", "Count(Row(g=7))", "Count(Row(f=2))"]:
+            want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+            got = [result_to_json(r) for r in ex_tpu.execute("i", q)]
+            assert got == want, q
+        assert be.blocks.evictions > 0
+        assert be.blocks.resident_bytes() <= 3 * 8 * WORDS_PER_SHARD * 4
+
+
+class TestMeshExecutor:
+    """Real PQL through the 8-device mesh: holder-resident fragments are
+    stacked, sharded over the mesh with NamedSharding(P('shards')), and
+    queried through shard_map+psum — differentially checked vs the CPU
+    oracle (the VERDICT r1 top-next item)."""
+
+    def _setup(self, holder, rng):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        idx.create_field("v", options_for_int(-500, 500))
+        n_shards = 11  # not a multiple of 8: exercises shard padding
+        for row in [1, 2, 3]:
+            cols = np.unique(rng.integers(0, n_shards * SHARD_WIDTH, 6000, dtype=np.uint64))
+            idx.field("f").import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+            idx.existence_field().import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
+        cols = np.unique(rng.integers(0, n_shards * SHARD_WIDTH, 4000, dtype=np.uint64))
+        idx.field("g").import_bits(np.full(cols.size, 7, dtype=np.uint64), cols)
+        cols = np.unique(rng.integers(0, n_shards * SHARD_WIDTH, 900, dtype=np.uint64))
+        vals = rng.integers(-500, 501, cols.size)
+        idx.field("v").import_value(cols, vals)
+        ex_cpu = Executor(holder)
+        ex_mesh = Executor(holder, backend=TPUBackend(holder, mesh=ShardMesh()))
+        return ex_cpu, ex_mesh
+
+    QUERIES = [
+        "Count(Intersect(Row(f=1), Row(g=7)))",
+        "Count(Union(Row(f=1), Row(f=2), Row(f=3)))",
+        "Count(Not(Row(f=1)))",
+        "Row(f=2)",
+        "TopN(f, n=2)",
+        "TopN(f, Row(g=7), n=3)",
+        "Sum(field=v)",
+        "Min(field=v)",
+        "Max(field=v)",
+        "Count(Row(v > 100))",
+        "Count(Row(v >< [-100, 100]))",
+    ]
+
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_mesh_differential(self, holder, rng, q):
+        ex_cpu, ex_mesh = self._setup(holder, rng)
+        want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+        got = [result_to_json(r) for r in ex_mesh.execute("i", q)]
+        assert got == want, q
+
+    def test_mesh_count_batch(self, holder, rng):
+        _, ex_mesh = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = ex_mesh.backend
+        calls = [
+            parse_string(f"Intersect(Row(f={r}), Row(g=7))").calls[0] for r in [1, 2, 3]
+        ]
+        shards = list(range(11))
+        batch = be.count_batch("i", calls, shards)
+        singles = [be.count_shards("i", c, shards) for c in calls]
+        assert batch == singles
 
 
 class TestShardMesh:
