@@ -45,8 +45,10 @@ def cmd_server(args) -> int:
     executor = Executor(holder, backend=backend)
     api = API(holder, executor)
 
+    daemons = []
     if cfg.cluster.hosts:
         from pilosa_tpu.cluster import Cluster, Node, Topology, URI
+        from pilosa_tpu.cluster.sync import FailureDetector, SyncDaemon
 
         # Node IDs derive from the URI so every host computes the same
         # ID-sorted ring without an out-of-band registry (the reference
@@ -55,9 +57,15 @@ def cmd_server(args) -> int:
         for h in cfg.cluster.hosts:
             u = URI.parse(h)
             nodes.append(Node(id=f"node-{u.host}-{u.port}", uri=u))
-        if nodes:
-            min(nodes, key=lambda n: n.id).is_coordinator = True
         local_id = f"node-{cfg.host}-{cfg.port}"
+        if cfg.cluster.coordinator:
+            # cluster.coordinator = true marks THIS node the coordinator
+            # (reference server/config.go Cluster.Coordinator); set it in
+            # every node's config consistently.
+            for n in nodes:
+                n.is_coordinator = n.id == local_id
+        elif nodes:
+            min(nodes, key=lambda n: n.id).is_coordinator = True
         topo = Topology(nodes, replica_n=cfg.cluster.replicas)
         local = topo.node_by_id(local_id)
         if local is None:
@@ -66,8 +74,12 @@ def cmd_server(args) -> int:
             )
             return 1
         cluster = Cluster(local, topo, holder)
+        cluster.logger = log
         cluster.attach(executor, api)
         api.cluster = cluster
+        cluster.attach_resizer(log)
+        daemons.append(SyncDaemon(cluster, interval=cfg.anti_entropy_interval, logger=log).start())
+        daemons.append(FailureDetector(cluster, logger=log).start())
         log.printf(
             "clustered: %d nodes, replicas=%d, coordinator=%s",
             len(nodes), cfg.cluster.replicas, cluster.coordinator().id,
@@ -79,6 +91,8 @@ def cmd_server(args) -> int:
         server.serve_forever()
     except KeyboardInterrupt:
         log.printf("shutting down")
+        for d in daemons:
+            d.stop()
         holder.close()
     return 0
 

@@ -207,6 +207,29 @@ class InternalClient:
             raw=True,
         )
 
+    def field_state(self, uri, index: str, field: str) -> dict:
+        """Peer field state: view names + available shards (anti-entropy
+        discovery; the reference ships this in NodeStatus gossip)."""
+        return self._do(
+            "GET", uri, f"/internal/field/state?index={index}&field={field}"
+        )
+
+    # -- attr sync (reference attr.go Blocks/BlockData) --------------------
+
+    def attr_blocks(self, uri, index: str, field: Optional[str] = None) -> list[tuple[int, int]]:
+        path = f"/internal/attr/blocks?index={index}"
+        if field:
+            path += f"&field={field}"
+        out = self._do("GET", uri, path)
+        return [(int(b["id"]), int(b["checksum"])) for b in out.get("blocks", [])]
+
+    def attr_block_data(self, uri, index: str, field: Optional[str], block: int) -> dict:
+        path = f"/internal/attr/block/data?index={index}&block={block}"
+        if field:
+            path += f"&field={field}"
+        out = self._do("GET", uri, path)
+        return {int(k): v for k, v in out.get("attrs", {}).items()}
+
     # -- control plane -----------------------------------------------------
 
     def send_message(self, uri, payload: bytes) -> None:

@@ -81,6 +81,12 @@ class Cluster:
         # Seams the resize/anti-entropy layers hook (set by attach_* below).
         self.resizer = None
         self.api = None
+        self.logger = None
+        # Control messages that failed to broadcast; retried by the sync
+        # daemon (ADVICE r1: a dropped DDL/shard broadcast must not be
+        # silently lost).
+        self._pending_msgs: list[Message] = []
+        self._pending_lock = threading.Lock()
 
     # -- wiring ------------------------------------------------------------
 
@@ -92,6 +98,20 @@ class Cluster:
         self.api = api
         if self.holder is not None:
             self.holder.broadcast_shard = self._on_local_new_shard
+        # Keyed translation routes through the coordinator primary.
+        from pilosa_tpu.cluster.sync import wrap_translate_stores
+
+        wrap_translate_stores(self)
+
+    def attach_resizer(self, logger=None):
+        """Install the resize state machine (cluster/resize.py)."""
+        from pilosa_tpu.cluster.resize import Resizer
+
+        return Resizer(self, logger or self.logger)
+
+    def _log(self, fmt: str, *args) -> None:
+        if self.logger is not None:
+            self.logger.printf(fmt, *args)
 
     # -- identity / state --------------------------------------------------
 
@@ -125,7 +145,13 @@ class Cluster:
     # -- mapReduce (reference executor.go:2460-2613) -----------------------
 
     def map_shards(self, index, shards, c, map_fn, reduce_fn, opt):
-        nodes = list(self.topology.nodes)
+        # Nodes the failure detector marked DOWN are skipped up front so
+        # queries route straight to replicas instead of eating a timeout.
+        from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
+
+        nodes = [n for n in self.topology.nodes if n.state != NODE_STATE_DOWN]
+        if not nodes:
+            nodes = list(self.topology.nodes)
         ch: "queue.Queue[_MapResponse]" = queue.Queue()
         self._launch(ch, nodes, index, shards, c, map_fn, reduce_fn, opt)
 
@@ -133,7 +159,14 @@ class Cluster:
         got_any = False
         done = 0
         while done < len(shards):
-            resp = ch.get(timeout=self.client.timeout + 30)
+            try:
+                resp = ch.get(timeout=self.client.timeout + 30)
+            except queue.Empty:
+                # A worker hung past the client timeout; surface as a
+                # routable 5xx instead of an unhandled traceback (ADVICE r1).
+                raise ShardUnavailableError(
+                    f"query timed out waiting for shard results ({index})"
+                ) from None
             if resp.err is not None:
                 # Filter the failed node, re-split its shards across the
                 # remaining replicas (reference :2497-2507).
@@ -193,12 +226,45 @@ class Cluster:
         ch.put(resp)
 
     def _remote_exec(self, node, index, c, shards):
-        out = self.client.query_node(
-            node, index, c.to_string(), shards=shards, remote=True
-        )
+        try:
+            out = self.client.query_node(
+                node, index, c.to_string(), shards=shards, remote=True
+            )
+        except ClientError as e:
+            # A peer that missed a DDL broadcast answers "not found": push
+            # it the schema and retry once (ADVICE r1: pull schema on
+            # NotFound instead of failing until anti-entropy).
+            if "not found" not in str(e):
+                raise
+            self._push_state_to(node, index)
+            out = self.client.query_node(
+                node, index, c.to_string(), shards=shards, remote=True
+            )
         results = out.get("results", [])
         raw = results[0] if results else None
         return decode_result(c, raw)
+
+    def _push_state_to(self, node, index: str) -> None:
+        """Repair one peer's schema + available shards inline."""
+        if self.holder is None:
+            return
+        self.broadcaster.send_to(
+            node, Message.make(bc.MSG_NODE_STATUS, schema={"indexes": self.holder.schema()})
+        )
+        idx = self.holder.index(index)
+        if idx is None:
+            return
+        for fname in list(idx.fields):
+            f = idx.field(fname)
+            if f is None:
+                continue
+            for shard in f.available_shards().to_array().tolist():
+                self.broadcaster.send_to(
+                    node,
+                    Message.make(
+                        bc.MSG_CREATE_SHARD, index=index, field=fname, shard=int(shard)
+                    ),
+                )
 
     # -- write replication (reference executor.go:2072-2141) ---------------
 
@@ -293,22 +359,36 @@ class Cluster:
         is simpler and idempotent — receivers apply_schema)."""
         if self.holder is None:
             return
+        # Local DDL may have created keyed stores: route them first.
+        from pilosa_tpu.cluster.sync import wrap_translate_stores
+
+        wrap_translate_stores(self)
         msg = Message.make(bc.MSG_NODE_STATUS, schema={"indexes": self.holder.schema()})
-        try:
-            self.broadcaster.send_sync(msg)
-        except RuntimeError:
-            pass  # peers down; anti-entropy re-syncs schema later
+        self._send_or_queue(msg)
 
     def _on_local_new_shard(self, index: str, field: str, shard: int) -> None:
         # Sync so a query routed through any node right after a write sees
         # the new shard in its fan-out set; down peers are repaired by
         # anti-entropy later.
+        self._send_or_queue(
+            Message.make(bc.MSG_CREATE_SHARD, index=index, field=field, shard=shard)
+        )
+
+    def _send_or_queue(self, msg: Message) -> None:
+        """Sync broadcast; failures are logged and queued for retry by the
+        sync daemon instead of dropped (ADVICE r1 medium)."""
         try:
-            self.broadcaster.send_sync(
-                Message.make(bc.MSG_CREATE_SHARD, index=index, field=field, shard=shard)
-            )
-        except RuntimeError:
-            pass
+            self.broadcaster.send_sync(msg)
+        except RuntimeError as e:
+            self._log("broadcast failed (queued for retry): %s", e)
+            with self._pending_lock:
+                self._pending_msgs.append(msg)
+
+    def flush_pending_broadcasts(self) -> None:
+        with self._pending_lock:
+            pending, self._pending_msgs = self._pending_msgs, []
+        for msg in pending:
+            self._send_or_queue(msg)
 
     # -- message receive (reference server.go receiveMessage :569) ---------
 
@@ -328,18 +408,34 @@ class Cluster:
         elif typ == bc.MSG_NODE_STATUS:
             if self.api is not None and "schema" in msg:
                 self.api.apply_schema(msg["schema"])
+                from pilosa_tpu.cluster.sync import wrap_translate_stores
+
+                wrap_translate_stores(self)
         elif typ == bc.MSG_CLUSTER_STATUS:
             self.set_state(msg.get("state", self.state()))
             if "nodes" in msg:
-                self.topology.nodes = sorted(
+                new_nodes = sorted(
                     (Node.from_json(d) for d in msg["nodes"]), key=lambda n: n.id
                 )
+                self.topology.nodes = new_nodes
+                # Keep the local node's identity object in sync (it may
+                # have just become or stopped being a member/coordinator).
+                mine = next((n for n in new_nodes if n.id == self.local_node.id), None)
+                if mine is not None:
+                    self.local_node = mine
+            if msg.get("state") == STATE_NORMAL and self.resizer is not None:
+                self.resizer.clean_holder()
         elif typ == bc.MSG_RECALCULATE_CACHES:
             if self.api is not None:
                 self.api.recalculate_caches()
         elif typ == bc.MSG_RESIZE_INSTRUCTION:
             if self.resizer is not None:
-                self.resizer.follow_instruction(msg)
+                # Follow asynchronously: the instruction fetches fragments
+                # from peers, which must not block the coordinator's
+                # broadcast round-trip.
+                threading.Thread(
+                    target=self.resizer.follow_instruction, args=(msg,), daemon=True
+                ).start()
         elif typ == bc.MSG_RESIZE_COMPLETE:
             if self.resizer is not None:
                 self.resizer.mark_complete(msg)

@@ -1,0 +1,337 @@
+"""Cluster resize: elastic add/remove of nodes with fragment re-placement
+(reference cluster.go:784-868 fragSources, :1196-1441 resizeJob /
+followResizeInstruction, holder.go:1104 holderCleaner).
+
+Flow (coordinator-driven state machine, reference cluster.go:47-50):
+
+1. Coordinator receives add/remove (HTTP endpoint or a JOIN node event),
+   snapshots the old topology, builds the new one, and diffs placement:
+   for every (index, shard) a node owns in the NEW topology but not the
+   OLD, an instruction entry points it at a surviving old owner.
+2. State broadcasts to RESIZING (API writes 503 during the move), then
+   each node gets a MSG_RESIZE_INSTRUCTION and fetches whole fragments
+   over /internal/fragment/data (reference RetrieveShardFromURI
+   http/client.go:742), unioning them into local storage.
+3. Nodes report MSG_RESIZE_COMPLETE; when all have, the coordinator
+   broadcasts the new node list with state NORMAL; every node then drops
+   fragments it no longer owns (holderCleaner).
+4. Abort (POST /cluster/resize/abort, reference api.go:1250) rolls state
+   back to NORMAL on the old topology.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.broadcast import Message
+from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.cluster.topology import (
+    Node,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    Topology,
+)
+from pilosa_tpu.utils.logger import NopLogger
+
+
+class ResizeError(Exception):
+    pass
+
+
+class Resizer:
+    """Owns resize jobs on the coordinator and instruction-following on
+    every node. Installed via cluster.attach_resizer()."""
+
+    def __init__(self, cluster, logger=None):
+        self.cluster = cluster
+        self.log = logger or NopLogger()
+        self._lock = threading.RLock()
+        self._job_id = 0
+        # Coordinator-side live job state.
+        self._pending_nodes: set[str] = set()
+        self._new_nodes: Optional[list[Node]] = None
+        self._notify_nodes: list[Node] = []
+        # Set on every node while it should clean after the topology flips.
+        self._needs_clean = False
+        cluster.resizer = self
+
+    # -- coordinator: job control (reference cluster.go:1196) --------------
+
+    def add_node(self, node: Node) -> int:
+        """Grow the cluster by one node; returns the job id."""
+        with self._lock:
+            if self.cluster.topology.node_by_id(node.id) is not None:
+                raise ResizeError(f"node already in cluster: {node.id}")
+            new_nodes = [
+                Node(n.id, n.uri, n.is_coordinator, n.state)
+                for n in self.cluster.topology.nodes
+            ] + [Node(node.id, node.uri, False)]
+            return self._start_job(new_nodes)
+
+    def remove_node(self, node_id: str) -> int:
+        with self._lock:
+            gone = self.cluster.topology.node_by_id(node_id)
+            if gone is None:
+                raise ResizeError(f"node not in cluster: {node_id}")
+            if gone.is_coordinator:
+                raise ResizeError("cannot remove the coordinator")
+            new_nodes = [
+                Node(n.id, n.uri, n.is_coordinator, n.state)
+                for n in self.cluster.topology.nodes
+                if n.id != node_id
+            ]
+            return self._start_job(new_nodes, removed=gone)
+
+    def handle_join(self, node: Node) -> None:
+        """A JOIN node event on the coordinator triggers a grow job
+        (reference listenForJoins cluster.go:1141)."""
+        try:
+            self.add_node(node)
+        except ResizeError:
+            pass  # already a member: nothing to do
+
+    def _start_job(self, new_nodes: list[Node], removed: Optional[Node] = None) -> int:
+        if not self.cluster.is_coordinator():
+            raise ResizeError("resize must run on the coordinator")
+        if self._new_nodes is not None:
+            raise ResizeError("a resize job is already running")
+        old_topo = self.cluster.topology
+        new_topo = Topology(
+            nodes=new_nodes,
+            replica_n=old_topo.replica_n,
+            partition_n=old_topo.partition_n,
+            hasher=old_topo.hasher,
+        )
+        self._job_id += 1
+        job = self._job_id
+        self._new_nodes = new_topo.nodes
+        instructions = self._build_instructions(old_topo, new_topo, removed)
+        self._pending_nodes = {n.id for n in new_topo.nodes}
+        # Final-status recipients: the union of old and new membership — a
+        # removed node must still see the flip back to NORMAL.
+        notify = {n.id: n for n in old_topo.nodes}
+        notify.update({n.id: n for n in new_topo.nodes})
+        self._notify_nodes = list(notify.values())
+
+        # Freeze writes cluster-wide while fragments move.
+        self.cluster.set_state(STATE_RESIZING)
+        self.cluster.broadcaster.send_sync(
+            Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
+        )
+        schema = {"indexes": self.cluster.holder.schema()} if self.cluster.holder else {}
+        available = self._available_map()
+        for node in new_topo.nodes:
+            msg = Message.make(
+                bc.MSG_RESIZE_INSTRUCTION,
+                job=job,
+                node=node.id,
+                coordinator=self.cluster.local_node.to_json(),
+                sources=instructions.get(node.id, []),
+                schema=schema,
+                available=available,
+            )
+            if node.id == self.cluster.local_node.id:
+                self.follow_instruction(msg)
+            else:
+                self.cluster.broadcaster.send_to(node, msg)
+        return job
+
+    def _available_map(self) -> dict:
+        """index -> field -> cluster-wide available shards (the joiner must
+        fan queries out to every shard, not just the ones it fetched)."""
+        holder = self.cluster.holder
+        out: dict[str, dict[str, list[int]]] = {}
+        if holder is None:
+            return out
+        for index_name in list(holder.indexes):
+            idx = holder.index(index_name)
+            if idx is None:
+                continue
+            for field_name in list(idx.fields):
+                f = idx.field(field_name)
+                if f is not None:
+                    out.setdefault(index_name, {})[field_name] = [
+                        int(s) for s in f.available_shards().to_array().tolist()
+                    ]
+        return out
+
+    def _build_instructions(self, old_topo: Topology, new_topo: Topology,
+                            removed: Optional[Node]) -> dict[str, list[dict]]:
+        """node id -> fragment sources (reference fragSources cluster.go:784).
+        A node fetches every (index, field, shard) it owns in the new
+        topology but not the old, from any surviving old owner."""
+        holder = self.cluster.holder
+        out: dict[str, list[dict]] = {}
+        if holder is None:
+            return out
+        gone_id = removed.id if removed is not None else None
+        for index_name in list(holder.indexes):
+            idx = holder.index(index_name)
+            if idx is None:
+                continue
+            for field_name in list(idx.fields):
+                f = idx.field(field_name)
+                if f is None:
+                    continue
+                for shard in f.available_shards().to_array().tolist():
+                    old_owners = [
+                        n for n in old_topo.shard_nodes(index_name, shard)
+                        if n.id != gone_id
+                    ]
+                    if removed is not None:
+                        # The leaving node's data must survive: it stays a
+                        # valid source for fragments only it holds.
+                        old_owners = old_owners + [removed]
+                    old_ids = {n.id for n in old_topo.shard_nodes(index_name, shard)}
+                    if not old_owners:
+                        continue
+                    for node in new_topo.shard_nodes(index_name, shard):
+                        if node.id in old_ids:
+                            continue  # already holds it
+                        src = next(
+                            (o for o in old_owners if o.id != node.id), old_owners[0]
+                        )
+                        out.setdefault(node.id, []).append(
+                            {
+                                "index": index_name,
+                                "field": field_name,
+                                "shard": int(shard),
+                                "from": str(src.uri),
+                            }
+                        )
+        return out
+
+    # -- every node: instruction following (reference cluster.go:1297) -----
+
+    def follow_instruction(self, msg: Message) -> None:
+        """Fetch assigned fragments, then report completion. Runs inline —
+        callers that need async wrap it in a thread (the HTTP receive path
+        does, so the coordinator isn't blocked on its own broadcast)."""
+        # A joining node first needs the schema the cluster already has.
+        if self.cluster.api is not None and msg.get("schema"):
+            self.cluster.api.apply_schema(msg["schema"])
+        from pilosa_tpu.cluster.sync import wrap_translate_stores
+
+        wrap_translate_stores(self.cluster)
+        holder = self.cluster.holder
+        for index_name, fields in msg.get("available", {}).items():
+            idx = holder.index(index_name) if holder else None
+            if idx is None:
+                continue
+            for field_name, shards in fields.items():
+                f = idx.field(field_name)
+                if f is not None:
+                    for s in shards:
+                        f.add_available_shard(int(s))
+        for src in msg.get("sources", []):
+            index, field_name = src["index"], src["field"]
+            shard, from_uri = int(src["shard"]), src["from"]
+            idx = holder.index(index) if holder else None
+            f = idx.field(field_name) if idx else None
+            if f is None:
+                continue
+            try:
+                view_names = self.cluster.client.field_state(
+                    from_uri, index, field_name
+                ).get("views", [])
+            except ClientError as e:
+                self.log.printf("resize: view list from %s: %s", from_uri, e)
+                view_names = []
+            for view_name in view_names:
+                try:
+                    data = self.cluster.client.retrieve_shard(
+                        from_uri, index, field_name, view_name, shard
+                    )
+                except ClientError:
+                    continue  # fragment absent in this view
+                f.import_roaring(shard, data, view_name=view_name)
+            f.add_available_shard(shard)
+        self._needs_clean = True
+        coord = Node.from_json(msg["coordinator"])
+        done = Message.make(
+            bc.MSG_RESIZE_COMPLETE, job=msg.get("job"), node=self.cluster.local_node.id
+        )
+        if coord.id == self.cluster.local_node.id:
+            self.mark_complete(done)
+        else:
+            self.cluster.broadcaster.send_to(coord, done)
+
+    # -- coordinator: completion tracking (reference cluster.go:1413) ------
+
+    def mark_complete(self, msg: Message) -> None:
+        with self._lock:
+            self._pending_nodes.discard(msg.get("node"))
+            if self._pending_nodes or self._new_nodes is None:
+                return
+            new_nodes = self._new_nodes
+            notify = self._notify_nodes
+            self._new_nodes = None
+        # Flip the whole cluster to the new topology atomically via one
+        # status broadcast; receivers clean unowned fragments. Recipients
+        # are old∪new members (send_sync would miss the joiner/leaver
+        # because the coordinator's own topology flips only on receive).
+        status = Message.make(
+            bc.MSG_CLUSTER_STATUS,
+            state=STATE_NORMAL,
+            nodes=[n.to_json() for n in new_nodes],
+        )
+        self.cluster.receive_message(status.to_bytes())
+        for node in notify:
+            if node.id != self.cluster.local_node.id:
+                try:
+                    self.cluster.broadcaster.send_to(node, status)
+                except Exception as e:
+                    self.log.printf("resize: status to %s failed: %s", node.id, e)
+        self.log.printf("resize complete: %d nodes", len(new_nodes))
+
+    def abort(self) -> None:
+        """Roll back to NORMAL on the old topology (reference api.go:1250)."""
+        with self._lock:
+            self._pending_nodes = set()
+            self._new_nodes = None
+            self._needs_clean = False
+        self.cluster.set_state(STATE_NORMAL)
+        if self.cluster.is_coordinator():
+            self.cluster.broadcaster.send_sync(Message.make(bc.MSG_RESIZE_ABORT))
+            self.cluster.broadcaster.send_sync(
+                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_NORMAL)
+            )
+
+    # -- every node: post-resize cleanup (reference holder.go:1104) --------
+
+    def clean_holder(self) -> int:
+        """Drop fragments for shards this node no longer owns. Runs after
+        the topology flip to NORMAL; returns fragments removed."""
+        with self._lock:
+            if not self._needs_clean:
+                return 0
+            self._needs_clean = False
+        holder = self.cluster.holder
+        if holder is None:
+            return 0
+        removed = 0
+        local_id = self.cluster.local_node.id
+        # A node that is no longer a member keeps its data (the reference
+        # leaves removed-node data dirs intact too).
+        if self.cluster.topology.node_by_id(local_id) is None:
+            return 0
+        for index_name in list(holder.indexes):
+            idx = holder.index(index_name)
+            if idx is None:
+                continue
+            for field_name in list(idx.fields):
+                f = idx.field(field_name)
+                if f is None:
+                    continue
+                for view in list(f.views.values()):
+                    for shard in list(view.fragments):
+                        if not self.cluster.topology.owns_shard(
+                            local_id, index_name, shard
+                        ):
+                            view.delete_fragment(shard)
+                            removed += 1
+        if removed:
+            self.log.printf("holder cleaner: removed %d fragments", removed)
+        return removed

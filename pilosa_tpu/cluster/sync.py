@@ -1,0 +1,413 @@
+"""Cluster convergence: translate replication, anti-entropy, failure detection.
+
+Three loops the reference runs as background monitors:
+
+- **Key translation** (reference translate.go:35-70, holder.go:785-878):
+  the coordinator is the translation primary. Non-coordinator stores wrap
+  the local sqlite store in a ForwardingTranslateStore: key *writes*
+  forward to the primary over RPC (so the same key gets the same id
+  cluster-wide), and replicas tail the primary's entry log
+  (entries_since) both on-demand (read miss) and from the sync daemon.
+- **Anti-entropy** (reference holder.go:882-1101, server.go:514): the
+  HolderSyncer periodically walks the schema and, for every fragment this
+  node owns, diffs 100-row block checksums against each replica and
+  merges differing blocks (union repair, fragment.go:1875). Attribute
+  stores sync the same way over 100-id blocks. View names and available
+  shards are pulled from peers first so a replica that missed a
+  CREATE_SHARD broadcast converges too.
+- **Failure detection** (reference gossip NotifyLeave + confirm-down
+  retry, cluster.go:65-67): each node probes peers' /status; after
+  CONFIRM_DOWN consecutive failures the peer is marked DOWN in the local
+  topology (queries then skip it proactively instead of timing out per
+  request) and the cluster degrades; a successful probe marks it READY.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.cluster.topology import NODE_STATE_DOWN, NODE_STATE_READY
+from pilosa_tpu.utils.logger import NopLogger
+
+# Consecutive probe failures before a peer is declared down
+# (the reference re-checks a leave event before acting, cluster.go:65).
+CONFIRM_DOWN = 3
+
+
+class ForwardingTranslateStore:
+    """Wraps a node-local TranslateStore; assigns ids only on the primary.
+
+    reference translate.go:35 (primary store) + http/translator.go (replica
+    reader). The local store is a strict replica of the primary's log:
+    entries are applied with their primary-assigned ids, so offsets never
+    diverge.
+    """
+
+    def __init__(self, local, cluster, index: str, field: str = ""):
+        self.local = local
+        self.cluster = cluster
+        self.index = index
+        self.field = field
+
+    # -- write path --------------------------------------------------------
+
+    def translate_key(self, key: str, write: bool = True) -> Optional[int]:
+        id_ = self.local.translate_key(key, write=False)
+        if id_ is not None:
+            return id_
+        if self.cluster.is_coordinator():
+            return self.local.translate_key(key, write=write)
+        if not write:
+            return None
+        coord = self.cluster.coordinator()
+        ids = self.cluster.client.translate_keys(coord, self.index, self.field, [key])
+        # Catch the local replica up so the log has no gaps, then make sure
+        # this entry landed even if the tail raced.
+        self.sync_from_primary()
+        self.local.apply_entries([(ids[0], key)])
+        return ids[0]
+
+    def translate_keys(self, keys: list[str], write: bool = True) -> list[Optional[int]]:
+        return [self.translate_key(k, write=write) for k in keys]
+
+    # -- read path ---------------------------------------------------------
+
+    def translate_id(self, id_: int) -> Optional[str]:
+        k = self.local.translate_id(id_)
+        if k is None and not self.cluster.is_coordinator():
+            try:
+                self.sync_from_primary()
+            except ClientError:
+                return None
+            k = self.local.translate_id(id_)
+        return k
+
+    def translate_ids(self, ids: list[int]) -> list[Optional[str]]:
+        return [self.translate_id(i) for i in ids]
+
+    # -- replication -------------------------------------------------------
+
+    def sync_from_primary(self) -> None:
+        """Tail the primary's entry log (reference EntryReader stream)."""
+        coord = self.cluster.coordinator()
+        if coord is None or coord.id == self.cluster.local_node.id:
+            return
+        entries = self.cluster.client.translate_data(
+            coord, self.index, self.field, self.local.max_id()
+        )
+        if entries:
+            self.local.apply_entries([(int(s), k) for s, k in entries])
+
+    # -- delegation --------------------------------------------------------
+
+    def max_id(self) -> int:
+        return self.local.max_id()
+
+    def entries_since(self, seq: int):
+        return self.local.entries_since(seq)
+
+    def apply_entries(self, entries) -> None:
+        self.local.apply_entries(entries)
+
+    def close(self) -> None:
+        self.local.close()
+
+
+def wrap_translate_stores(cluster) -> None:
+    """Install forwarding wrappers on every keyed store in the holder.
+    Idempotent; called at attach and after any schema change."""
+    holder = cluster.holder
+    if holder is None:
+        return
+    for name in list(holder.indexes):
+        idx = holder.index(name)
+        if idx is None:
+            continue
+        if idx.translate_store is not None and not isinstance(
+            idx.translate_store, ForwardingTranslateStore
+        ):
+            idx.translate_store = ForwardingTranslateStore(
+                idx.translate_store, cluster, name
+            )
+        for fname in list(idx.fields):
+            f = idx.field(fname)
+            if f is not None and f.translate_store is not None and not isinstance(
+                f.translate_store, ForwardingTranslateStore
+            ):
+                f.translate_store = ForwardingTranslateStore(
+                    f.translate_store, cluster, name, fname
+                )
+
+
+class HolderSyncer:
+    """Anti-entropy repair loop (reference holderSyncer holder.go:882)."""
+
+    def __init__(self, cluster, logger=None):
+        self.cluster = cluster
+        self.log = logger or NopLogger()
+
+    # -- one full pass -----------------------------------------------------
+
+    def sync_holder(self) -> int:
+        """Walk schema, diff checksums vs replicas, merge differing blocks.
+        Returns the number of blocks repaired (reference SyncHolder
+        holder.go:911)."""
+        holder = self.cluster.holder
+        if holder is None:
+            return 0
+        repaired = 0
+        self._sync_schema()
+        for index_name in list(holder.indexes):
+            idx = holder.index(index_name)
+            if idx is None:
+                continue
+            repaired += self._sync_attrs(index_name, None, idx.column_attr_store)
+            for field_name in list(idx.fields):
+                f = idx.field(field_name)
+                if f is None:
+                    continue
+                repaired += self._sync_attrs(index_name, field_name, f.row_attr_store)
+                self._pull_field_state(index_name, field_name, f)
+                shards = f.available_shards().to_array().tolist()
+                for view_name in list(f.views):
+                    for shard in shards:
+                        if not self.cluster.topology.owns_shard(
+                            self.cluster.local_node.id, index_name, shard
+                        ):
+                            continue
+                        repaired += self._sync_fragment(
+                            index_name, f, view_name, shard
+                        )
+        # Drain any control messages that failed to broadcast earlier.
+        self.cluster.flush_pending_broadcasts()
+        return repaired
+
+    def _live_replicas(self, index: str, shard: int):
+        local_id = self.cluster.local_node.id
+        return [
+            n
+            for n in self.cluster.topology.shard_nodes(index, shard)
+            if n.id != local_id and n.state != NODE_STATE_DOWN
+        ]
+
+    def _peers(self):
+        local_id = self.cluster.local_node.id
+        return [
+            n
+            for n in self.cluster.topology.nodes
+            if n.id != local_id and n.state != NODE_STATE_DOWN
+        ]
+
+    def _sync_schema(self) -> None:
+        """Pull peer schemas (repairs a missed DDL broadcast; reference
+        syncs schema via NodeStatus gossip, holder.go:924)."""
+        api = self.cluster.api
+        if api is None:
+            return
+        for peer in self._peers():
+            try:
+                schema = self.cluster.client.schema(peer)
+            except ClientError:
+                continue
+            try:
+                api.apply_schema(schema)
+            except Exception as e:
+                self.log.printf("anti-entropy: apply schema from %s: %s", peer.id, e)
+        wrap_translate_stores(self.cluster)
+
+    def _pull_field_state(self, index: str, field_name: str, f) -> None:
+        """Union peer view lists + available shards (repairs a missed
+        CREATE_SHARD broadcast)."""
+        for peer in self._peers():
+            try:
+                state = self.cluster.client.field_state(peer, index, field_name)
+            except ClientError:
+                continue
+            for shard in state.get("availableShards", []):
+                f.add_available_shard(int(shard))
+            for view_name in state.get("views", []):
+                f.create_view_if_not_exists(view_name)
+
+    def _sync_fragment(self, index: str, f, view_name: str, shard: int) -> int:
+        v = f.view(view_name)
+        frag = v.fragment(shard) if v is not None else None
+        repaired = 0
+        for peer in self._live_replicas(index, shard):
+            try:
+                peer_blocks = self.cluster.client.fragment_blocks(
+                    peer, index, f.name, view_name, shard
+                )
+            except ClientError:
+                continue  # peer has no fragment (404) or is unreachable
+            if not peer_blocks:
+                continue
+            local_blocks = dict(frag.checksum_blocks()) if frag is not None else {}
+            for block_id, checksum in peer_blocks:
+                if local_blocks.get(block_id) == checksum:
+                    continue
+                try:
+                    data = self.cluster.client.block_data(
+                        peer, index, f.name, view_name, shard, block_id
+                    )
+                except ClientError:
+                    continue
+                if frag is None:
+                    frag = v.create_fragment_if_not_exists(shard) if v is not None else None
+                    if frag is None:
+                        frag = f.create_view_if_not_exists(
+                            view_name
+                        ).create_fragment_if_not_exists(shard)
+                added, _ = frag.merge_block(block_id, data)
+                if added:
+                    repaired += 1
+        return repaired
+
+    def _sync_attrs(self, index: str, field_name: Optional[str], store) -> int:
+        """100-id block diff + merge (reference holder.go:975-1067)."""
+        if store is None:
+            return 0
+        repaired = 0
+        for peer in self._peers():
+            try:
+                peer_blocks = self.cluster.client.attr_blocks(peer, index, field_name)
+            except ClientError:
+                continue
+            local_blocks = dict(store.blocks())
+            for block_id, checksum in peer_blocks:
+                if local_blocks.get(block_id) == checksum:
+                    continue
+                try:
+                    data = self.cluster.client.attr_block_data(
+                        peer, index, field_name, block_id
+                    )
+                except ClientError:
+                    continue
+                for id_, attrs in data.items():
+                    if attrs:
+                        store.set_attrs(int(id_), attrs)
+                        repaired += 1
+        return repaired
+
+    def _sync_translation(self) -> None:
+        """Replica-side tail of the primary's key logs."""
+        holder = self.cluster.holder
+        if holder is None or self.cluster.is_coordinator():
+            return
+        for name in list(holder.indexes):
+            idx = holder.index(name)
+            if idx is None:
+                continue
+            stores = [idx.translate_store] + [
+                idx.field(fn).translate_store
+                for fn in list(idx.fields)
+                if idx.field(fn) is not None
+            ]
+            for st in stores:
+                if isinstance(st, ForwardingTranslateStore):
+                    try:
+                        st.sync_from_primary()
+                    except ClientError:
+                        pass
+
+
+class SyncDaemon:
+    """Background thread running anti-entropy + translate tailing on an
+    interval (reference monitorAntiEntropy server.go:514)."""
+
+    def __init__(self, cluster, interval: float = 600.0, logger=None):
+        self.cluster = cluster
+        self.interval = interval
+        self.syncer = HolderSyncer(cluster, logger)
+        self.log = logger or NopLogger()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SyncDaemon":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                n = self.syncer.sync_holder()
+                self.syncer._sync_translation()
+                if n:
+                    self.log.printf("anti-entropy: repaired %d blocks", n)
+            except Exception as e:
+                self.log.printf("anti-entropy error: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class FailureDetector:
+    """Static-topology liveness probe (the gossip-membership replacement;
+    TPU pods have fixed peers, SURVEY.md §2.2 gossip row)."""
+
+    def __init__(self, cluster, interval: float = 1.0, confirm_down: int = CONFIRM_DOWN,
+                 logger=None):
+        self.cluster = cluster
+        self.interval = interval
+        self.confirm_down = confirm_down
+        self.log = logger or NopLogger()
+        self._fails: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def probe_once(self) -> None:
+        topo = self.cluster.topology
+        local_id = self.cluster.local_node.id
+        for node in list(topo.nodes):
+            if node.id == local_id:
+                continue
+            try:
+                self.cluster.client.status(node)
+                ok = True
+            except ClientError:
+                ok = False
+            if ok:
+                self._fails[node.id] = 0
+                if node.state == NODE_STATE_DOWN:
+                    node.state = NODE_STATE_READY
+                    self.log.printf("node %s is back up", node.id)
+            else:
+                self._fails[node.id] = self._fails.get(node.id, 0) + 1
+                if (
+                    self._fails[node.id] >= self.confirm_down
+                    and node.state != NODE_STATE_DOWN
+                ):
+                    node.state = NODE_STATE_DOWN
+                    self.log.printf("node %s marked down", node.id)
+        # Cluster state follows membership (reference determineClusterState
+        # cluster.go:571): any down node + replication -> DEGRADED.
+        from pilosa_tpu.cluster.topology import STATE_DEGRADED, STATE_NORMAL
+
+        any_down = any(n.state == NODE_STATE_DOWN for n in topo.nodes)
+        state = self.cluster.state()
+        if any_down and topo.replica_n > 1 and state == STATE_NORMAL:
+            self.cluster.set_state(STATE_DEGRADED)
+        elif not any_down and state == STATE_DEGRADED:
+            self.cluster.set_state(STATE_NORMAL)
+
+    def start(self) -> "FailureDetector":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.probe_once()
+            except Exception as e:
+                self.log.printf("failure detector error: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -9,6 +9,7 @@ cluster layer injects itself to gate methods and route imports.
 
 from __future__ import annotations
 
+import json
 from typing import Any, Optional
 
 import numpy as np
@@ -387,6 +388,58 @@ class API:
                 f.import_roaring(shard, data, view_name=name, clear=clear)
             except ValueError as e:
                 raise APIError(str(e)) from e
+
+    # -- resize (reference api.go:1193-1261) -------------------------------
+
+    def _resizer(self):
+        if self.cluster is None or self.cluster.resizer is None:
+            raise APIError("cluster resize is not enabled", status=400)
+        return self.cluster.resizer
+
+    def resize_add_node(self, body: dict) -> dict:
+        """POST /cluster/resize/add-node {id?, uri}. Non-coordinators
+        forward to the coordinator (reference routes joins there)."""
+        from pilosa_tpu.cluster.resize import ResizeError
+        from pilosa_tpu.cluster.topology import Node, URI
+
+        rz = self._resizer()
+        if not self.cluster.is_coordinator():
+            coord = self.cluster.coordinator()
+            return self.cluster.client._do(
+                "POST", coord, "/cluster/resize/add-node", json.dumps(body).encode()
+            )
+        uri = URI.parse(body.get("uri", ""))
+        node_id = body.get("id") or f"node-{uri.host}-{uri.port}"
+        try:
+            job = rz.add_node(Node(id=node_id, uri=uri))
+        except ResizeError as e:
+            raise APIError(str(e), status=400) from e
+        return {"job": job, "node": node_id}
+
+    def resize_remove_node(self, node_id: str) -> dict:
+        from pilosa_tpu.cluster.resize import ResizeError
+
+        rz = self._resizer()
+        if not self.cluster.is_coordinator():
+            coord = self.cluster.coordinator()
+            return self.cluster.client._do(
+                "POST", coord, "/cluster/resize/remove-node",
+                json.dumps({"id": node_id}).encode(),
+            )
+        try:
+            job = rz.remove_node(node_id)
+        except ResizeError as e:
+            raise APIError(str(e), status=400) from e
+        return {"job": job, "node": node_id}
+
+    def resize_abort(self) -> None:
+        self._validate_state("ResizeAbort")
+        rz = self._resizer()
+        if not self.cluster.is_coordinator():
+            coord = self.cluster.coordinator()
+            self.cluster.client._do("POST", coord, "/cluster/resize/abort", b"{}")
+            return
+        rz.abort()
 
     # -- info --------------------------------------------------------------
 

@@ -399,6 +399,75 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(frag.block_data(block), content_type="application/octet-stream")
 
+    @route("GET", r"/internal/field/state")
+    def handle_get_field_state(self):
+        """View names + available shards for one field (anti-entropy and
+        resize discovery; the reference ships this in NodeStatus gossip)."""
+        index = self.query.get("index", "")
+        field = self.query.get("field", "")
+        idx = self.api.holder.index(index)
+        f = idx.field(field) if idx else None
+        if f is None:
+            self._error(f"field not found: {field}", status=404)
+            return
+        self._reply(
+            {
+                "views": sorted(f.views),
+                "availableShards": f.available_shards().to_array().tolist(),
+            }
+        )
+
+    @route("GET", r"/internal/attr/blocks")
+    def handle_get_attr_blocks(self):
+        store = self._attr_store()
+        if store is None:
+            return
+        self._reply(
+            {"blocks": [{"id": b, "checksum": str(c)} for b, c in store.blocks()]}
+        )
+
+    @route("GET", r"/internal/attr/block/data")
+    def handle_get_attr_block_data(self):
+        store = self._attr_store()
+        if store is None:
+            return
+        block = int(self.query.get("block", "0"))
+        self._reply({"attrs": {str(k): v for k, v in store.block_data(block).items()}})
+
+    def _attr_store(self):
+        index = self.query.get("index", "")
+        field = self.query.get("field", "")
+        idx = self.api.holder.index(index)
+        if idx is None:
+            self._error(f"index not found: {index}", status=404)
+            return None
+        if field:
+            f = idx.field(field)
+            store = f.row_attr_store if f else None
+        else:
+            store = idx.column_attr_store
+        if store is None:
+            self._error("no attr store", status=400)
+            return None
+        return store
+
+    # -- resize control (reference api.go:1193-1261) -----------------------
+
+    @route("POST", r"/cluster/resize/add-node")
+    def handle_resize_add_node(self):
+        body = self._json_body()
+        self._reply(self.api.resize_add_node(body))
+
+    @route("POST", r"/cluster/resize/remove-node")
+    def handle_resize_remove_node(self):
+        body = self._json_body()
+        self._reply(self.api.resize_remove_node(body.get("id", "")))
+
+    @route("POST", r"/cluster/resize/abort")
+    def handle_resize_abort(self):
+        self.api.resize_abort()
+        self._reply({"success": True})
+
     @route("POST", r"/internal/cluster/message")
     def handle_post_cluster_message(self):
         if self.api.cluster is None:

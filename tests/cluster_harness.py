@@ -44,23 +44,71 @@ class TestCluster:
 
     def __init__(self, n: int, replica_n: int = 1, hasher=None):
         self._tmp = tempfile.mkdtemp(prefix="pilosa-tpu-cluster-")
+        self._replica_n = replica_n
+        self._hasher = hasher or JmpHasher()
+        self._next_i = n
         self.nodes: list[ClusterNode] = [
             ClusterNode(i, f"{self._tmp}/node{i}") for i in range(n)
         ]
         members = [cn.node for cn in self.nodes]
         for cn in self.nodes:
-            topo = Topology(
-                nodes=[Node(m.id, m.uri, m.is_coordinator) for m in members],
-                replica_n=replica_n,
-                hasher=hasher or JmpHasher(),
-            )
-            cn.cluster = Cluster(
-                local_node=topo.node_by_id(cn.node.id),
-                topology=topo,
-                holder=cn.holder,
-            )
-            cn.cluster.attach(cn.executor, cn.api)
-            cn.api.cluster = cn.cluster
+            self._wire(cn, members)
+
+    def _wire(self, cn: ClusterNode, members) -> None:
+        topo = Topology(
+            nodes=[Node(m.id, m.uri, m.is_coordinator) for m in members],
+            replica_n=self._replica_n,
+            hasher=self._hasher,
+        )
+        cn.cluster = Cluster(
+            local_node=topo.node_by_id(cn.node.id),
+            topology=topo,
+            holder=cn.holder,
+        )
+        cn.cluster.attach(cn.executor, cn.api)
+        cn.api.cluster = cn.cluster
+        cn.cluster.attach_resizer()
+
+    def spawn_node(self) -> ClusterNode:
+        """Boot a fresh empty node wired to see only itself (it learns the
+        real topology from the resize instruction)."""
+        i = self._next_i
+        self._next_i += 1
+        cn = ClusterNode(i, f"{self._tmp}/node{i}")
+        cn.node.is_coordinator = False
+        self._wire(cn, [cn.node])
+        self.nodes.append(cn)
+        return cn
+
+    def add_node_via_resize(self, timeout: float = 10.0) -> ClusterNode:
+        """Grow the cluster through the coordinator's resize job and wait
+        for the topology to converge everywhere."""
+        cn = self.spawn_node()
+        self.nodes[0].cluster.resizer.add_node(
+            Node(cn.node.id, cn.node.uri, False)
+        )
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(
+                len(x.cluster.topology.nodes) == len(self.nodes)
+                and x.cluster.state() == "NORMAL"
+                for x in self.nodes
+            ):
+                return cn
+            time.sleep(0.02)
+        states = [(x.node.id, x.cluster.state(), len(x.cluster.topology.nodes)) for x in self.nodes]
+        raise TimeoutError(f"resize never converged: {states}")
+
+    def sync_all(self) -> int:
+        """One synchronous anti-entropy pass on every node."""
+        from pilosa_tpu.cluster.sync import HolderSyncer
+
+        repaired = 0
+        for cn in self.nodes:
+            syncer = HolderSyncer(cn.cluster)
+            repaired += syncer.sync_holder()
+            syncer._sync_translation()
+        return repaired
 
     def __getitem__(self, i: int) -> ClusterNode:
         return self.nodes[i]
