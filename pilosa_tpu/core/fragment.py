@@ -40,6 +40,10 @@ BSI_OFFSET_BIT = 2
 
 CACHE_EXT = ".cache"
 
+# Decoded-row LRU bound: a TopN over a 50k-row fragment must not pin 50k
+# bitmaps (r1 weak #7). 2048 rows ≈ a full rank-cache recalc working set.
+ROW_CACHE_MAX = 2048
+
 
 def pos(row_id: int, column_id: int) -> int:
     """Bit position in fragment storage (reference fragment.go pos)."""
@@ -84,6 +88,9 @@ class Fragment:
         self.version = 0
         self.uid = next(_fragment_uids)
         self._row_cache: dict[int, Bitmap] = {}
+        # Lazily-computed per-block checksums, invalidated by row on write
+        # (reference caches block checksums too, fragment.go:1762-1776).
+        self._block_sums: dict[int, int] = {}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -163,9 +170,11 @@ class Fragment:
         self.version += 1
         if row_ids is None:
             self._row_cache.clear()
+            self._block_sums.clear()
         else:
             for r in row_ids:
                 self._row_cache.pop(r, None)
+                self._block_sums.pop(r // HASH_BLOCK_SIZE, None)
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
         """reference fragment.go setBit :647 (+ handleMutex :670)."""
@@ -193,8 +202,9 @@ class Fragment:
 
     def _clear_mutex_column(self, keep_row: int, column_id: int) -> bool:
         """Clear any other row's bit for this column (mutex fields,
-        reference fragment.go handleMutex + mutexVector fragment.go:3242)."""
-        changed = False
+        reference fragment.go handleMutex + mutexVector fragment.go:3242).
+        The mutex invariant means at most ONE other row holds the column,
+        so the scan stops at the first hit."""
         col = column_id % SHARD_WIDTH
         for row_id in self.row_ids():
             if row_id == keep_row:
@@ -203,8 +213,8 @@ class Fragment:
                 self.storage.remove(row_id * SHARD_WIDTH + col)
                 self.cache.add(row_id, self.row_count(row_id))
                 self._mutated([row_id])
-                changed = True
-        return changed
+                return True
+        return False
 
     def clear_row(self, row_id: int) -> bool:
         """Remove all bits in a row (reference fragment.go unprotectedClearRow)."""
@@ -238,11 +248,14 @@ class Fragment:
     # -- reads ------------------------------------------------------------
 
     def _row_bitmap(self, row_id: int) -> Bitmap:
-        cached = self._row_cache.get(row_id)
+        cached = self._row_cache.pop(row_id, None)
         if cached is not None:
+            self._row_cache[row_id] = cached  # LRU touch (dict order)
             return cached
         bm = self.storage.offset_range(0, row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH)
         self._row_cache[row_id] = bm
+        while len(self._row_cache) > ROW_CACHE_MAX:
+            self._row_cache.pop(next(iter(self._row_cache)))
         return bm
 
     def row(self, row_id: int) -> Row:
@@ -624,19 +637,26 @@ class Fragment:
 
     def _bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray) -> None:
         """Mutex import: last write per column wins, other rows cleared
-        (reference fragment.bulkImportMutex :2133)."""
+        (reference fragment.bulkImportMutex :2133 via the vectorized
+        mutexVector idea :3242): per existing row, ONE bitmap intersection
+        against the imported column set + a searchsorted target lookup —
+        no per-(row, column) Python scanning (r1 weak #5)."""
         # Deduplicate: keep the last (row, column) per column.
         last: dict[int, int] = {}
         for r, c in zip(row_ids.tolist(), column_ids.tolist()):
             last[c % SHARD_WIDTH] = r
         cols = np.array(sorted(last), dtype=np.uint64)
         targets = np.array([last[int(c)] for c in cols], dtype=np.uint64)
+        cols_bm = Bitmap(cols)
         to_clear = []
         for row_id in self.row_ids():
-            row_bm = self._row_bitmap(row_id)
-            mask = np.array([row_bm.contains(int(c)) and last[int(c)] != row_id for c in cols])
-            if mask.any():
-                to_clear.append(row_id * np.uint64(SHARD_WIDTH) + cols[mask])
+            hit = self._row_bitmap(row_id).intersect(cols_bm).to_array()
+            if not hit.size:
+                continue
+            tgt = targets[np.searchsorted(cols, hit)]
+            stale = hit[tgt != np.uint64(row_id)]
+            if stale.size:
+                to_clear.append(np.uint64(row_id * SHARD_WIDTH) + stale)
         if to_clear:
             self.storage.remove_many(np.concatenate(to_clear))
         self.storage.add_many(targets * np.uint64(SHARD_WIDTH) + cols)
@@ -709,15 +729,27 @@ class Fragment:
         """[(block_id, checksum)] for each 100-row block with data. Checksum
         is xxhash64 of the block's serialized sub-bitmap (the reference
         hashes (row,col) pair streams with xxhash, fragment.go:2814; any
-        deterministic digest works as long as all nodes agree)."""
+        deterministic digest works as long as all nodes agree). Checksums
+        are cached per block and invalidated by row on mutation (reference
+        fragment.go:1762-1776) so anti-entropy passes don't re-serialize
+        unchanged blocks (r1 weak #9)."""
         with self.lock:
             out = []
             block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
             blocks = sorted({(k << 16) // block_span for k in self.storage.keys()})
             for b in blocks:
+                cached = self._block_sums.get(b)
+                if cached is not None:
+                    if cached:  # 0 marks an empty block
+                        out.append((b, cached))
+                    continue
                 sub = self.storage.offset_range(0, b * block_span, (b + 1) * block_span)
                 if sub.any():
-                    out.append((b, xxhash64(serialize(sub))))
+                    h = xxhash64(serialize(sub))
+                    self._block_sums[b] = h
+                    out.append((b, h))
+                else:
+                    self._block_sums[b] = 0
             return out
 
     def block_data(self, block_id: int) -> bytes:

@@ -36,6 +36,8 @@ from pilosa_tpu.exec.result import (
 from pilosa_tpu.pql import Call, Condition, Query, parse_string
 from pilosa_tpu.pql.ast import is_reserved_arg
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.stats import global_stats
+from pilosa_tpu.utils.tracing import global_tracer
 
 MAX_INT = (1 << 63) - 1
 
@@ -64,6 +66,12 @@ class Executor:
         # replica of the target shard, attr writes on every node
         # (reference executeSetBitField :2096-2135). None = single node.
         self.router = None
+        # Observability (reference spans in Execute executor.go:114, stats
+        # tags per index, and the long-query log api.go:1157).
+        self.stats = global_stats
+        self.tracer = global_tracer
+        self.long_query_time: float = 60.0
+        self.logger = None
 
     # ------------------------------------------------------------------
     # entry
@@ -85,17 +93,30 @@ class Executor:
         if opt.shards:
             shards = list(opt.shards)
 
+        import time as _time
+
+        t0 = _time.perf_counter()
+        stats = self.stats.with_tags(f"index:{index}")
         results = []
-        for call in query.calls:
-            # Remote (peer-issued) requests arrive pre-translated and are
-            # returned raw; translation happens only at the coordinator
-            # (reference executor.go:121-127).
-            if not opt.remote:
-                self._translate_call(idx, call)
-            result = self.execute_call(index, call, shards, opt)
-            if not opt.remote:
-                result = self._translate_result(idx, call, result)
-            results.append(result)
+        with self.tracer.start_span("executor.Execute") as span:
+            span.set_tag("index", index)
+            for call in query.calls:
+                stats.count(f"query_{call.name}_total")
+                # Remote (peer-issued) requests arrive pre-translated and
+                # are returned raw; translation happens only at the
+                # coordinator (reference executor.go:121-127).
+                if not opt.remote:
+                    self._translate_call(idx, call)
+                with self.tracer.start_span(f"executor.execute{call.name}"):
+                    result = self.execute_call(index, call, shards, opt)
+                if not opt.remote:
+                    result = self._translate_result(idx, call, result)
+                results.append(result)
+        elapsed = _time.perf_counter() - t0
+        stats.timing("execute_duration_seconds", elapsed)
+        if elapsed > self.long_query_time and self.logger is not None:
+            # reference api.go:1157 long-query log.
+            self.logger.printf("%.3fs longQueryTime exceeded: %r", elapsed, query)
         return results
 
     # ------------------------------------------------------------------

@@ -815,7 +815,8 @@ class TPUBackend:
             return sum(self.cpu.count_shard(index, c, s) for s in shards)
         s_pad = blocks[0].shape[0]
         reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
-        partials = self._program("count", spec, reduce_dev)(blocks, scalars)
+        with jax.profiler.TraceAnnotation("pilosa.count"):
+            partials = self._program("count", spec, reduce_dev)(blocks, scalars)
         # Host sum in Python ints: exact for any shard count.
         return int(np.asarray(partials, dtype=np.uint64).sum())
 
@@ -843,10 +844,11 @@ class TPUBackend:
         )
         s_pad = blocks[0].shape[0]
         reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
-        out = np.asarray(
-            self._program("count_batch", spec, reduce_dev)(blocks, scalars),
-            dtype=np.uint64,
-        )
+        with jax.profiler.TraceAnnotation("pilosa.count_batch"):
+            out = np.asarray(
+                self._program("count_batch", spec, reduce_dev)(blocks, scalars),
+                dtype=np.uint64,
+            )
         if out.ndim == 2:  # [S, Q] partials past the device-sum bound
             out = out.sum(axis=0)
         return [int(v) for v in out]
@@ -882,12 +884,13 @@ class TPUBackend:
         s_pad = block.shape[0]
         reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
 
-        if src_call is None:
-            counts = self._program("topn_plain", None, reduce_dev)(block)
-        else:
-            counts = self._program("topn_src", spec, reduce_dev)(
-                block, blocks, scalars
-            )
+        with jax.profiler.TraceAnnotation("pilosa.topn"):
+            if src_call is None:
+                counts = self._program("topn_plain", None, reduce_dev)(block)
+            else:
+                counts = self._program("topn_src", spec, reduce_dev)(
+                    block, blocks, scalars
+                )
         counts = np.asarray(counts, dtype=np.uint64)
         if counts.ndim == 2:  # [S, R] partials past the device-sum bound
             counts = counts.sum(axis=0)
@@ -931,9 +934,10 @@ class TPUBackend:
         if bsi_block.shape[0] > MAX_DEVICE_SUM_SHARDS:
             return None
         depth = opts.bit_depth
-        pos_c, neg_c, cnt = self._program(
-            "bsi_sum", spec, True, extra=depth
-        )(bsi_block, blocks, scalars)
+        with jax.profiler.TraceAnnotation("pilosa.bsi_sum"):
+            pos_c, neg_c, cnt = self._program(
+                "bsi_sum", spec, True, extra=depth
+            )(bsi_block, blocks, scalars)
         pos_c = np.asarray(pos_c, dtype=np.uint64)
         neg_c = np.asarray(neg_c, dtype=np.uint64)
         total = sum((int(pos_c[i]) - int(neg_c[i])) << i for i in range(depth))
@@ -959,12 +963,13 @@ class TPUBackend:
         if bsi_block.shape[0] > MAX_DEVICE_SUM_SHARDS:
             return None
         depth = opts.bit_depth
-        bits_a, cnt_a, bits_b, cnt_b, branch_any, consider_any = (
-            np.asarray(x)
-            for x in self._program(kind, spec, True, extra=depth)(
-                bsi_block, blocks, scalars
+        with jax.profiler.TraceAnnotation("pilosa." + kind):
+            bits_a, cnt_a, bits_b, cnt_b, branch_any, consider_any = (
+                np.asarray(x)
+                for x in self._program(kind, spec, True, extra=depth)(
+                    bsi_block, blocks, scalars
+                )
             )
-        )
 
         def assemble_max(bits) -> int:  # maxUnsigned decision bits
             return sum(1 << i for i in range(depth) if bits[i])

@@ -249,6 +249,11 @@ class API:
         existence). remote=True marks a peer-routed request that must
         apply locally without re-routing."""
         self._validate_state("Import")
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats.with_tags(f"index:{index}", f"field:{field}").count(
+            "import_bits_total", len(column_ids) or len(column_keys or [])
+        )
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError(f"index not found: {index}")
@@ -290,6 +295,11 @@ class API:
         remote: bool = False,
     ) -> None:
         self._validate_state("ImportValue")
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats.with_tags(f"index:{index}", f"field:{field}").count(
+            "import_values_total", len(column_ids) or len(column_keys or [])
+        )
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError(f"index not found: {index}")

@@ -133,14 +133,29 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             match = pattern.match(path)
             if match:
+                # Per-route stats middleware (reference statsValidator,
+                # http stats middleware in handler.go, CHANGELOG 1.4).
+                from pilosa_tpu.utils.stats import global_stats
+                from pilosa_tpu.utils.tracing import global_tracer
+
+                stats = global_stats.with_tags(f"route:{fn_name[7:]}", f"method:{method}")
+                stats.count("http_requests_total")
+                span = global_tracer.start_span(
+                    f"http.{fn_name}", headers=dict(self.headers)
+                )
                 try:
-                    getattr(self, fn_name)(**match.groupdict())
+                    with stats.timer("http_request_duration_seconds"):
+                        getattr(self, fn_name)(**match.groupdict())
                 except APIError as e:
+                    stats.count("http_request_errors_total")
                     self._error(str(e), status=e.status)
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # mirror the reference's panic trap
+                    stats.count("http_request_errors_total")
                     self._error(f"PANIC: {e}\n{traceback.format_exc()}", status=500)
+                finally:
+                    span.finish()
                 return
         self._error("not found", status=404)
 
@@ -329,7 +344,22 @@ class _Handler(BaseHTTPRequestHandler):
     def handle_metrics(self):
         from pilosa_tpu.utils.stats import global_stats
 
+        # Surface device-residency gauges at scrape time (HBM policy).
+        backend = getattr(self.api.executor, "backend", None)
+        blocks = getattr(backend, "blocks", None)
+        if blocks is not None:
+            global_stats.gauge("tpu_resident_bytes", blocks.resident_bytes())
+            global_stats.gauge("tpu_stack_evictions", blocks.evictions)
         self._reply(global_stats.prometheus_text(), content_type="text/plain; version=0.0.4")
+
+    @route("GET", r"/debug/traces")
+    def handle_debug_traces(self):
+        """Recent spans from the in-memory tracer (the reference exposes
+        jaeger; an inspection endpoint keeps the seam observable here)."""
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        n = int(self.query.get("n", "50"))
+        self._reply({"spans": global_tracer.recent(n)})
 
     # -- internal routes (reference http/handler.go:307-318) ---------------
 
