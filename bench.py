@@ -1,22 +1,33 @@
-"""Headline benchmark: PQL Intersect+Count throughput, TPU vs host roaring.
+"""Headline benchmark: PQL Intersect+Count throughput at the north-star
+shape (954 shards = 1.0B columns, BASELINE.json), TPU vs the numpy oracle.
 
-Builds an index of BENCH_SHARDS shards (2^20 columns each) with two set
-fields, then measures Count(Intersect(Row(f=i), Row(g=j))) throughput:
+Measured paths:
 
-- TPU: the TPUBackend's batched path — Q same-shape queries fused into a
-  single device dispatch over stacked HBM blocks (the realistic serving
-  shape; per-query blocking sync through this environment's relay-attached
+- batched throughput: Q same-shape Count(Intersect(Row,Row)) queries fused
+  into ONE device dispatch over stacked HBM blocks (the serving shape;
+  per-dispatch blocking sync through this environment's relay-attached
   chip costs ~78 ms regardless of work, so batching is the only honest
-  throughput measurement).
-- Baseline: the same queries through the CPU oracle backend (vectorized
-  numpy roaring — the stand-in for the reference's Go/roaring engine; the
-  reference publishes no absolute numbers and no Go toolchain exists in
-  this image, see BASELINE.md).
+  throughput measurement — single-query latency is reported separately).
+- single-query p50/p99 latency: one unbatched dispatch per query.
+- TopN latency: exact popcount-per-row + sort over the whole field.
 
-Prints ONE JSON line {metric, value, unit, vs_baseline}.
+Baseline: the same queries through the CPU oracle backend — **vectorized
+numpy roaring, NOT the Go reference**. The reference publishes no absolute
+numbers and no Go toolchain exists in this image (BASELINE.md); vs_baseline
+is therefore labeled vs_numpy_oracle. Rough calibration: the Go engine's
+per-container AND+popcount loops are typically 3-10x faster than this
+numpy oracle on equal hardware, so divide vs_baseline by ~10 for a
+conservative Go-relative estimate.
 
-Env knobs: BENCH_SHARDS (default 64), BENCH_ROWS (8), BENCH_DENSITY
-(0.05), BENCH_BATCH (256), BENCH_SECONDS (10).
+Roofline context: each query touches 2 rows x SHARDS x 128 KiB = ~250 MB
+of HBM at the 954-shard shape; hbm_gbps reports the achieved read rate so
+the "fast" claim is bandwidth-grounded (VERDICT r1 #6).
+
+Prints ONE JSON line {metric, value, unit, vs_baseline, ...}.
+
+Env knobs: BENCH_SHARDS (default 954 = 1B cols), BENCH_ROWS (8),
+BENCH_DENSITY (0.05), BENCH_BATCH (256), BENCH_SECONDS (10),
+BENCH_LATENCY_N (30).
 """
 
 import json
@@ -34,11 +45,14 @@ from pilosa_tpu.exec.tpu import TPUBackend
 from pilosa_tpu.pql import parse_string
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
-SHARDS = int(os.environ.get("BENCH_SHARDS", "64"))
+SHARDS = int(os.environ.get("BENCH_SHARDS", "954"))  # 954*2^20 > 1e9 columns
 ROWS = int(os.environ.get("BENCH_ROWS", "8"))
 DENSITY = float(os.environ.get("BENCH_DENSITY", "0.05"))
 BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 SECONDS = float(os.environ.get("BENCH_SECONDS", "10"))
+LATENCY_N = int(os.environ.get("BENCH_LATENCY_N", "30"))
+
+WORDS = SHARD_WIDTH // 32
 
 
 def build_index(h: Holder):
@@ -49,10 +63,9 @@ def build_index(h: Holder):
         field = idx.create_field(fname)
         for shard in range(SHARDS):
             base = shard * SHARD_WIDTH
-            for row in range(ROWS):
-                cols = rng.integers(0, SHARD_WIDTH, n_bits, dtype=np.uint64) + base
-                cols = np.unique(cols)
-                field.import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+            rows = np.repeat(np.arange(ROWS, dtype=np.uint64), n_bits)
+            cols = rng.integers(0, SHARD_WIDTH, ROWS * n_bits, dtype=np.uint64) + base
+            field.import_bits(rows, cols)
     return idx
 
 
@@ -68,15 +81,44 @@ def bench_tpu(holder, queries) -> tuple[float, list[int]]:
         be.count_batch("bench", calls[:BATCH], shards)
         n_done += BATCH
     dt = time.time() - t0
-    return n_done / dt, first
+    return n_done / dt, first, be
+
+
+def bench_tpu_single(be, queries) -> tuple[float, float]:
+    """Unbatched: one dispatch + one scalar readback per query."""
+    shards = list(range(SHARDS))
+    calls = [parse_string(q).calls[0].children[0] for q in queries[:LATENCY_N]]
+    be.count_shards("bench", calls[0], shards)  # warm
+    lat = []
+    for c in calls:
+        t0 = time.perf_counter()
+        be.count_shards("bench", c, shards)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return lat[len(lat) // 2], lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+
+def bench_topn(be) -> float:
+    """Exact TopN over the whole field: p50 of LATENCY_N runs."""
+    shards = list(range(SHARDS))
+    be.topn_field("bench", "f", shards, 10)  # warm
+    lat = []
+    for _ in range(max(5, LATENCY_N // 3)):
+        t0 = time.perf_counter()
+        be.topn_field("bench", "f", shards, 10)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return lat[len(lat) // 2]
 
 
 def bench_cpu(holder, parsed_queries) -> float:
-    """Same pre-parsed queries, same duration knob as the TPU side."""
+    """Same pre-parsed queries through the numpy-oracle executor."""
     ex = Executor(holder)
     n_done = 0
     t0 = time.time()
-    while time.time() - t0 < SECONDS:
+    # At the 1B-column shape a single oracle query takes seconds; run at
+    # least 3 so the rate is a measurement, not one sample.
+    while time.time() - t0 < SECONDS or n_done < 3:
         ex.execute("bench", parsed_queries[n_done % len(parsed_queries)])
         n_done += 1
     dt = time.time() - t0
@@ -86,7 +128,9 @@ def bench_cpu(holder, parsed_queries) -> float:
 def main():
     h = Holder(None)  # in-memory: bench measures query path, not disk
     h.open()
+    t_build = time.time()
     build_index(h)
+    t_build = time.time() - t_build
 
     rng = np.random.default_rng(7)
     queries = [
@@ -96,13 +140,19 @@ def main():
     parsed = [parse_string(q) for q in queries]
 
     cpu_qps = bench_cpu(h, parsed)
-    tpu_qps, tpu_first = bench_tpu(h, queries)
+    tpu_qps, tpu_first, be = bench_tpu(h, queries)
+    p50, p99 = bench_tpu_single(be, queries)
+    topn_p50 = bench_topn(be)
 
     # Correctness cross-check: TPU batch results must equal the CPU oracle.
     ex = Executor(h)
     for i in sorted({0, BATCH // 2, BATCH - 1}):
         want = ex.execute("bench", queries[i])[0]
         assert tpu_first[i] == want, (i, tpu_first[i], want)
+
+    # HBM roofline: bytes of row data each query's AND+popcount touches.
+    bytes_per_query = 2 * SHARDS * WORDS * 4
+    hbm_gbps = tpu_qps * bytes_per_query / 1e9
 
     print(
         json.dumps(
@@ -111,7 +161,14 @@ def main():
                 "value": round(tpu_qps, 1),
                 "unit": "queries/s",
                 "vs_baseline": round(tpu_qps / cpu_qps, 2) if cpu_qps else None,
-                "baseline_qps": round(cpu_qps, 1),
+                "baseline": "numpy_oracle_cpu (NOT Go/roaring; see BASELINE.md)",
+                "baseline_qps": round(cpu_qps, 2),
+                "single_query_p50_ms": round(p50 * 1e3, 2),
+                "single_query_p99_ms": round(p99 * 1e3, 2),
+                "topn_p50_ms": round(topn_p50 * 1e3, 2),
+                "hbm_read_gbps": round(hbm_gbps, 1),
+                "bytes_touched_per_query": bytes_per_query,
+                "build_seconds": round(t_build, 1),
                 "config": {
                     "shards": SHARDS,
                     "columns": SHARDS * SHARD_WIDTH,

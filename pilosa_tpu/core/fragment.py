@@ -97,13 +97,15 @@ class Fragment:
     def open(self) -> "Fragment":
         if self.path is not None:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
-            data = b""
-            if os.path.exists(self.path):
-                with open(self.path, "rb") as f:
-                    data = f.read()
-            if data:
-                self.storage = deserialize(data)
-            else:
+            # mmap-backed read (budgeted, reference syswrap): container
+            # payloads copy out during deserialize, so there is no
+            # transient whole-file copy and the map releases immediately.
+            from pilosa_tpu.utils.syswrap import read_buffer
+
+            with read_buffer(self.path) as data:
+                if len(data):
+                    self.storage = deserialize(data)
+            if not os.path.exists(self.path) or os.path.getsize(self.path) == 0:
                 # New file: write an empty-bitmap header so the op log that
                 # follows always has a valid roaring prefix (reference
                 # fragment.go openStorage writes the marshaled bitmap first).

@@ -160,16 +160,6 @@ class _StackedBlocks:
         self._entries.clear()
 
 
-def _spec_batchable(spec) -> bool:
-    """Batched (vectorized-row) programs support plain-row trees only."""
-    tag = spec[0]
-    if tag == "R":
-        return True
-    if tag in ("U", "I", "D", "X"):
-        return all(_spec_batchable(ch) for ch in spec[1])
-    return False
-
-
 # ---------------------------------------------------------------------------
 # trace-time evaluation of a spec tree
 # ---------------------------------------------------------------------------
@@ -278,22 +268,20 @@ def _shift_slab(slab, n: int):
     return (lo << np.uint32(s_bits)) | (hi >> np.uint32(32 - s_bits))
 
 
-def _eval_spec(spec, blocks_it, scalars_it, batched=False):
-    """Trace-time recursive evaluation of a tree spec.
-
-    Unbatched: row scalars, result [S, W]. Batched: row vectors [Q],
-    result [S, Q, W] — Q same-shape queries fused into one program (the
-    serving-style batching that amortizes dispatch+readback round trips).
-    Both iterators are consumed in the exact order _build_spec emitted.
+def _eval_spec(spec, blocks_it, scalars_it):
+    """Trace-time recursive evaluation of a tree spec over [S, W] slabs;
+    row ids, masks, and predicate bits are traced scalars/vectors, so one
+    compiled program serves any values of the same tree shape. Both
+    iterators are consumed in the exact order _build emitted. Batched
+    (multi-query) execution scans this same evaluation over the query
+    axis (see the count_batch program).
     """
     tag = spec[0]
     if tag == "R":
         block = next(blocks_it)  # [S, R, W]
-        row = next(scalars_it)  # scalar or [Q]
+        row = next(scalars_it)  # traced scalar
         mask = next(scalars_it)
-        slab = jnp.take(block, row, axis=1)  # [S, W] or [S, Q, W]
-        if batched:
-            return slab * mask[None, :, None]
+        slab = jnp.take(block, row, axis=1)  # [S, W]
         return slab * mask  # mask=0 zeroes rows beyond the packed range
     if tag == "T":
         # Time-range row: union of per-view row slabs (executor.go:1441).
@@ -308,15 +296,11 @@ def _eval_spec(spec, blocks_it, scalars_it, batched=False):
         return acc
     if tag == "A":
         block = next(blocks_it)  # existence stack
-        ex = block[:, 0, :]
-        return ex[:, None, :] if batched else ex
+        return block[:, 0, :]
     if tag == "N":
         block = next(blocks_it)  # existence stack
-        ex = block[:, 0, :]
-        inner = _eval_spec(spec[1], blocks_it, scalars_it, batched)
-        if batched:
-            ex = ex[:, None, :]
-        return ex & ~inner
+        inner = _eval_spec(spec[1], blocks_it, scalars_it)
+        return block[:, 0, :] & ~inner
     if tag == "E":
         block = next(blocks_it)  # consumed for shape only
         return jnp.zeros_like(block[:, 0, :])
@@ -359,12 +343,12 @@ def _eval_spec(spec, blocks_it, scalars_it, batched=False):
         neg = _lt_unsigned(exists & sign, planes, lo_bits, depth, True)
         return pos | neg
     if tag == "S":
-        inner = _eval_spec(spec[2], blocks_it, scalars_it, batched)
+        inner = _eval_spec(spec[2], blocks_it, scalars_it)
         return _shift_slab(inner, spec[1])
     children = spec[1]
-    acc = _eval_spec(children[0], blocks_it, scalars_it, batched)
+    acc = _eval_spec(children[0], blocks_it, scalars_it)
     for ch in children[1:]:
-        v = _eval_spec(ch, blocks_it, scalars_it, batched)
+        v = _eval_spec(ch, blocks_it, scalars_it)
         if tag == "U":
             acc = acc | v
         elif tag == "I":
@@ -644,15 +628,23 @@ class TPUBackend:
         elif kind == "count_batch":
 
             def body(blocks, scalars):
-                slab = _eval_spec(spec, iter(blocks), iter(scalars), batched=True)
-                per = jnp.sum(
-                    jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32
-                )  # [S, Q]
-                if reduce_dev:
-                    return self._psum(jnp.sum(per, axis=0, dtype=jnp.uint32))  # [Q]
-                return per
+                # scan over the query axis: each step is the fused
+                # unbatched count over [S, W] slabs — never materializes a
+                # [S, Q, W] gather (32 GB at the 1B-column/256-batch
+                # shape), and works for any spec (BSI leaves included).
+                def step(_, qs):
+                    slab = _eval_spec(spec, iter(blocks), iter(qs))
+                    per_shard = jnp.sum(
+                        jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32
+                    )
+                    if reduce_dev:
+                        return None, self._psum(jnp.sum(per_shard, dtype=jnp.uint32))
+                    return None, per_shard
 
-            out = (P() if reduce_dev else ax) if mesh is not None else None
+                _, out = jax.lax.scan(step, None, scalars)
+                return out  # [Q] or [Q, S]
+
+            out = (P() if reduce_dev else P(None, mesh.axis if mesh else None)) if mesh is not None else None
             fn = self._wrap(body, False, out)
 
         elif kind == "topn_plain":
@@ -834,12 +826,12 @@ class TPUBackend:
             return [self.count_shards(index, c, shards) for c in calls]
         spec = per_call[0][0]
         assert all(pc[0] == spec for pc in per_call), "count_batch requires same-shape queries"
-        if not _spec_batchable(spec):
-            return [self.count_shards(index, c, shards) for c in calls]
         blocks = per_call[0][1]
         n_scalars = len(per_call[0][2])
+        # Stack per-call leaf scalars along the query axis: scalars become
+        # [Q] (row ids/masks) or [Q, depth] (BSI predicate bits).
         scalars = tuple(
-            np.array([pc[2][j] for pc in per_call], dtype=np.uint32)
+            np.stack([np.asarray(pc[2][j], dtype=np.uint32) for pc in per_call])
             for j in range(n_scalars)
         )
         s_pad = blocks[0].shape[0]
@@ -849,8 +841,8 @@ class TPUBackend:
                 self._program("count_batch", spec, reduce_dev)(blocks, scalars),
                 dtype=np.uint64,
             )
-        if out.ndim == 2:  # [S, Q] partials past the device-sum bound
-            out = out.sum(axis=0)
+        if out.ndim == 2:  # [Q, S] partials past the device-sum bound
+            out = out.sum(axis=1)
         return [int(v) for v in out]
 
     # -- exact TopN (device fast path) -------------------------------------

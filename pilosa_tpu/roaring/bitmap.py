@@ -10,9 +10,15 @@ kernels instead of per-container-type Go loops.
 from __future__ import annotations
 
 import bisect
+import os
 from typing import Iterable, Iterator, Optional
 
 import numpy as np
+
+# Invariant-checking mode (reference roaringparanoia build tag): every
+# container entering a Bitmap is validated. Off by default — it's a
+# correctness harness for tests/debugging, not a production cost.
+PARANOIA = os.environ.get("PILOSA_TPU_PARANOIA", "").lower() in ("1", "true")
 
 # A container covers 2^16 bit positions (reference roaring/roaring.go:64-69).
 CONTAINER_WIDTH = 1 << 16
@@ -66,6 +72,29 @@ class Container:
         self._n = n
 
     # -- constructors ----------------------------------------------------
+
+    def validate(self, key: int = -1) -> None:
+        """Invariant checks for paranoia mode (reference roaringparanoia
+        build tag, roaring/roaring_paranoia.go:20): array containers must
+        be sorted unique within bounds; cached cardinality must match."""
+        if self.typ == TYPE_ARRAY:
+            a = self.data
+            if a.dtype != np.uint16:
+                raise AssertionError(f"container {key}: array dtype {a.dtype}")
+            if a.size > 1 and not (a[1:] > a[:-1]).all():
+                raise AssertionError(f"container {key}: array not sorted/unique")
+            if self._n != int(a.size):
+                raise AssertionError(
+                    f"container {key}: n={self._n} != array size {a.size}"
+                )
+        else:
+            if self.data.size != BITMAP_N:
+                raise AssertionError(
+                    f"container {key}: bitmap has {self.data.size} words"
+                )
+            real = int(np.bitwise_count(self.data).sum())
+            if self._n != real:
+                raise AssertionError(f"container {key}: n={self._n} != popcount {real}")
 
     @staticmethod
     def empty() -> "Container":
@@ -306,6 +335,8 @@ class Bitmap:
         return self._cs.get(key)
 
     def _put(self, key: int, c: Container) -> None:
+        if PARANOIA:
+            c.validate(key)
         if c.n == 0:
             if key in self._cs:
                 del self._cs[key]

@@ -61,7 +61,7 @@ class API:
 
     # -- query -------------------------------------------------------------
 
-    def query(
+    def query_results(
         self,
         index: str,
         query: str,
@@ -70,9 +70,10 @@ class API:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
         remote: bool = False,
-    ) -> dict[str, Any]:
+    ) -> tuple[list[Any], list[dict]]:
+        """Raw executor results + column attr sets (shared by the JSON and
+        protobuf response encoders)."""
         self._validate_state("Query")
-        from pilosa_tpu.exec.result import result_to_json
         from pilosa_tpu.pql import ParseError
 
         opt = ExecOptions(
@@ -92,12 +93,40 @@ class API:
             raise APIError(str(e), status=503) from e
         except ClientError as e:
             raise APIError(f"remote node error: {e}", status=502) from e
+        attr_sets: list[dict] = []
+        if column_attrs and not exclude_columns:
+            attr_sets = self._column_attr_sets(index, results)
+        return results, attr_sets
+
+    def query(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[list[int]] = None,
+        column_attrs: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+        remote: bool = False,
+    ) -> dict[str, Any]:
+        results, attr_sets = self.query_results(
+            index, query, shards=shards, column_attrs=column_attrs,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns, remote=remote,
+        )
         out: dict[str, Any] = {
             "results": [self._encode_result(r, exclude_columns) for r in results]
         }
         if column_attrs and not exclude_columns:
-            out["columnAttrSets"] = self._column_attr_sets(index, results)
+            out["columnAttrSets"] = attr_sets
         return out
+
+    def query_proto(self, index: str, query: str, **kw) -> bytes:
+        """Protobuf QueryResponse (reference QueryResponse public.proto:66;
+        Go client libraries speak this both ways)."""
+        from pilosa_tpu.server.wire import encode_query_response
+
+        results, attr_sets = self.query_results(index, query, **kw)
+        return encode_query_response(results, attr_sets)
 
     def _encode_result(self, r: Any, exclude_columns: bool) -> Any:
         from pilosa_tpu.core.row import Row

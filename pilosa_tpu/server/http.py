@@ -253,15 +253,31 @@ class _Handler(BaseHTTPRequestHandler):
             exclude_row_attrs = self.query.get("excludeRowAttrs") == "true"
             exclude_columns = self.query.get("excludeColumns") == "true"
             remote = self.query.get("remote") == "true"
-        out = self.api.query(
-            index,
-            query,
+        kw = dict(
             shards=shards,
             column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
             remote=remote,
         )
+        # Content negotiation (reference handler.go: protobuf responses
+        # when the client Accepts application/x-protobuf).
+        accept = (self.headers.get("Accept") or "").split(";")[0].strip()
+        if accept == "application/x-protobuf":
+            try:
+                data = self.api.query_proto(index, query, **kw)
+            except APIError as e:
+                from pilosa_tpu.server.wire import encode_query_response
+
+                self._reply(
+                    encode_query_response([], err=str(e)),
+                    status=e.status,
+                    content_type="application/x-protobuf",
+                )
+                return
+            self._reply(data, content_type="application/x-protobuf")
+            return
+        out = self.api.query(index, query, **kw)
         self._reply(out)
 
     @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")

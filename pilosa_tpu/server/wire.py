@@ -268,6 +268,289 @@ class ImportRoaringRequest:
         return m
 
 
+# ---------------------------------------------------------------------------
+# QueryResponse (reference internal/public.proto:66-81 + the type codes in
+# encoding/proto/proto.go:1056-1067; attr types proto.go:1119-1124)
+# ---------------------------------------------------------------------------
+
+QUERY_RESULT_NIL = 0
+QUERY_RESULT_ROW = 1
+QUERY_RESULT_PAIRS = 2
+QUERY_RESULT_VALCOUNT = 3
+QUERY_RESULT_UINT64 = 4
+QUERY_RESULT_BOOL = 5
+QUERY_RESULT_ROWIDS = 6
+QUERY_RESULT_GROUPCOUNTS = 7
+QUERY_RESULT_ROWIDENTIFIERS = 8
+QUERY_RESULT_PAIR = 9
+
+ATTR_TYPE_STRING = 1
+ATTR_TYPE_INT = 2
+ATTR_TYPE_BOOL = 3
+ATTR_TYPE_FLOAT = 4
+
+
+def _encode_int64(fnum: int, v: int) -> bytes:
+    return _encode_tag(fnum, 0) + _encode_varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+
+
+def _encode_attr(key: str, value) -> bytes:
+    """reference internal.Attr (proto.go encodeAttrs)."""
+    out = _encode_string(1, key)
+    if isinstance(value, bool):
+        out += _encode_uint64(2, ATTR_TYPE_BOOL) + _encode_bool(5, value)
+    elif isinstance(value, int):
+        out += _encode_uint64(2, ATTR_TYPE_INT) + _encode_int64(4, value)
+    elif isinstance(value, float):
+        import struct
+
+        out += _encode_uint64(2, ATTR_TYPE_FLOAT)
+        out += _encode_tag(6, 1) + struct.pack("<d", value)
+    else:
+        out += _encode_uint64(2, ATTR_TYPE_STRING) + _encode_string(3, str(value))
+    return out
+
+
+def _encode_attr_list(fnum: int, attrs: dict) -> bytes:
+    out = b""
+    for k in sorted(attrs):
+        out += _encode_bytes(fnum, _encode_attr(k, attrs[k]))
+    return out
+
+
+def _encode_pair(p) -> bytes:
+    out = b""
+    if p.id:
+        out += _encode_uint64(1, int(p.id))
+    if p.count:
+        out += _encode_uint64(2, int(p.count))
+    if getattr(p, "key", ""):
+        out += _encode_string(3, p.key)
+    return out
+
+
+def encode_query_result(r) -> bytes:
+    """One executor result -> internal.QueryResult bytes (reference
+    encoding/proto/proto.go:416-448 encodeQueryResult)."""
+    from pilosa_tpu.core.cache import Pair
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.exec.result import (
+        GroupCount,
+        PairField,
+        PairsField,
+        RowIDs,
+        ValCount,
+    )
+
+    out = b""
+    if isinstance(r, Row):
+        body = _encode_packed_uint64(1, [int(c) for c in r.columns().tolist()])
+        if r.keys:
+            for k in r.keys:
+                body += _encode_string(3, k)
+        if r.attrs:
+            body += _encode_attr_list(2, r.attrs)
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_ROW)
+        out += _encode_bytes(1, body)
+    elif isinstance(r, PairsField):
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_PAIRS)
+        for p in r.pairs:
+            out += _encode_bytes(3, _encode_pair(p))
+    elif isinstance(r, PairField):
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_PAIR)
+        out += _encode_bytes(3, _encode_pair(r.pair))
+    elif isinstance(r, ValCount):
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_VALCOUNT)
+        body = _encode_int64(1, r.val) + _encode_int64(2, r.count)
+        out += _encode_bytes(5, body)
+    elif isinstance(r, bool):
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_BOOL)
+        out += _encode_bool(4, r)
+    elif isinstance(r, int):
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_UINT64)
+        out += _encode_uint64(2, r)
+    elif isinstance(r, RowIDs):
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_ROWIDENTIFIERS)
+        body = _encode_packed_uint64(1, list(r))
+        for k in getattr(r, "keys", None) or []:
+            body += _encode_string(2, k)
+        out += _encode_bytes(9, body)
+    elif isinstance(r, list) and (not r or isinstance(r[0], GroupCount)):
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_GROUPCOUNTS)
+        for gc in r:
+            gbody = b""
+            for fr in gc.group:
+                fbody = _encode_string(1, fr.field)
+                if fr.row_id:
+                    fbody += _encode_uint64(2, int(fr.row_id))
+                if getattr(fr, "row_key", ""):
+                    fbody += _encode_string(3, fr.row_key)
+                gbody += _encode_bytes(1, fbody)
+            gbody += _encode_uint64(2, int(gc.count))
+            out += _encode_bytes(8, gbody)
+    else:  # None / unknown
+        out += _encode_tag(6, 0) + _encode_varint(QUERY_RESULT_NIL)
+    return out
+
+
+def encode_query_response(results, column_attr_sets=None, err: str = "") -> bytes:
+    """internal.QueryResponse (the wire shape Go client libraries read)."""
+    out = b""
+    if err:
+        out += _encode_string(1, err)
+    for r in results:
+        out += _encode_bytes(2, encode_query_result(r))
+    for cas in column_attr_sets or []:
+        body = _encode_uint64(1, int(cas.get("id", 0)))
+        if cas.get("key"):
+            body += _encode_string(3, cas["key"])
+        body += _encode_attr_list(2, cas.get("attrs", {}))
+        out += _encode_bytes(3, body)
+    return out
+
+
+def _decode_attr(data: bytes):
+    key, value = "", None
+    typ = 0
+    raw = {}
+    for fnum, wtype, v in _iter_fields(data):
+        raw[fnum] = v
+    key = _field_str(raw.get(1, b""))
+    typ = raw.get(2, 0)
+    if typ == ATTR_TYPE_STRING:
+        value = _field_str(raw.get(3, b""))
+    elif typ == ATTR_TYPE_INT:
+        value = _signed(raw.get(4, 0))
+    elif typ == ATTR_TYPE_BOOL:
+        value = bool(raw.get(5, 0))
+    elif typ == ATTR_TYPE_FLOAT:
+        import struct
+
+        value = struct.unpack("<d", raw.get(6, b"\0" * 8))[0]
+    return key, value
+
+
+def decode_query_response(data: bytes) -> dict:
+    """QueryResponse bytes -> plain python (for tests + python clients)."""
+    results = []
+    err = ""
+    column_attr_sets = []
+    for fnum, wtype, v in _iter_fields(data):
+        if fnum == 1:
+            err = _field_str(v)
+        elif fnum == 2:
+            results.append(_decode_query_result(v))
+        elif fnum == 3:
+            cas = {"id": 0, "attrs": {}}
+            for f2, w2, v2 in _iter_fields(v):
+                if f2 == 1:
+                    cas["id"] = v2
+                elif f2 == 3:
+                    cas["key"] = _field_str(v2)
+                elif f2 == 2:
+                    k, val = _decode_attr(v2)
+                    cas["attrs"][k] = val
+            column_attr_sets.append(cas)
+    out = {"results": results}
+    if err:
+        out["error"] = err
+    if column_attr_sets:
+        out["columnAttrSets"] = column_attr_sets
+    return out
+
+
+def _decode_query_result(data: bytes):
+    typ = QUERY_RESULT_NIL
+    fields: list[tuple[int, int, object]] = []
+    for fnum, wtype, v in _iter_fields(data):
+        if fnum == 6:
+            typ = v
+        else:
+            fields.append((fnum, wtype, v))
+    if typ == QUERY_RESULT_ROW:
+        row = {"columns": [], "keys": [], "attrs": {}}
+        for fnum, wtype, v in fields:
+            if fnum == 1:
+                for f2, w2, v2 in _iter_fields(v):
+                    if f2 == 1:
+                        row["columns"].extend(_repeated_uint64(v2, w2))
+                    elif f2 == 3:
+                        row["keys"].append(_field_str(v2))
+                    elif f2 == 2:
+                        k, val = _decode_attr(v2)
+                        row["attrs"][k] = val
+        if not row["keys"]:
+            del row["keys"]
+        return row
+    if typ in (QUERY_RESULT_PAIRS, QUERY_RESULT_PAIR):
+        pairs = []
+        for fnum, wtype, v in fields:
+            if fnum == 3:
+                p = {"id": 0, "count": 0}
+                for f2, w2, v2 in _iter_fields(v):
+                    if f2 == 1:
+                        p["id"] = v2
+                    elif f2 == 2:
+                        p["count"] = v2
+                    elif f2 == 3:
+                        p["key"] = _field_str(v2)
+                pairs.append(p)
+        return pairs[0] if typ == QUERY_RESULT_PAIR and pairs else pairs
+    if typ == QUERY_RESULT_VALCOUNT:
+        out = {"value": 0, "count": 0}
+        for fnum, wtype, v in fields:
+            if fnum == 5:
+                for f2, w2, v2 in _iter_fields(v):
+                    if f2 == 1:
+                        out["value"] = _signed(v2)
+                    elif f2 == 2:
+                        out["count"] = _signed(v2)
+        return out
+    if typ == QUERY_RESULT_UINT64:
+        for fnum, wtype, v in fields:
+            if fnum == 2:
+                return v
+        return 0
+    if typ == QUERY_RESULT_BOOL:
+        for fnum, wtype, v in fields:
+            if fnum == 4:
+                return bool(v)
+        return False
+    if typ in (QUERY_RESULT_ROWIDS, QUERY_RESULT_ROWIDENTIFIERS):
+        out = {"rows": [], "keys": []}
+        for fnum, wtype, v in fields:
+            if fnum == 9:
+                for f2, w2, v2 in _iter_fields(v):
+                    if f2 == 1:
+                        out["rows"].extend(_repeated_uint64(v2, w2))
+                    elif f2 == 2:
+                        out["keys"].append(_field_str(v2))
+        if not out["keys"]:
+            del out["keys"]
+        return out
+    if typ == QUERY_RESULT_GROUPCOUNTS:
+        groups = []
+        for fnum, wtype, v in fields:
+            if fnum == 8:
+                gc = {"group": [], "count": 0}
+                for f2, w2, v2 in _iter_fields(v):
+                    if f2 == 1:
+                        fr = {"field": "", "rowID": 0}
+                        for f3, w3, v3 in _iter_fields(v2):
+                            if f3 == 1:
+                                fr["field"] = _field_str(v3)
+                            elif f3 == 2:
+                                fr["rowID"] = v3
+                            elif f3 == 3:
+                                fr["rowKey"] = _field_str(v3)
+                        gc["group"].append(fr)
+                    elif f2 == 2:
+                        gc["count"] = v2
+                groups.append(gc)
+        return groups
+    return None
+
+
 @dataclass
 class QueryRequest:
     """reference internal/public.proto:57."""

@@ -248,3 +248,80 @@ class TestWireCodec:
         r = ImportRoaringRequest(clear=True, views=[ImportRoaringRequestView("x", b"\x01\x02")])
         r2 = ImportRoaringRequest.from_bytes(r.to_bytes())
         assert r2.clear and r2.views[0].name == "x" and r2.views[0].data == b"\x01\x02"
+
+
+class TestProtobufResponses:
+    """QueryResponse protobuf encoding (reference public.proto:66 +
+    encoding/proto/proto.go:416): content-negotiated via Accept."""
+
+    def _pb_query(self, srv, index, pql):
+        from pilosa_tpu.server.wire import decode_query_response
+
+        r = urllib.request.Request(
+            srv.uri + f"/index/{index}/query",
+            data=pql.encode(),
+            method="POST",
+            headers={"Content-Type": "text/plain", "Accept": "application/x-protobuf"},
+        )
+        resp = urllib.request.urlopen(r)
+        assert resp.headers.get("Content-Type") == "application/x-protobuf"
+        return decode_query_response(resp.read())
+
+    def _setup(self, srv):
+        req(srv, "POST", "/index/i", {})
+        req(srv, "POST", "/index/i/field/f", {})
+        req(srv, "POST", "/index/i/field/v",
+            {"options": {"type": "int", "min": -100, "max": 100}})
+        req(srv, "POST", "/index/i/query", b"Set(1, f=3) Set(2, f=3) Set(9, f=5)",
+            ctype="text/plain")
+        req(srv, "POST", "/index/i/query", b"Set(1, v=42) Set(2, v=-7)",
+            ctype="text/plain")
+
+    def test_row_count_pairs_valcount(self, server):
+        self._setup(server)
+        out = self._pb_query(server, "i", "Row(f=3)")
+        assert out["results"][0]["columns"] == [1, 2]
+        out = self._pb_query(server, "i", "Count(Row(f=3))")
+        assert out["results"][0] == 2
+        out = self._pb_query(server, "i", "TopN(f, n=2)")
+        assert out["results"][0] == [
+            {"id": 3, "count": 2},
+            {"id": 5, "count": 1},
+        ]
+        out = self._pb_query(server, "i", "Sum(field=v)")
+        assert out["results"][0] == {"value": 35, "count": 2}
+        out = self._pb_query(server, "i", "Min(field=v)")
+        assert out["results"][0] == {"value": -7, "count": 1}
+
+    def test_bool_rows_groupby_pairfield(self, server):
+        self._setup(server)
+        out = self._pb_query(server, "i", "Set(77, f=3)")
+        assert out["results"][0] is True
+        out = self._pb_query(server, "i", "Rows(f)")
+        assert out["results"][0]["rows"] == [3, 5]
+        out = self._pb_query(server, "i", "GroupBy(Rows(f))")
+        gcs = out["results"][0]
+        assert {g["group"][0]["rowID"]: g["count"] for g in gcs} == {3: 3, 5: 1}
+        out = self._pb_query(server, "i", "MaxRow(field=f)")
+        assert out["results"][0]["id"] == 5
+        out = self._pb_query(server, "i", "SetRowAttrs(f, 3, note=\"hi\")")
+        assert out["results"][0] is None
+
+    def test_error_encoded(self, server):
+        self._setup(server)
+        import urllib.error
+
+        r = urllib.request.Request(
+            server.uri + "/index/i/query",
+            data=b"Bogus(f=1)",
+            method="POST",
+            headers={"Content-Type": "text/plain", "Accept": "application/x-protobuf"},
+        )
+        try:
+            urllib.request.urlopen(r)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            from pilosa_tpu.server.wire import decode_query_response
+
+            out = decode_query_response(e.read())
+            assert "error" in out
