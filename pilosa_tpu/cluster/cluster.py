@@ -19,6 +19,7 @@ the Executor:
 from __future__ import annotations
 
 import queue
+import time
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
@@ -87,6 +88,14 @@ class Cluster:
         # silently lost).
         self._pending_msgs: list[Message] = []
         self._pending_lock = threading.Lock()
+        # Schema-repair throttle per (node, index): a query naming a
+        # genuinely nonexistent field must not trigger a schema push +
+        # duplicate remote execution on every query (ADVICE r2). Entries
+        # expire after repair_retry_interval (a permanent throttle would
+        # disable the NotFound repair the moment one bad-field query came
+        # through); cleared on membership change or successful repair.
+        self._repair_attempted: dict[tuple[str, str], float] = {}
+        self.repair_retry_interval: float = 30.0
 
     # -- wiring ------------------------------------------------------------
 
@@ -233,13 +242,28 @@ class Cluster:
         except ClientError as e:
             # A peer that missed a DDL broadcast answers "not found": push
             # it the schema and retry once (ADVICE r1: pull schema on
-            # NotFound instead of failing until anti-entropy).
-            if "not found" not in str(e):
+            # NotFound instead of failing until anti-entropy). At most one
+            # repair attempt per (node, index): a genuinely nonexistent
+            # field otherwise costs a schema push + duplicate remote
+            # execution on EVERY query (ADVICE r2).
+            repair_key = (node.id, index)
+            last = self._repair_attempted.get(repair_key)
+            throttled = (
+                last is not None
+                and time.monotonic() - last < self.repair_retry_interval
+            )
+            if "not found" not in str(e) or throttled:
                 raise
+            self._repair_attempted[repair_key] = time.monotonic()
             self._push_state_to(node, index)
             out = self.client.query_node(
                 node, index, c.to_string(), shards=shards, remote=True
             )
+            # The retry succeeded: the peer genuinely lacked schema and is
+            # now repaired. Forget the attempt so a FUTURE missed DDL on
+            # the same index can be repaired too; only the
+            # genuinely-nonexistent-field case stays throttled.
+            self._repair_attempted.pop(repair_key, None)
         results = out.get("results", [])
         raw = results[0] if results else None
         return decode_result(c, raw)
@@ -418,6 +442,7 @@ class Cluster:
                     (Node.from_json(d) for d in msg["nodes"]), key=lambda n: n.id
                 )
                 self.topology.nodes = new_nodes
+                self._repair_attempted.clear()
                 # Keep the local node's identity object in sync (it may
                 # have just become or stopped being a member/coordinator).
                 mine = next((n for n in new_nodes if n.id == self.local_node.id), None)

@@ -44,15 +44,22 @@ class Resizer:
     """Owns resize jobs on the coordinator and instruction-following on
     every node. Installed via cluster.attach_resizer()."""
 
+    #: Coordinator-side auto-abort: a job whose completions don't all
+    #: arrive within this window rolls back instead of wedging the
+    #: cluster in RESIZING (ADVICE r2: no manual-abort-only escape).
+    job_timeout: float = 600.0
+
     def __init__(self, cluster, logger=None):
         self.cluster = cluster
         self.log = logger or NopLogger()
         self._lock = threading.RLock()
         self._job_id = 0
         # Coordinator-side live job state.
+        self._active_job: Optional[int] = None
         self._pending_nodes: set[str] = set()
         self._new_nodes: Optional[list[Node]] = None
         self._notify_nodes: list[Node] = []
+        self._timer: Optional[threading.Timer] = None
         # Set on every node while it should clean after the topology flips.
         self._needs_clean = False
         cluster.resizer = self
@@ -106,6 +113,7 @@ class Resizer:
         )
         self._job_id += 1
         job = self._job_id
+        self._active_job = job
         self._new_nodes = new_topo.nodes
         instructions = self._build_instructions(old_topo, new_topo, removed)
         self._pending_nodes = {n.id for n in new_topo.nodes}
@@ -115,28 +123,97 @@ class Resizer:
         notify.update({n.id: n for n in new_topo.nodes})
         self._notify_nodes = list(notify.values())
 
-        # Freeze writes cluster-wide while fragments move.
-        self.cluster.set_state(STATE_RESIZING)
-        self.cluster.broadcaster.send_sync(
-            Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
-        )
-        schema = {"indexes": self.cluster.holder.schema()} if self.cluster.holder else {}
-        available = self._available_map()
-        for node in new_topo.nodes:
-            msg = Message.make(
-                bc.MSG_RESIZE_INSTRUCTION,
-                job=job,
-                node=node.id,
-                coordinator=self.cluster.local_node.to_json(),
-                sources=instructions.get(node.id, []),
-                schema=schema,
-                available=available,
-            )
-            if node.id == self.cluster.local_node.id:
-                self.follow_instruction(msg)
-            else:
-                self.cluster.broadcaster.send_to(node, msg)
+        # Anything failing past this point (state broadcast, instruction
+        # delivery, local follow) must roll back rather than leave the
+        # cluster frozen in RESIZING with a half-armed job.
+        try:
+            # Freeze writes cluster-wide while fragments move. The freeze
+            # is a safety invariant for every node that SURVIVES into the
+            # new topology (a survivor that keeps accepting writes while
+            # its fragments copy would silently lose them at the flip), so
+            # delivery to survivors is fail-fast; a node being removed is
+            # best-effort — it is usually being removed precisely because
+            # it is dead, and its post-freeze writes are lost by design
+            # (the reference leaves removed-node data dirs behind too).
+            self.cluster.set_state(STATE_RESIZING)
+            freeze = Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
+            new_ids = {n.id for n in new_topo.nodes}
+            for node in self._notify_nodes:
+                if node.id == self.cluster.local_node.id:
+                    continue
+                try:
+                    self.cluster.broadcaster.send_to(node, freeze)
+                except Exception as e:
+                    if node.id in new_ids:
+                        raise ResizeError(
+                            f"freeze broadcast to {node.id} failed: {e}"
+                        ) from e
+                    self.log.printf(
+                        "resize: freeze to leaving node %s failed: %s", node.id, e
+                    )
+            schema = {"indexes": self.cluster.holder.schema()} if self.cluster.holder else {}
+            available = self._available_map()
+            for node in new_topo.nodes:
+                msg = Message.make(
+                    bc.MSG_RESIZE_INSTRUCTION,
+                    job=job,
+                    node=node.id,
+                    coordinator=self.cluster.local_node.to_json(),
+                    sources=instructions.get(node.id, []),
+                    schema=schema,
+                    available=available,
+                )
+                if node.id == self.cluster.local_node.id:
+                    self.follow_instruction(msg)
+                else:
+                    try:
+                        self.cluster.broadcaster.send_to(node, msg)
+                    except Exception as e:
+                        # An unreachable node would wedge the job in
+                        # RESIZING forever; roll back instead.
+                        raise ResizeError(
+                            f"instruction delivery to {node.id} failed: {e}"
+                        ) from e
+        except Exception as e:
+            self.log.printf("resize: job %d failed to start: %s", job, e)
+            self.abort()
+            raise
+        self._arm_timeout(job)
         return job
+
+    def _broadcast_best_effort(self, msg: Message, nodes=None) -> None:
+        """Deliver to the given nodes (default: current topology), logging
+        failures instead of raising: a dead peer must not stop state
+        transitions from reaching the survivors (code review r3:
+        fail-fast send_sync left reachable nodes frozen in RESIZING)."""
+        for node in (nodes if nodes is not None else self.cluster.topology.nodes):
+            if node.id == self.cluster.local_node.id:
+                continue
+            try:
+                self.cluster.broadcaster.send_to(node, msg)
+            except Exception as e:
+                self.log.printf("resize: broadcast to %s failed: %s", node.id, e)
+
+    def _arm_timeout(self, job: int) -> None:
+        t = threading.Timer(self.job_timeout, self._timeout_job, args=(job,))
+        t.daemon = True
+        with self._lock:
+            self._timer = t
+        t.start()
+
+    def _timeout_job(self, job: int) -> None:
+        with self._lock:
+            if self._active_job != job or self._new_nodes is None:
+                return  # completed or already aborted
+            pending = sorted(self._pending_nodes)
+        self.log.printf(
+            "resize job %d timed out after %.0fs waiting on %s: aborting",
+            job, self.job_timeout, pending,
+        )
+        # only_job guards the race where the final completion lands
+        # between the check above and the abort: aborting a job that
+        # already finished would re-freeze the NEW topology.
+        self.abort(only_job=job)
 
     def _available_map(self) -> dict:
         """index -> field -> cluster-wide available shards (the joiner must
@@ -208,7 +285,34 @@ class Resizer:
     def follow_instruction(self, msg: Message) -> None:
         """Fetch assigned fragments, then report completion. Runs inline —
         callers that need async wrap it in a thread (the HTTP receive path
-        does, so the coordinator isn't blocked on its own broadcast)."""
+        does, so the coordinator isn't blocked on its own broadcast).
+
+        Completion is reported even when the fetch fails part-way (with an
+        'error' field): a silent dead thread would wedge the whole cluster
+        in RESIZING (ADVICE r2); incomplete data heals via anti-entropy.
+        """
+        err = None
+        try:
+            self._follow_instruction_inner(msg)
+        except Exception as e:  # noqa: BLE001 — any failure must still report
+            err = str(e)
+            self.log.printf("resize: follow_instruction failed: %s", e)
+        coord = Node.from_json(msg["coordinator"])
+        done = Message.make(
+            bc.MSG_RESIZE_COMPLETE,
+            job=msg.get("job"),
+            node=self.cluster.local_node.id,
+            **({"error": err} if err else {}),
+        )
+        if coord.id == self.cluster.local_node.id:
+            self.mark_complete(done)
+        else:
+            try:
+                self.cluster.broadcaster.send_to(coord, done)
+            except Exception as e:
+                self.log.printf("resize: completion report failed: %s", e)
+
+    def _follow_instruction_inner(self, msg: Message) -> None:
         # A joining node first needs the schema the cluster already has.
         if self.cluster.api is not None and msg.get("schema"):
             self.cluster.api.apply_schema(msg["schema"])
@@ -249,25 +353,32 @@ class Resizer:
                 f.import_roaring(shard, data, view_name=view_name)
             f.add_available_shard(shard)
         self._needs_clean = True
-        coord = Node.from_json(msg["coordinator"])
-        done = Message.make(
-            bc.MSG_RESIZE_COMPLETE, job=msg.get("job"), node=self.cluster.local_node.id
-        )
-        if coord.id == self.cluster.local_node.id:
-            self.mark_complete(done)
-        else:
-            self.cluster.broadcaster.send_to(coord, done)
 
     # -- coordinator: completion tracking (reference cluster.go:1413) ------
 
     def mark_complete(self, msg: Message) -> None:
         with self._lock:
+            if msg.get("job") != self._active_job:
+                # Stale COMPLETE from an aborted/earlier job must not
+                # satisfy a later job's pending set (ADVICE r2): flipping
+                # topology before copies finish silently loses data.
+                return
+            if msg.get("error"):
+                self.log.printf(
+                    "resize: node %s completed with error: %s",
+                    msg.get("node"), msg.get("error"),
+                )
             self._pending_nodes.discard(msg.get("node"))
             if self._pending_nodes or self._new_nodes is None:
                 return
             new_nodes = self._new_nodes
             notify = self._notify_nodes
+            self._notify_nodes = []
             self._new_nodes = None
+            self._active_job = None
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
         # Flip the whole cluster to the new topology atomically via one
         # status broadcast; receivers clean unowned fragments. Recipients
         # are old∪new members (send_sync would miss the joiner/leaver
@@ -286,17 +397,34 @@ class Resizer:
                     self.log.printf("resize: status to %s failed: %s", node.id, e)
         self.log.printf("resize complete: %d nodes", len(new_nodes))
 
-    def abort(self) -> None:
-        """Roll back to NORMAL on the old topology (reference api.go:1250)."""
+    def abort(self, only_job: Optional[int] = None) -> None:
+        """Roll back to NORMAL on the old topology (reference api.go:1250).
+        only_job: abort only if that job is still active (timeout path)."""
         with self._lock:
+            if only_job is not None and self._active_job != only_job:
+                return  # job completed/was replaced while we decided
             self._pending_nodes = set()
             self._new_nodes = None
+            self._active_job = None
             self._needs_clean = False
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            # old∪new membership: a joiner that already received its
+            # instruction must learn the job died, even though it is not
+            # in topology.nodes yet (same reason mark_complete notifies
+            # this set).
+            notify = {n.id: n for n in self.cluster.topology.nodes}
+            notify.update({n.id: n for n in self._notify_nodes})
+            self._notify_nodes = []
         self.cluster.set_state(STATE_NORMAL)
         if self.cluster.is_coordinator():
-            self.cluster.broadcaster.send_sync(Message.make(bc.MSG_RESIZE_ABORT))
-            self.cluster.broadcaster.send_sync(
-                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_NORMAL)
+            # Best-effort delivery: a dead peer (often the very reason for
+            # the abort) must not stop survivors from unfreezing.
+            targets = list(notify.values())
+            self._broadcast_best_effort(Message.make(bc.MSG_RESIZE_ABORT), targets)
+            self._broadcast_best_effort(
+                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_NORMAL), targets
             )
 
     # -- every node: post-resize cleanup (reference holder.go:1104) --------

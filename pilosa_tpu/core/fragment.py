@@ -279,8 +279,9 @@ class Fragment:
     def columns(self) -> Row:
         """Union of all rows as absolute columns (used by existence checks)."""
         out = Bitmap()
-        for row_id in self.row_ids():
-            out.union_in_place(self._row_bitmap(row_id))
+        with self.lock:  # _row_bitmap mutates the LRU row cache
+            for row_id in self.row_ids():
+                out.union_in_place(self._row_bitmap(row_id))
         return Row.from_segment(self.shard, out)
 
     def for_each_bit(self, fn: Callable[[int, int], None]) -> None:

@@ -37,6 +37,7 @@ large to ever fit fall back to the CPU oracle (SURVEY.md §7 hard part c).
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -89,6 +90,14 @@ class _StackedBlocks:
         self.max_bytes = max_bytes
         self._entries: dict[tuple, tuple[tuple, object, int]] = {}
         self.evictions = 0
+        # Queries are served concurrently (ThreadingHTTPServer); the LRU
+        # touch/evict mutate on reads, so all access goes under one lock
+        # (ADVICE r2: dict-changed-size races surfaced as 500s).
+        self._lock = threading.RLock()
+        # Per-key build latch: concurrent misses for the same stack must
+        # not pack+upload it twice (duplicate HBM residency could blow the
+        # byte budget); losers wait for the winner's entry.
+        self._building: dict[tuple, threading.Event] = {}
 
     def _pad_shards(self, n: int) -> int:
         if self.mesh is None or self.mesh.n <= 1:
@@ -124,26 +133,40 @@ class _StackedBlocks:
         # Keyed by (index, field, view) only: a changed shard set REPLACES
         # the cached stack rather than accumulating per-subset copies in HBM.
         key = (index, field_obj.name, view_name)
-        cached = self._entries.get(key)
-        if cached is not None and cached[0] == fingerprint:
-            # LRU touch.
-            self._entries[key] = self._entries.pop(key)
-            return cached[1], cached[2]
-        nbytes = s_pad * rows_p * WORDS_PER_SHARD * 4
-        if self.max_bytes is not None and nbytes > self.max_bytes:
-            # Stack can never be resident under the budget: the caller
-            # falls back to the CPU oracle instead of blowing HBM.
-            return None, rows_p
-        host = np.zeros((s_pad, rows_p, WORDS_PER_SHARD), dtype=np.uint32)
-        for i, s in enumerate(shards):
-            fr = frags[s]
-            if fr is not None:
-                host[i] = pack_fragment(fr, n_rows=rows_p)
-        arr = self._put(host)
-        self._entries.pop(key, None)
-        self._entries[key] = (fingerprint, arr, rows_p)
-        self._evict(keep=key)
-        return arr, rows_p
+        while True:
+            with self._lock:
+                cached = self._entries.get(key)
+                if cached is not None and cached[0] == fingerprint:
+                    # LRU touch.
+                    self._entries[key] = self._entries.pop(key)
+                    return cached[1], cached[2]
+                latch = self._building.get(key)
+                if latch is None:
+                    self._building[key] = threading.Event()
+                    break
+            # Another thread is packing this stack: wait, then re-check —
+            # its fingerprint usually matches ours (same live fragments).
+            latch.wait()
+        try:
+            nbytes = s_pad * rows_p * WORDS_PER_SHARD * 4
+            if self.max_bytes is not None and nbytes > self.max_bytes:
+                # Stack can never be resident under the budget: the caller
+                # falls back to the CPU oracle instead of blowing HBM.
+                return None, rows_p
+            host = np.zeros((s_pad, rows_p, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, s in enumerate(shards):
+                fr = frags[s]
+                if fr is not None:
+                    host[i] = pack_fragment(fr, n_rows=rows_p)
+            arr = self._put(host)
+            with self._lock:
+                self._entries.pop(key, None)
+                self._entries[key] = (fingerprint, arr, rows_p)
+                self._evict(keep=key)
+            return arr, rows_p
+        finally:
+            with self._lock:
+                self._building.pop(key).set()
 
     def _evict(self, keep: tuple) -> None:
         if self.max_bytes is None:
@@ -154,10 +177,12 @@ class _StackedBlocks:
             self.evictions += 1
 
     def resident_bytes(self) -> int:
-        return sum(int(np.prod(e[1].shape)) * 4 for e in self._entries.values())
+        with self._lock:
+            return sum(int(np.prod(e[1].shape)) * 4 for e in self._entries.values())
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -379,6 +404,7 @@ class TPUBackend:
         self.mesh = mesh if (mesh is not None and mesh.n > 1) else None
         self.blocks = _StackedBlocks(device, self.mesh, max_bytes)
         self._fns: dict = {}
+        self._fns_lock = threading.RLock()
 
     # -- spec + leaf assembly ---------------------------------------------
 
@@ -597,7 +623,8 @@ class TPUBackend:
         """One compiled program per (kind, tree-shape, reduction mode);
         the spec tree fixes the leaf count, so it alone keys the shape."""
         key = (kind, spec, reduce_dev, extra)
-        fn = self._fns.get(key)
+        with self._fns_lock:
+            fn = self._fns.get(key)
         if fn is not None:
             return fn
 
@@ -769,7 +796,8 @@ class TPUBackend:
         else:
             raise ValueError(kind)
 
-        self._fns[key] = fn
+        with self._fns_lock:
+            fn = self._fns.setdefault(key, fn)
         return fn
 
     # -- backend interface -------------------------------------------------

@@ -187,6 +187,94 @@ class TestResize:
             with pytest.raises(ResizeError):
                 c.nodes[0].cluster.resizer.add_node(c.nodes[1].node)
 
+    def test_stale_complete_ignored(self):
+        """A MSG_RESIZE_COMPLETE carrying an old job id must not satisfy a
+        later job's pending set (ADVICE r2: premature NORMAL flip routes
+        queries to nodes missing data)."""
+        from pilosa_tpu.cluster.broadcast import MSG_RESIZE_COMPLETE, Message
+
+        with TestCluster(2) as c:
+            rz = c.nodes[0].cluster.resizer
+            rz._active_job = 7
+            rz._pending_nodes = {"node0", "node1"}
+            rz._new_nodes = list(c.nodes[0].cluster.topology.nodes)
+            rz._notify_nodes = []
+            # Stale completes (old job / aborted job) are ignored.
+            rz.mark_complete(Message.make(MSG_RESIZE_COMPLETE, job=6, node="node0"))
+            rz.mark_complete(Message.make(MSG_RESIZE_COMPLETE, job=None, node="node1"))
+            assert rz._pending_nodes == {"node0", "node1"}
+            # Matching completes drain the set and finish the job.
+            rz.mark_complete(Message.make(MSG_RESIZE_COMPLETE, job=7, node="node0"))
+            rz.mark_complete(Message.make(MSG_RESIZE_COMPLETE, job=7, node="node1"))
+            assert rz._new_nodes is None and rz._active_job is None
+
+    def test_failed_follow_still_completes(self):
+        """A node whose instruction-following blows up mid-fetch must still
+        report completion (with error) so the cluster leaves RESIZING
+        (ADVICE r2: bare daemon thread death wedged the cluster)."""
+        with TestCluster(2) as c:
+            self._populate(c)
+            cn = c.spawn_node()
+            # Sabotage the joiner: schema application explodes.
+            def boom(schema):
+                raise RuntimeError("injected schema failure")
+
+            cn.api.apply_schema = boom
+            c.nodes[0].cluster.resizer.add_node(
+                type(cn.node)(cn.node.id, cn.node.uri, False)
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if all(x.cluster.state() == "NORMAL" for x in c.nodes):
+                    break
+                time.sleep(0.02)
+            else:
+                states = [(x.node.id, x.cluster.state()) for x in c.nodes]
+                raise TimeoutError(f"cluster wedged in RESIZING: {states}")
+
+    def test_unreachable_node_aborts_job(self):
+        """Instruction delivery failure rolls the cluster back to NORMAL
+        instead of freezing writes forever."""
+        from pilosa_tpu.cluster.resize import ResizeError
+        from pilosa_tpu.cluster.topology import Node, URI
+
+        with TestCluster(2) as c:
+            dead = Node("ghost", URI(scheme="http", host="127.0.0.1", port=1), False)
+            with pytest.raises(ResizeError):
+                c.nodes[0].cluster.resizer.add_node(dead)
+            time.sleep(0.2)
+            assert c.nodes[0].cluster.state() == "NORMAL"
+            assert c.nodes[1].cluster.state() == "NORMAL"
+            # The failed job must not block a later, healthy one.
+            cn = c.add_node_via_resize()
+            assert len(cn.cluster.topology.nodes) == 3
+
+    def test_job_timeout_auto_aborts(self):
+        """A job whose completions never arrive aborts itself."""
+        with TestCluster(2) as c:
+            rz = c.nodes[0].cluster.resizer
+            rz.job_timeout = 0.3
+            cn = c.spawn_node()
+            # Deliver instructions into the void: the joiner never acts.
+            orig_send = c.nodes[0].cluster.broadcaster.send_to
+            from pilosa_tpu.cluster import broadcast as bc
+
+            def drop_instructions(node, msg):
+                if msg.get("type") == bc.MSG_RESIZE_INSTRUCTION:
+                    return  # "delivered", never followed
+                return orig_send(node, msg)
+
+            c.nodes[0].cluster.broadcaster.send_to = drop_instructions
+            rz.add_node(type(cn.node)(cn.node.id, cn.node.uri, False))
+            assert c.nodes[0].cluster.state() == "RESIZING"
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if c.nodes[0].cluster.state() == "NORMAL" and rz._active_job is None:
+                    break
+                time.sleep(0.02)
+            else:
+                raise TimeoutError("job timeout never fired")
+
 
 class TestFailureDetection:
     def test_down_node_marked_and_queries_survive(self):
