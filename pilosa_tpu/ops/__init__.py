@@ -1,16 +1,13 @@
-"""TPU device ops: dense bitmap blocks in HBM + XLA/Pallas kernels.
+"""TPU device ops: dense bitmap blocks in HBM + the Pallas batch kernel.
 
 This is the execution layer BASELINE.json's north star describes: each
 fragment's roaring containers are flattened into a dense
 uint32[rows, SHARD_WIDTH/32] block resident in HBM; PQL bitmap verbs
 lower to bitwise ops and Count/TopN/Sum to popcount reductions, fused by
-XLA (with Pallas variants for the hot paths). Blocks are cached on device
-and re-uploaded only when the owning fragment's version changes.
+XLA, with the pair_stats Pallas kernel sweeping batched 2-row counts at
+HBM roofline. Blocks are cached on device and re-uploaded only when the
+owning fragment's version changes.
 """
 
-from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, BlockCache, pack_fragment
-from pilosa_tpu.ops.kernels import (
-    and_popcount,
-    popcount_rows,
-    row_popcount_topk,
-)
+from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, pack_fragment
+from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats, pair_stats_xla

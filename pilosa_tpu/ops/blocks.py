@@ -31,6 +31,21 @@ def _padded_rows(n_rows: int) -> int:
     return max(((n_rows + ROW_PAD - 1) // ROW_PAD) * ROW_PAD, ROW_PAD)
 
 
+def _scatter_container(row_words: np.ndarray, cidx: int, c) -> None:
+    """OR one roaring container into a row's word vector at container
+    slot cidx (dense containers memcpy; array containers scatter bits)."""
+    base = cidx * _WORDS_PER_CONTAINER
+    if c.typ == "bitmap":
+        row_words[base : base + _WORDS_PER_CONTAINER] = c.data.view("<u4")
+    else:
+        pos = c.data.astype(np.uint32)
+        np.bitwise_or.at(
+            row_words,
+            base + (pos >> 5),
+            np.uint32(1) << (pos & np.uint32(31)),
+        )
+
+
 def pack_fragment(frag, n_rows: Optional[int] = None) -> np.ndarray:
     """Flatten a fragment's roaring storage into uint32[rows_p, WORDS].
 
@@ -49,17 +64,7 @@ def pack_fragment(frag, n_rows: Optional[int] = None) -> np.ndarray:
         row = key // _CONTAINERS_PER_ROW
         if row >= rows_p:
             continue  # caller asked for fewer rows than stored
-        cidx = key % _CONTAINERS_PER_ROW
-        base = cidx * _WORDS_PER_CONTAINER
-        if c.typ == "bitmap":
-            arr[row, base : base + _WORDS_PER_CONTAINER] = c.data.view("<u4")
-        else:
-            pos = c.data.astype(np.uint32)
-            np.bitwise_or.at(
-                arr[row],
-                base + (pos >> 5),
-                np.uint32(1) << (pos & np.uint32(31)),
-            )
+        _scatter_container(arr[row], key % _CONTAINERS_PER_ROW, c)
     return arr
 
 
@@ -69,52 +74,16 @@ def unpack_row(words: np.ndarray) -> np.ndarray:
     return np.nonzero(bits)[0].astype(np.uint64)
 
 
-class BlockCache:
-    """Fragment -> device-resident dense block, invalidated by version.
-
-    The write path stays host-roaring (reference fragment mutation
-    semantics); queries lazily (re)upload blocks whose fragment.version
-    changed — the device-residency policy described in SURVEY.md §7 step 5.
-    A whole-block re-upload on any mutation is the v1 policy; dirty
-    container-range tracking is the planned refinement.
-    """
-
-    def __init__(self, device=None):
-        import jax
-
-        self.device = device
-        self._jax = jax
-        self._entries: dict[int, tuple[int, int, object]] = {}  # id(frag) -> (version, rows, array)
-
-    def block(self, frag, n_rows: Optional[int] = None):
-        """Device block for a fragment, shape uint32[rows_p, WORDS]."""
-        key = frag.uid  # process-unique, never reused (unlike id())
-        want_rows = _padded_rows(n_rows if n_rows is not None else frag.max_row_id + 1)
-        entry = self._entries.get(key)
-        if entry is not None:
-            version, rows, arr = entry
-            if version == frag.version and rows >= want_rows:
-                return arr
-        host = pack_fragment(frag, n_rows=want_rows)
-        arr = self._jax.device_put(host, self.device)
-        self._entries[key] = (frag.version, host.shape[0], arr)
-        return arr
-
-    def row_vector(self, frag, row_id: int):
-        """One row as a device uint32[WORDS] vector."""
-        block = self.block(frag)
-        if row_id >= block.shape[0]:
-            # Row beyond the packed block: empty.
-            import jax.numpy as jnp
-
-            return jnp.zeros((WORDS_PER_SHARD,), dtype=jnp.uint32)
-        return block[row_id]
-
-    def invalidate(self, frag) -> None:
-        self._entries.pop(frag.uid, None)
-
-    def clear(self) -> None:
-        self._entries.clear()
-
-    def resident_bytes(self) -> int:
-        return sum(rows * WORDS_PER_SHARD * 4 for _, rows, _ in self._entries.values())
+def pack_row(frag, row_id: int) -> np.ndarray:
+    """One row of a fragment as uint32[WORDS] (the row-paging unit: a
+    stack too tall for the HBM budget is served row-by-row instead of
+    falling back to the CPU oracle — SURVEY.md §7 hard part (c))."""
+    out = np.zeros(WORDS_PER_SHARD, dtype=np.uint32)
+    storage = frag.storage
+    base_key = row_id * _CONTAINERS_PER_ROW
+    for cidx in range(_CONTAINERS_PER_ROW):
+        c = storage.container(base_key + cidx)
+        if c is None or c.n == 0:
+            continue
+        _scatter_container(out, cidx, c)
+    return out

@@ -1,11 +1,13 @@
 """Device-mesh execution of shard-parallel queries.
 
-A ShardMesh owns a 1-D jax Mesh over the 'shards' axis. Query-side arrays
-are stacked [n_shards, ...] and placed with NamedSharding(P('shards')),
-so each device holds its shards' blocks in local HBM; shard_map-ed
-kernels compute per-device partials and psum/all_gather them over ICI —
-the XLA-collective replacement for the reference's HTTP scatter-gather
-(SURVEY.md §2.2).
+A ShardMesh owns a 1-D jax Mesh over the 'shards' axis. The TPU backend
+stacks query-side arrays [n_shards, ...] and places them with
+NamedSharding(P('shards')), so each device holds its shards' blocks in
+local HBM; shard_map-ed programs compute per-device partials and
+psum/all_gather them over ICI — the XLA-collective replacement for the
+reference's HTTP scatter-gather (SURVEY.md §2.2). The programs
+themselves live in exec/tpu.py (TPUBackend._program/_pair_program);
+this class is the topology object they build against.
 """
 
 from __future__ import annotations
@@ -15,8 +17,6 @@ from typing import Optional, Sequence
 import numpy as np
 
 import jax
-import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -30,104 +30,9 @@ class ShardMesh:
         self.n = len(self.devices)
         self._sharding = NamedSharding(self.mesh, P(axis))
 
-        axis_name = axis
-
-        @jax.jit
-        def _count_and(a, b):
-            # a, b: uint32[S, W] sharded over 'shards'. AND+popcount locally,
-            # psum partials over ICI -> replicated scalar.
-            def kernel(a_blk, b_blk):
-                part = jnp.sum(
-                    jax.lax.population_count(a_blk & b_blk), dtype=jnp.uint32
-                )
-                return jax.lax.psum(part, axis_name)
-
-            return shard_map(
-                kernel,
-                mesh=self.mesh,
-                in_specs=(P(axis_name, None), P(axis_name, None)),
-                out_specs=P(),
-            )(a, b)
-
-        self._count_and = _count_and
-
-        @jax.jit
-        def _topn_counts(blocks):
-            # blocks: uint32[S, R, W] sharded over 'shards'. Per-row
-            # popcount locally, psum row-count vectors over ICI.
-            def kernel(blk):
-                per_row = jnp.sum(
-                    jax.lax.population_count(blk), axis=(0, 2), dtype=jnp.uint32
-                )
-                return jax.lax.psum(per_row, axis_name)
-
-            return shard_map(
-                kernel,
-                mesh=self.mesh,
-                in_specs=(P(axis_name, None, None),),
-                out_specs=P(),
-            )(blocks)
-
-        self._topn_counts = _topn_counts
-
-        @jax.jit
-        def _bsi_sum(planes, exists, sign):
-            # planes: uint32[S, D, W]; exists/sign: uint32[S, W], all
-            # sharded over 'shards'. Per-plane popcounts psum'd over ICI;
-            # final weighting on host in exact ints.
-            def kernel(planes_blk, exists_blk, sign_blk):
-                consider = exists_blk
-                neg = sign_blk & consider
-                pos = consider & ~neg
-                pos_c = jnp.sum(
-                    jax.lax.population_count(planes_blk & pos[:, None, :]),
-                    axis=(0, 2),
-                    dtype=jnp.uint32,
-                )
-                neg_c = jnp.sum(
-                    jax.lax.population_count(planes_blk & neg[:, None, :]),
-                    axis=(0, 2),
-                    dtype=jnp.uint32,
-                )
-                cnt = jnp.sum(jax.lax.population_count(consider), dtype=jnp.uint32)
-                return (
-                    jax.lax.psum(pos_c, axis_name),
-                    jax.lax.psum(neg_c, axis_name),
-                    jax.lax.psum(cnt, axis_name),
-                )
-
-            return shard_map(
-                kernel,
-                mesh=self.mesh,
-                in_specs=(P(axis_name, None, None), P(axis_name, None), P(axis_name, None)),
-                out_specs=(P(), P(), P()),
-            )(planes, exists, sign)
-
-        self._bsi_sum = _bsi_sum
-
-    # -- public API -------------------------------------------------------
-
     def put(self, host_array: np.ndarray):
         """Place a [n_shards, ...] stacked array sharded over the mesh."""
         assert host_array.shape[0] % self.n == 0, (
             f"leading dim {host_array.shape[0]} not divisible by {self.n} devices"
         )
         return jax.device_put(host_array, self._sharding)
-
-    def count_intersect(self, a, b) -> int:
-        """Count(Intersect(a, b)) across the mesh: AND+popcount per device,
-        psum over ICI."""
-        return int(self._count_and(a, b))
-
-    def topn_counts(self, blocks) -> np.ndarray:
-        """Exact per-row counts across all shards: [S, R, W] -> [R]."""
-        return np.asarray(self._topn_counts(blocks))
-
-    def bsi_sum(self, planes, exists, sign) -> tuple[int, int]:
-        """Distributed BSI sum -> (sum, count), weighting on host."""
-        pos_c, neg_c, cnt = self._bsi_sum(planes, exists, sign)
-        pos_c, neg_c = np.asarray(pos_c), np.asarray(neg_c)
-        total = sum((int(pos_c[i]) - int(neg_c[i])) << i for i in range(pos_c.size))
-        # note: pos-neg per plane then weight — matches reference
-        # fragment.sum's psum-nsum squashing (fragment.go:1131-1139).
-        return total, int(cnt)

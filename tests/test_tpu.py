@@ -12,8 +12,8 @@ from pilosa_tpu.core.field import options_for_int
 from pilosa_tpu.exec import Executor
 from pilosa_tpu.exec.result import result_to_json
 from pilosa_tpu.exec.tpu import TPUBackend
-from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, BlockCache, pack_fragment, unpack_row
-from pilosa_tpu.ops.kernels import and_popcount, popcount_rows
+from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, pack_fragment, pack_row, unpack_row
+from pilosa_tpu.ops.kernels import pair_stats, pair_stats_xla
 from pilosa_tpu.parallel import ShardMesh
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -43,31 +43,40 @@ class TestBlockPacking:
         block = pack_fragment(f)
         np.testing.assert_array_equal(unpack_row(block[0]), cols)
 
-    def test_cache_invalidation(self):
+    def test_pack_row_matches_pack_fragment(self, rng):
         f = Fragment(None, "i", "f", "standard", 0)
-        f.set_bit(0, 1)
-        cache = BlockCache()
-        b1 = cache.block(f)
-        assert np.asarray(b1)[0, 0] == 2  # bit 1
-        f.set_bit(0, 2)  # version bump
-        b2 = cache.block(f)
-        assert np.asarray(b2)[0, 0] == 6  # bits 1,2
-        assert cache.resident_bytes() > 0
+        cols = np.unique(rng.integers(0, SHARD_WIDTH, 5000, dtype=np.uint64))
+        f.bulk_import(np.full(cols.size, 2, dtype=np.uint64), cols)
+        block = pack_fragment(f)
+        np.testing.assert_array_equal(pack_row(f, 2), block[2])
+        np.testing.assert_array_equal(pack_row(f, 0), np.zeros(WORDS_PER_SHARD, np.uint32))
 
 
-class TestKernels:
-    def test_and_popcount_matches_numpy(self, rng):
-        a = rng.integers(0, 2**32, WORDS_PER_SHARD, dtype=np.uint32)
-        b = rng.integers(0, 2**32, WORDS_PER_SHARD, dtype=np.uint32)
-        got = int(and_popcount(a, b))
-        want = int(np.bitwise_count(a & b).sum())
-        assert got == want
+class TestPairStatsKernel:
+    """The batched-count Pallas kernel (interpret mode on CPU) must match
+    both the fused-XLA formulation and a numpy oracle."""
 
-    def test_popcount_rows(self, rng):
-        block = rng.integers(0, 2**32, (8, WORDS_PER_SHARD), dtype=np.uint32)
-        got = np.asarray(popcount_rows(block))
-        want = np.bitwise_count(block).sum(axis=1)
-        np.testing.assert_array_equal(got, want)
+    def test_pair_stats_matches_numpy(self, rng):
+        S, RF, RG, W = 3, 8, 16, 512
+        f = rng.integers(0, 2**32, (S, RF, W), dtype=np.uint32)
+        g = rng.integers(0, 2**32, (S, RG, W), dtype=np.uint32)
+        pair, cf, cg = (np.asarray(x) for x in pair_stats(f, g, interpret=True))
+        want_pair = np.zeros((RF, RG), dtype=np.int64)
+        for a in range(RF):
+            for b in range(RG):
+                want_pair[a, b] = np.bitwise_count(f[:, a] & g[:, b]).sum()
+        np.testing.assert_array_equal(pair, want_pair)
+        np.testing.assert_array_equal(cf, np.bitwise_count(f).sum(axis=(0, 2)))
+        np.testing.assert_array_equal(cg, np.bitwise_count(g).sum(axis=(0, 2)))
+
+    def test_pair_stats_matches_xla(self, rng):
+        S, R, W = 5, 8, 256
+        f = rng.integers(0, 2**32, (S, R, W), dtype=np.uint32)
+        g = rng.integers(0, 2**32, (S, R, W), dtype=np.uint32)
+        got = pair_stats(f, g, interpret=True)
+        want = pair_stats_xla(f, g)
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 class TestTPUBackendDifferential:
@@ -259,39 +268,8 @@ class TestMeshExecutor:
 
 
 class TestShardMesh:
-    """Multi-chip execution on the virtual 8-device CPU mesh."""
-
     def test_mesh_has_8_devices(self):
         assert len(jax.devices()) == 8
-
-    def test_count_intersect_psum(self, rng):
-        mesh = ShardMesh()
-        S = mesh.n
-        a = rng.integers(0, 2**32, (S, WORDS_PER_SHARD), dtype=np.uint32)
-        b = rng.integers(0, 2**32, (S, WORDS_PER_SHARD), dtype=np.uint32)
-        da, db = mesh.put(a), mesh.put(b)
-        got = mesh.count_intersect(da, db)
-        want = int(np.bitwise_count(a & b).sum())
-        assert got == want
-
-    def test_topn_counts(self, rng):
-        mesh = ShardMesh()
-        S, R = mesh.n, 8
-        blocks = rng.integers(0, 2**32, (S, R, WORDS_PER_SHARD // 16), dtype=np.uint32)
-        got = mesh.topn_counts(mesh.put(blocks))
-        want = np.bitwise_count(blocks).sum(axis=(0, 2))
-        np.testing.assert_array_equal(got, want)
-
-    def test_bsi_sum(self, rng):
-        mesh = ShardMesh()
-        S, D, W = mesh.n, 4, WORDS_PER_SHARD // 64
-        planes = rng.integers(0, 2**32, (S, D, W), dtype=np.uint32)
-        exists = np.full((S, W), 0xFFFFFFFF, dtype=np.uint32)
-        sign = np.zeros((S, W), dtype=np.uint32)
-        total, cnt = mesh.bsi_sum(mesh.put(planes), mesh.put(exists), mesh.put(sign))
-        want = sum(int(np.bitwise_count(planes[:, i, :]).sum()) << i for i in range(D))
-        assert total == want
-        assert cnt == S * W * 32
 
 
 class TestCountBatch:
@@ -315,3 +293,104 @@ class TestCountBatch:
         singles = [be.count_shards("i", c, shards) for c in calls]
         assert batch == singles
         assert batch[3] == 0  # nonexistent row counts zero
+
+    def _setup(self, holder, rng):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        idx.create_field("v", options_for_int(-100, 100))
+        for row in [1, 2, 3]:
+            cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 3000, dtype=np.uint64))
+            idx.field("f").import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+        cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 3000, dtype=np.uint64))
+        idx.field("g").import_bits(np.full(cols.size, 9, dtype=np.uint64), cols)
+        cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 500, dtype=np.uint64))
+        idx.field("v").import_value(cols, rng.integers(-100, 101, cols.size))
+        return idx
+
+    def test_mixed_verbs_pair_path(self, holder, rng):
+        """All four verbs + single rows over one field pair derive from
+        one pair_stats sweep; results must match per-query execution."""
+        self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        qs = [
+            "Intersect(Row(f=1), Row(g=9))",
+            "Union(Row(f=2), Row(g=9))",
+            "Difference(Row(f=3), Row(g=9))",
+            "Xor(Row(f=1), Row(g=9))",
+            "Row(f=2)",
+            "Row(g=9)",
+            "Union(Row(f=99), Row(g=9))",  # missing row -> just |g|
+        ]
+        calls = [parse_string(q).calls[0] for q in qs]
+        shards = [0, 1]
+        assert be._pair_batch_plan("i", calls) is not None
+        batch = be.count_batch("i", calls, shards)
+        singles = [be.count_shards("i", c, shards) for c in calls]
+        assert batch == singles
+
+    def test_generic_path_groups_specs(self, holder, rng):
+        """Non-pair-able batches (BSI, Not) group by spec shape and still
+        match per-query execution."""
+        self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        qs = [
+            "Row(v > 10)",
+            "Row(v > -5)",
+            "Not(Row(f=1))",
+            "Intersect(Row(f=1), Row(v > 0))",
+        ]
+        calls = [parse_string(q).calls[0] for q in qs]
+        assert be._pair_batch_plan("i", calls) is None
+        shards = [0, 1]
+        batch = be.count_batch("i", calls, shards)
+        singles = [be.count_shards("i", c, shards) for c in calls]
+        assert batch == singles
+
+    def test_multi_count_query_through_executor(self, holder, rng):
+        """A multi-Count PQL request is served by one batched dispatch and
+        matches the CPU oracle call-for-call (the serving-batch surface)."""
+        self._setup(holder, rng)
+        q = (
+            "Count(Intersect(Row(f=1), Row(g=9)))"
+            "Count(Union(Row(f=2), Row(g=9)))"
+            "Count(Row(f=3))"
+            "Count(Xor(Row(f=1), Row(g=9)))"
+        )
+        want = Executor(holder).execute("i", q)
+        got = Executor(holder, backend=TPUBackend(holder)).execute("i", q)
+        assert got == want
+
+    def test_bitmap_call_shard_subset(self, holder, rng):
+        """Whole-query bitmap materialization honors shard subsets."""
+        self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        cpu = Executor(holder).backend
+        c = parse_string("Union(Row(f=1), Row(g=9))").calls[0]
+        for shards in ([0], [1], [0, 1]):
+            got = be.bitmap_call("i", c, shards)
+            want_cols = []
+            for s in shards:
+                want_cols.extend(cpu.bitmap_call_shard("i", c, s).columns().tolist())
+            np.testing.assert_array_equal(got.columns(), np.array(sorted(want_cols), dtype=np.uint64))
+
+    def test_count_batch_async_pipelines(self, holder, rng):
+        """Multiple batches in flight resolve to correct results."""
+        self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        shards = [0, 1]
+        pending = []
+        for r in [1, 2, 3]:
+            calls = [parse_string(f"Intersect(Row(f={r}), Row(g=9))").calls[0]]
+            pending.append((r, be.count_batch_async("i", calls, shards)))
+        for r, resolve in pending:
+            c = parse_string(f"Intersect(Row(f={r}), Row(g=9))").calls[0]
+            assert resolve() == [be.count_shards("i", c, shards)]
