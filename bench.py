@@ -30,10 +30,12 @@ BENCH_DENSITY (0.05), BENCH_BATCH (256), BENCH_SECONDS (10),
 BENCH_LATENCY_N (30).
 """
 
+import concurrent.futures
 import json
 import os
 import sys
 import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -41,6 +43,7 @@ import numpy as np
 
 from pilosa_tpu.core import Holder
 from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.batcher import CountBatcher
 from pilosa_tpu.exec.tpu import TPUBackend
 from pilosa_tpu.pql import parse_string
 from pilosa_tpu.shardwidth import SHARD_WIDTH
@@ -51,6 +54,8 @@ DENSITY = float(os.environ.get("BENCH_DENSITY", "0.05"))
 BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 SECONDS = float(os.environ.get("BENCH_SECONDS", "10"))
 LATENCY_N = int(os.environ.get("BENCH_LATENCY_N", "30"))
+HTTP_CLIENTS = int(os.environ.get("BENCH_HTTP_CLIENTS", "16"))
+HTTP_QUERIES_PER_REQ = int(os.environ.get("BENCH_HTTP_QUERIES_PER_REQ", "16"))
 
 WORDS = SHARD_WIDTH // 32
 
@@ -69,19 +74,33 @@ def build_index(h: Holder):
     return idx
 
 
-def bench_tpu(holder, queries) -> tuple[float, list[int]]:
+def bench_tpu(holder, queries) -> tuple[float, list[int], float, object]:
     be = TPUBackend(holder)
     shards = list(range(SHARDS))
     calls = [parse_string(q).calls[0].children[0] for q in queries]
     # warmup: compile + upload blocks
     first = be.count_batch("bench", calls[:BATCH], shards)
+
+    # Cold sweep latency: dispatch + single-readback resolve with the
+    # pair-stats cache emptied — what a batch costs after any write.
+    sweeps = []
+    for _ in range(5):
+        be._pair_cache.clear()
+        t0 = time.perf_counter()
+        be.count_batch("bench", calls[:BATCH], shards)
+        sweeps.append(time.perf_counter() - t0)
+    sweep_ms = sorted(sweeps)[len(sweeps) // 2] * 1e3
+
+    # Steady-state batched throughput through count_batch (stats cache
+    # warm — the read-heavy serving shape; writes invalidate by block
+    # identity and the next batch re-sweeps).
     n_done = 0
     t0 = time.time()
     while time.time() - t0 < SECONDS:
         be.count_batch("bench", calls[:BATCH], shards)
         n_done += BATCH
     dt = time.time() - t0
-    return n_done / dt, first, be
+    return n_done / dt, first, sweep_ms, be
 
 
 def bench_tpu_single(be, queries) -> tuple[float, float]:
@@ -109,6 +128,61 @@ def bench_topn(be) -> float:
         lat.append(time.perf_counter() - t0)
     lat.sort()
     return lat[len(lat) // 2]
+
+
+def bench_http(holder, be, queries) -> tuple[float, float]:
+    """Drive the REAL serving surface: POST /index/bench/query against an
+    in-process HTTP server whose executor has the device backend + the
+    cross-request micro-batcher — the exact path a client hits (VERDICT
+    r2 #2: the headline number must be reachable from the API).
+
+    HTTP_CLIENTS concurrent clients each send requests carrying
+    HTTP_QUERIES_PER_REQ Count calls; within a request the executor fuses
+    the run, and concurrent requests coalesce through the batcher into
+    shared pair-stats dispatches. Returns (qps, single-request p50)."""
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http import Server
+
+    ex = Executor(holder, backend=be)
+    ex.batcher = CountBatcher(be, window=0.004)
+    srv = Server(API(holder, ex), host="localhost", port=0).open()
+    url = f"http://localhost:{srv.port}/index/bench/query"
+
+    def post(body: str) -> list[int]:
+        r = urllib.request.Request(
+            url, data=body.encode(), headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(r) as resp:
+            return json.loads(resp.read())["results"]
+
+    per_req = HTTP_QUERIES_PER_REQ
+    bodies = ["".join(queries[i : i + per_req]) for i in range(0, len(queries), per_req)]
+    post(bodies[0])  # warm: compile + upload through the serving path
+
+    counters = [0] * HTTP_CLIENTS
+    deadline = time.time() + SECONDS
+
+    def client(k: int) -> None:
+        j = k
+        while time.time() < deadline:
+            post(bodies[j % len(bodies)])
+            counters[k] += per_req
+            j += 1
+
+    t0 = time.time()
+    with concurrent.futures.ThreadPoolExecutor(HTTP_CLIENTS) as pool:
+        list(pool.map(client, range(HTTP_CLIENTS)))
+    qps = sum(counters) / (time.time() - t0)
+
+    # Single-request latency through the full HTTP path (one Count).
+    lat = []
+    for q in queries[: max(5, LATENCY_N // 3)]:
+        t0 = time.perf_counter()
+        post(q)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    srv.close()
+    return qps, lat[len(lat) // 2]
 
 
 def bench_cpu(holder, parsed_queries) -> float:
@@ -140,9 +214,10 @@ def main():
     parsed = [parse_string(q) for q in queries]
 
     cpu_qps = bench_cpu(h, parsed)
-    tpu_qps, tpu_first, be = bench_tpu(h, queries)
+    tpu_qps, tpu_first, sweep_ms, be = bench_tpu(h, queries)
     p50, p99 = bench_tpu_single(be, queries)
     topn_p50 = bench_topn(be)
+    http_qps, http_p50 = bench_http(h, be, queries)
 
     # Correctness cross-check: TPU batch results must equal the CPU oracle.
     ex = Executor(h)
@@ -150,24 +225,32 @@ def main():
         want = ex.execute("bench", queries[i])[0]
         assert tpu_first[i] == want, (i, tpu_first[i], want)
 
-    # HBM roofline: bytes of row data each query's AND+popcount touches.
+    # HBM roofline: logical bytes each query's AND+popcount touches (2
+    # rows x shards x 128 KiB). The pair-stats kernel actually sweeps the
+    # two whole field stacks ONCE per batch, so the per-query physical
+    # traffic is sweep_bytes/BATCH — report both so the reuse is visible.
     bytes_per_query = 2 * SHARDS * WORDS * 4
+    sweep_bytes = 2 * SHARDS * ROWS * WORDS * 4
     hbm_gbps = tpu_qps * bytes_per_query / 1e9
 
     print(
         json.dumps(
             {
-                "metric": "intersect_count_qps",
-                "value": round(tpu_qps, 1),
+                "metric": "intersect_count_qps_http",
+                "value": round(http_qps, 1),
                 "unit": "queries/s",
-                "vs_baseline": round(tpu_qps / cpu_qps, 2) if cpu_qps else None,
+                "vs_baseline": round(http_qps / cpu_qps, 2) if cpu_qps else None,
                 "baseline": "numpy_oracle_cpu (NOT Go/roaring; see BASELINE.md)",
                 "baseline_qps": round(cpu_qps, 2),
+                "direct_batch_qps": round(tpu_qps, 1),
+                "cold_sweep_ms": round(sweep_ms, 2),
+                "http_single_p50_ms": round(http_p50 * 1e3, 2),
                 "single_query_p50_ms": round(p50 * 1e3, 2),
                 "single_query_p99_ms": round(p99 * 1e3, 2),
                 "topn_p50_ms": round(topn_p50 * 1e3, 2),
-                "hbm_read_gbps": round(hbm_gbps, 1),
-                "bytes_touched_per_query": bytes_per_query,
+                "hbm_read_gbps_direct": round(hbm_gbps, 1),
+                "bytes_touched_per_query_logical": bytes_per_query,
+                "bytes_touched_per_query_physical": sweep_bytes // BATCH,
                 "build_seconds": round(t_build, 1),
                 "config": {
                     "shards": SHARDS,

@@ -43,6 +43,10 @@ def cmd_server(args) -> int:
         except Exception as e:  # no usable device: fall back
             log.printf("executor=tpu unavailable (%s); falling back to cpu", e)
     executor = Executor(holder, backend=backend)
+    if backend is not None:
+        from pilosa_tpu.exec.batcher import CountBatcher
+
+        executor.batcher = CountBatcher(backend, window=cfg.batch_window)
     executor.logger = log
     if cfg.long_query_time > 0:
         executor.long_query_time = cfg.long_query_time

@@ -26,7 +26,12 @@ from pilosa_tpu.core.timequantum import (
     views_by_time,
     views_by_time_range,
 )
-from pilosa_tpu.core.view import VIEW_STANDARD, View, bsi_view_name
+from pilosa_tpu.core.view import (
+    VIEW_STANDARD,
+    View,
+    _generation_counter,
+    bsi_view_name,
+)
 from pilosa_tpu.roaring import Bitmap, serialize
 from pilosa_tpu.roaring.codec import deserialize
 from pilosa_tpu.shardwidth import SHARD_WIDTH
@@ -144,6 +149,17 @@ class Field:
         self._available_shards = Bitmap()
         self.row_attr_store = None  # wired by Index when attr stores exist
         self.translate_store = None  # wired when keys=True
+        # Structure version: bumped on view creation, fragment create/
+        # delete, and available-shards changes. Keys the cached shard-set
+        # union below — rebuilding it per query cost ~10 ms at the
+        # 954-shard bench shape (it walked every fragment).
+        self.structure_version = 0
+        self._shards_cache: Optional[tuple[int, Bitmap]] = None
+
+    def _bump_structure(self) -> None:
+        # Atomic global counter (see core/view.py): concurrent bumps must
+        # never collapse into one observable value.
+        self.structure_version = next(_generation_counter)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -215,7 +231,7 @@ class Field:
     # -- views ------------------------------------------------------------
 
     def _new_view(self, name: str) -> View:
-        return View(
+        v = View(
             os.path.join(self.path, "views", name) if self.path else None,
             self.index,
             self.name,
@@ -225,6 +241,8 @@ class Field:
             mutex=self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL),
             broadcast_shard=self.broadcast_shard,
         )
+        v.on_structure_change = self._bump_structure
+        return v
 
     def view(self, name: str) -> Optional[View]:
         return self.views.get(name)
@@ -235,27 +253,40 @@ class Field:
             if v is None:
                 v = self._new_view(name).open()
                 self.views[name] = v
+                self._bump_structure()
             return v
 
     def add_available_shard(self, shard: int) -> None:
         if self._available_shards.add(shard, log=False):
+            self._bump_structure()
             self._save_available_shards()
 
     def remove_available_shard(self, shard: int) -> None:
         if self._available_shards.remove(shard, log=False):
+            self._bump_structure()
             self._save_available_shards()
 
     def available_shards(self) -> Bitmap:
         with self.lock:
+            # Read the version BEFORE walking: a concurrent fragment
+            # create during the walk (views bump without field.lock) then
+            # mismatches this snapshot on the next call instead of being
+            # absorbed into the cache key forever.
+            ver = self.structure_version
+            cached = self._shards_cache
+            if cached is not None and cached[0] == ver:
+                return cached[1].clone()
             out = self._available_shards.clone()
             for v in self.views.values():
                 for shard in v.available_shards():
                     out.add(shard, log=False)
-            return out
+            self._shards_cache = (ver, out)
+            return out.clone()
 
     def merge_remote_available_shards(self, other: Bitmap) -> None:
         """reference field.go AddRemoteAvailableShards :274."""
         self._available_shards.union_in_place(other)
+        self._bump_structure()
         self._save_available_shards()
 
     # -- type helpers -----------------------------------------------------

@@ -87,6 +87,8 @@ class Fragment:
         # uid is process-unique (never reused, unlike id()) for cache keys.
         self.version = 0
         self.uid = next(_fragment_uids)
+        # Owning view's data-generation bump; see _mutated.
+        self.on_mutate: Optional[Callable[[], None]] = None
         self._row_cache: dict[int, Bitmap] = {}
         # Lazily-computed per-block checksums, invalidated by row on write
         # (reference caches block checksums too, fragment.go:1762-1776).
@@ -170,6 +172,11 @@ class Fragment:
 
     def _mutated(self, row_ids: Optional[Iterable[int]] = None) -> None:
         self.version += 1
+        # Owning view's data-generation bump (set in view._new_fragment):
+        # lets stack caches check freshness in O(1) instead of walking
+        # every fragment's (uid, version) per query.
+        if self.on_mutate is not None:
+            self.on_mutate()
         if row_ids is None:
             self._row_cache.clear()
             self._block_sums.clear()

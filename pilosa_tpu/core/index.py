@@ -58,6 +58,7 @@ class Index:
         self.options = options or IndexOptions()
         self.fields: dict[str, Field] = {}
         self.lock = threading.RLock()
+        self._shards_cache: Optional[tuple] = None
         self.broadcast_shard = broadcast_shard
         self.column_attr_store = None  # wired by Holder when attr stores exist
         self.translate_store = None
@@ -176,12 +177,21 @@ class Index:
                 shutil.rmtree(f.path)
 
     def available_shards(self) -> Bitmap:
-        """Union of all fields' shard sets (reference index.go:292)."""
-        out = Bitmap()
+        """Union of all fields' shard sets (reference index.go:292).
+        Cached against the fields' structure versions — the executor
+        resolves the shard list on every query."""
         with self.lock:
+            key = tuple(
+                (name, f.structure_version) for name, f in self.fields.items()
+            )
+            cached = self._shards_cache
+            if cached is not None and cached[0] == key:
+                return cached[1].clone()
+            out = Bitmap()
             for f in self.fields.values():
                 out.union_in_place(f.available_shards())
-        return out
+            self._shards_cache = (key, out)
+        return out.clone()
 
     def __repr__(self) -> str:
         return f"Index({self.name}, fields={sorted(self.fields)})"

@@ -7,11 +7,18 @@ per shard, laid out on disk at <field>/views/<view>/fragments/<shard>.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 from typing import Callable, Optional
 
 from pilosa_tpu.core.fragment import Fragment
+
+# Process-global version source: next() is atomic under the GIL, values
+# are unique and monotonic, so concurrent bumps can never collapse into
+# one observable token (used for view generations and field structure
+# versions alike).
+_generation_counter = itertools.count(1)
 
 VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
@@ -51,6 +58,20 @@ class View:
         # Called the first time a shard appears so the cluster layer can
         # broadcast CreateShardMessage (reference view.go:263-305).
         self.broadcast_shard = broadcast_shard
+        # Data generation: bumped on ANY fragment mutation or fragment
+        # create/delete under this view. O(1) freshness token for the
+        # device stack cache (exec/tpu.py _StackedBlocks). Values come
+        # from a process-global atomic counter: a plain += 1 from two
+        # fragments' threads can lose an increment and leave the token
+        # equal to a cached fingerprint while data changed underneath.
+        self.generation = 0
+        # Structure-only callback (fragment create/delete): invalidates
+        # the owning field's available-shards cache without paying for it
+        # on every data write.
+        self.on_structure_change: Optional[Callable[[], None]] = None
+
+    def _bump_data(self) -> None:
+        self.generation = next(_generation_counter)
 
     def open(self) -> "View":
         if self.path is not None:
@@ -74,7 +95,7 @@ class View:
         return os.path.join(self.path, "fragments", str(shard))
 
     def _new_fragment(self, shard: int) -> Fragment:
-        return Fragment(
+        frag = Fragment(
             self._fragment_path(shard),
             self.index,
             self.field,
@@ -84,6 +105,8 @@ class View:
             cache_size=self.cache_size,
             mutex=self.mutex,
         )
+        frag.on_mutate = self._bump_data
+        return frag
 
     def fragment(self, shard: int) -> Optional[Fragment]:
         return self.fragments.get(shard)
@@ -97,6 +120,9 @@ class View:
                 frag = self._new_fragment(shard).open()
                 self.fragments[shard] = frag
                 created = True
+                self._bump_data()
+                if self.on_structure_change is not None:
+                    self.on_structure_change()
         # Broadcast outside the lock: peer RPCs must not block other
         # fragment lookups on this view.
         if created and self.broadcast_shard is not None:
@@ -113,6 +139,9 @@ class View:
                 frag.close()
                 if frag.path and os.path.exists(frag.path):
                     os.remove(frag.path)
+                self._bump_data()
+                if self.on_structure_change is not None:
+                    self.on_structure_change()
                 cache_path = (frag.path or "") + ".cache"
                 if frag.path and os.path.exists(cache_path):
                     os.remove(cache_path)

@@ -1,0 +1,131 @@
+"""Cross-request micro-batching for Count queries (VERDICT r2 #2).
+
+Concurrent HTTP clients each issue small Count requests; one device
+dispatch can serve hundreds of them (the pair-stats kernel touches each
+HBM byte once per sweep regardless of how many queries it answers). The
+batcher coalesces concurrent submissions with a leader/follower window:
+the first submitter becomes leader, sleeps `window` seconds — small
+against the ~78 ms relay dispatch round trip — then drains the queue,
+groups items by (index, shards), and issues ONE count_batch_async per
+group, distributing results back to the waiting threads.
+
+The reference has no analog: the Go engine executes each request's calls
+serially per connection (executor.go:231) because its per-shard loop is
+already CPU-parallel. On a TPU the economics invert — dispatches are
+expensive, device sweeps are cheap — so coalescing across requests is
+what makes the serving path reach the batched-kernel throughput.
+
+Error isolation: a failed group dispatch retries each member item
+individually so one client's bad query (unknown field, unsupported
+shape) errors only that client, never the whole window.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from pilosa_tpu.utils.stats import global_stats
+
+
+class _Item:
+    __slots__ = ("index", "shards", "calls", "event", "result", "error")
+
+    def __init__(self, index, shards, calls):
+        self.index = index
+        self.shards = shards
+        self.calls = calls
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class CountBatcher:
+    """Leader/follower window batcher over TPUBackend.count_batch_async."""
+
+    def __init__(self, backend, window: float = 0.004):
+        self.backend = backend
+        self.window = window
+        self._lock = threading.Lock()
+        self._pending: list[_Item] = []
+        self._leader_active = False
+        self.stats = global_stats
+
+    def count(self, index: str, calls: list, shards: list[int]) -> list[int]:
+        """Block until the batch containing these calls resolves; returns
+        one count per call. Thread-safe; any thread may become leader."""
+        item = _Item(index, tuple(shards), list(calls))
+        with self._lock:
+            self._pending.append(item)
+            am_leader = not self._leader_active
+            if am_leader:
+                self._leader_active = True
+        if am_leader:
+            self._lead()
+        item.event.wait()
+        if item.error is not None:
+            raise item.error
+        return item.result  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------
+
+    def _lead(self) -> None:
+        # Sleep the coalescing window so concurrent submitters can pile
+        # on, then drain. New arrivals after the drain elect a new leader.
+        if self.window > 0:
+            time.sleep(self.window)
+        with self._lock:
+            batch = self._pending
+            self._pending = []
+            self._leader_active = False
+        if not batch:
+            return
+        n_queries = sum(len(it.calls) for it in batch)
+        self.stats.count("count_batcher_batches_total")
+        self.stats.count("count_batcher_queries_total", n_queries)
+        if len(batch) > 1:
+            self.stats.count("count_batcher_coalesced_total", len(batch) - 1)
+        groups: dict[tuple, list[_Item]] = {}
+        for it in batch:
+            groups.setdefault((it.index, it.shards), []).append(it)
+        # Dispatch every group before resolving any: the async resolvers
+        # let XLA pipeline the device work past the readback round trips.
+        dispatched = []
+        for (index, shards), items in groups.items():
+            all_calls = [c for it in items for c in it.calls]
+            try:
+                resolver = self.backend.count_batch_async(
+                    index, all_calls, list(shards)
+                )
+            except BaseException:
+                dispatched.append((items, None))
+                continue
+            dispatched.append((items, resolver))
+        for items, resolver in dispatched:
+            if resolver is None:
+                self._resolve_individually(items)
+                continue
+            try:
+                values = resolver()
+            except BaseException:
+                self._resolve_individually(items)
+                continue
+            off = 0
+            for it in items:
+                it.result = [int(v) for v in values[off : off + len(it.calls)]]
+                off += len(it.calls)
+                it.event.set()
+
+    def _resolve_individually(self, items: list[_Item]) -> None:
+        """Group dispatch failed — isolate: one dispatch per item so only
+        the offending client sees the error."""
+        for it in items:
+            try:
+                resolver = self.backend.count_batch_async(
+                    it.index, it.calls, list(it.shards)
+                )
+                it.result = [int(v) for v in resolver()]
+            except BaseException as e:  # noqa: BLE001 — delivered to waiter
+                it.error = e
+            it.event.set()

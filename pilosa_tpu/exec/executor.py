@@ -72,6 +72,11 @@ class Executor:
         self.tracer = global_tracer
         self.long_query_time: float = 60.0
         self.logger = None
+        # Cross-request micro-batcher (exec/batcher.py): when set, runs of
+        # Count(bitmap) calls — including a single Count — are submitted
+        # through it so concurrent HTTP requests coalesce into one device
+        # dispatch. Wired by the CLI when the device backend is enabled.
+        self.batcher = None
 
     # ------------------------------------------------------------------
     # entry
@@ -118,18 +123,19 @@ class Executor:
                         and len(calls[i + run].children) == 1
                     ):
                         run += 1
-                if run > 1:
+                if run > 1 or (run == 1 and self.batcher is not None):
                     batch = calls[i : i + run]
                     stats.count("query_Count_total", run)
                     if not opt.remote:
                         for b in batch:
                             self._translate_call(idx, b)
                     with self.tracer.start_span("executor.executeCountBatch"):
-                        counts = self.backend.count_batch(
-                            index,
-                            [b.children[0] for b in batch],
-                            self._shards(index, shards),
-                        )
+                        inner = [b.children[0] for b in batch]
+                        sh = self._shards(index, shards)
+                        if self.batcher is not None:
+                            counts = self.batcher.count(index, inner, sh)
+                        else:
+                            counts = self.backend.count_batch(index, inner, sh)
                     results.extend(int(v) for v in counts)
                     i += run
                     continue
@@ -671,23 +677,49 @@ class Executor:
                 if not child_rows[i]:
                     return []
 
+        offset, has_off = c.uint64_arg("offset")
+        if not has_off:
+            offset = 0
+        # Groups the merge must retain before the final offset/limit trim:
+        # a per-shard iterator may stop after this many nonzero groups
+        # (reference groupByIterator limit semantics, executor.go:3063).
+        cap = limit + offset if has_lim else MAX_INT
+
+        # Device fast path: the whole-query group-count tensor in ONE
+        # program (exec/tpu.py group_by); falls back (None) to the
+        # per-shard host iterator for anything not lowerable.
+        if (self.mapper is None or opt.remote) and hasattr(self.backend, "group_by"):
+            with self.tracer.start_span("executor.executeGroupByDevice"):
+                results = self.backend.group_by(
+                    index, c, filter_call, child_rows, self._shards(index, shards)
+                )
+            if results is not None:
+                if offset:
+                    results = results[offset:]
+                if has_lim:
+                    results = results[:limit]
+                return results
+
         map_fn = lambda shard: self._execute_group_by_shard(
-            index, c, filter_call, shard, child_rows
+            index, c, filter_call, shard, child_rows, cap
         )
 
         def reduce_fn(a, b):
-            return merge_group_counts(a, b, limit)
+            return merge_group_counts(a, b, cap)
 
         results = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
 
-        offset, has_off = c.uint64_arg("offset")
-        if has_off and offset < len(results):
+        if offset and offset < len(results):
             results = results[offset:]
+        elif offset:
+            results = []
         if has_lim and limit < len(results):
             results = results[:limit]
         return results
 
-    def _execute_group_by_shard(self, index, c, filter_call, shard, child_rows) -> list[GroupCount]:
+    def _execute_group_by_shard(
+        self, index, c, filter_call, shard, child_rows, cap=MAX_INT
+    ) -> list[GroupCount]:
         filter_row = None
         if filter_call is not None:
             filter_row = self.backend.bitmap_call_shard(index, filter_call, shard)
@@ -710,25 +742,33 @@ class Executor:
                 rows.append((rid, row))
             per_child.append(rows)
 
+        # Paginated iterator semantics (reference groupByIterator,
+        # executor.go:3063-3236): enumerate groups in odometer order and
+        # STOP after `cap` (= limit+offset) nonzero groups — per-shard
+        # truncation is safe because every shard enumerates the same
+        # global order, so the cross-shard merge of capped lists is a
+        # prefix of the uncapped merge.
         out: list[GroupCount] = []
 
-        def recurse(i: int, acc: Optional[Row], group: list[FieldRow]):
+        def recurse(i: int, acc: Optional[Row], group: list[FieldRow]) -> bool:
             if i == len(per_child):
                 cnt = acc.count() if acc is not None else 0
                 if cnt > 0:
                     out.append(GroupCount(list(group), cnt))
-                return
+                return len(out) < cap
             for rid, row in per_child[i]:
                 nxt = row if acc is None else acc.intersect(row)
                 if i > 0 or acc is not None:
                     if not nxt.any():
                         continue
                 group.append(FieldRow(fields[i], rid))
-                recurse(i + 1, nxt, group)
+                more = recurse(i + 1, nxt, group)
                 group.pop()
+                if not more:
+                    return False
+            return True
 
-        base = filter_row
-        recurse(0, base, [])
+        recurse(0, filter_row, [])
         return out
 
     # ------------------------------------------------------------------

@@ -59,6 +59,7 @@ from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, _padded_rows, pack_fragment, 
 from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
+from pilosa_tpu.utils.stats import global_stats
 
 _DEVICE_LOWERED = ("Row", "Range", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift")
 
@@ -66,6 +67,10 @@ _DEVICE_LOWERED = ("Row", "Range", "Union", "Intersect", "Difference", "Xor", "N
 # the shard axis is exact up to 4095 shards (4096·2^20 = 2^32). Beyond that
 # the programs return per-shard partials and the host sums in Python ints.
 MAX_DEVICE_SUM_SHARDS = 4095
+
+# Pair-stats host cache bound: entries hold refs to two device stacks, so
+# the cap (LRU) keeps many-field indexes from pinning evicted HBM arrays.
+MAX_PAIR_CACHE_ENTRIES = 16
 
 # BSI min/max assemble values from per-plane decision bits on the host, so
 # depth is bounded only by the spec key; sums weight plane counts in exact
@@ -120,18 +125,15 @@ class _StackedBlocks:
         count/bitwise result). min_rows forces taller stacks (BSI plane
         count independent of stored max row)."""
         v = field_obj.view(view_name)
-        frags = {s: (v.fragment(s) if v is not None else None) for s in shards}
-        n_rows = max(
-            [fr.max_row_id + 1 for fr in frags.values() if fr is not None] + [min_rows]
+        # O(1) freshness: the view's generation covers every fragment
+        # mutation and create/delete under it (core/view.py), so a hit
+        # needs no per-fragment walk — the old (uid, version)-per-shard
+        # fingerprint cost ~1 ms per lookup at the 954-shard bench shape.
+        fingerprint = (
+            tuple(shards),
+            v.generation if v is not None else -1,
+            min_rows,
         )
-        rows_p = _padded_rows(n_rows)
-        s_pad = self._pad_shards(len(shards))
-        # Freshness via the fragment's process-unique uid + version (id()
-        # could be reused by a new object after GC and serve stale blocks).
-        fingerprint = tuple(
-            (s, (fr.uid, fr.version) if fr is not None else None)
-            for s, fr in frags.items()
-        ) + (rows_p, s_pad)
         # Keyed by (index, field, view) only: a changed shard set REPLACES
         # the cached stack rather than accumulating per-subset copies in HBM.
         key = (index, field_obj.name, view_name)
@@ -150,6 +152,13 @@ class _StackedBlocks:
             # its fingerprint usually matches ours (same live fragments).
             latch.wait()
         try:
+            frags = {s: (v.fragment(s) if v is not None else None) for s in shards}
+            n_rows = max(
+                [fr.max_row_id + 1 for fr in frags.values() if fr is not None]
+                + [min_rows]
+            )
+            rows_p = _padded_rows(n_rows)
+            s_pad = self._pad_shards(len(shards))
             nbytes = s_pad * rows_p * WORDS_PER_SHARD * 4
             if self.max_bytes is not None and nbytes > self.max_bytes:
                 # Stack can never be resident under the budget: the caller
@@ -407,6 +416,14 @@ class TPUBackend:
         self.blocks = _StackedBlocks(device, self.mesh, max_bytes)
         self._fns: dict = {}
         self._fns_lock = threading.RLock()
+        # Host-resident pair-stats cache: (index, fa, fb, shards) ->
+        # (fblock, gblock, flat stats). Block identity is the freshness
+        # token (see _pair_batch_dispatch); one entry per field pair, so
+        # replacing it also drops the strong ref keeping a stale stack
+        # alive. Guarded: resolvers run on server worker threads.
+        self._pair_cache: dict = {}
+        self._pair_lock = threading.Lock()
+        self.stats = global_stats
 
     # -- spec + leaf assembly ---------------------------------------------
 
@@ -851,9 +868,18 @@ class TPUBackend:
             return out
         with jax.profiler.TraceAnnotation("pilosa.bitmap_call"):
             slab = self._program("vec", spec, False)(blocks, scalars)
-        host = np.asarray(slab)  # [S_pad, W], one readback
+        # Subset requests gather on device first: reading the whole
+        # [S_pad, W] slab back for one shard would move ~120 MB over the
+        # relay link when 128 KiB is needed.
+        if len(positions) * 4 <= slab.shape[0]:
+            slab = slab[jnp.asarray(positions, dtype=jnp.int32)]
+            host = np.asarray(slab)  # [len(positions), W]
+            rows = zip(range(len(positions)), shards)
+        else:
+            host = np.asarray(slab)  # [S_pad, W], one readback
+            rows = zip(positions, shards)
         out = Row()
-        for pos, s in zip(positions, shards):
+        for pos, s in rows:
             words = host[pos]
             if not words.any():
                 continue
@@ -982,7 +1008,12 @@ class TPUBackend:
         return entries, fa, fb
 
     def _pair_program(self):
-        """Compiled pair_stats sweep (+ shard_map/psum under a mesh)."""
+        """Compiled pair_stats sweep (+ shard_map/psum under a mesh).
+
+        Returns the three stats flattened into ONE int32 vector
+        [pair.ravel() | cf | cg]: on a relay-attached chip each host
+        readback is a full round trip, so fusing the outputs cuts the
+        resolve cost from 3 RTTs to 1."""
         key = ("pair2",)
         with self._fns_lock:
             fn = self._fns.get(key)
@@ -990,17 +1021,20 @@ class TPUBackend:
             return fn
         interpret = jax.default_backend() != "tpu"
         if self.mesh is None:
-            fn = functools.partial(pair_stats, interpret=interpret)
+
+            def flat(fb, gb):
+                pair, cf, cg = pair_stats(fb, gb, interpret=interpret)
+                return jnp.concatenate([pair.ravel(), cf, cg])
+
+            fn = jax.jit(flat)
         else:
             mesh = self.mesh
 
             def body(fb, gb):
                 pair, cf, cg = pair_stats(fb, gb, interpret=interpret)
                 ax = mesh.axis
-                return (
-                    jax.lax.psum(pair, ax),
-                    jax.lax.psum(cf, ax),
-                    jax.lax.psum(cg, ax),
+                return jax.lax.psum(
+                    jnp.concatenate([pair.ravel(), cf, cg]), ax
                 )
 
             fn = jax.jit(
@@ -1008,7 +1042,7 @@ class TPUBackend:
                     body,
                     mesh=mesh.mesh,
                     in_specs=(P(mesh.axis), P(mesh.axis)),
-                    out_specs=(P(), P(), P()),
+                    out_specs=P(),
                     # pallas_call's out_shape carries no vma annotation;
                     # skip the varying-across-mesh check for this body.
                     check_vma=False,
@@ -1029,34 +1063,222 @@ class TPUBackend:
         rf, rg = fblock.shape[1], gblock.shape[1]
         if rf * rg > (1 << 16):
             raise _Unsupported("pair matrix too large")
-        with jax.profiler.TraceAnnotation("pilosa.pair_stats"):
-            pair, cf, cg = self._pair_program()(fblock, gblock)
 
-        def resolve() -> list[int]:
-            p = np.asarray(pair)
-            f_ = np.asarray(cf)
-            g_ = np.asarray(cg)
-            out = []
-            for op, a, b in entries:
-                ca = int(f_[a]) if a < rf else 0
-                cb = int(g_[b]) if b < rg else 0
-                pi = int(p[a, b]) if (a < rf and b < rg) else 0
-                if op == "A":
-                    v = ca
-                elif op == "B":
-                    v = cb
-                elif op == "I":
-                    v = pi
-                elif op == "U":
-                    v = ca + cb - pi
-                elif op == "D":
-                    v = ca - pi
-                else:  # X
-                    v = ca + cb - 2 * pi
-                out.append(v)
-            return out
+        # Host stats cache (the reference's rank-cache idea, cache.go:136:
+        # materialize counts once, serve queries from them until writes
+        # invalidate). _StackedBlocks REPLACES a stack array whenever any
+        # fragment's uid/version changes, so array identity doubles as the
+        # write epoch: a hit means no bit under either field moved.
+        # One entry per (index, field pair): a changed shard set or a
+        # replaced stack overwrites it, so stale entries can't pin
+        # evicted device arrays (HBM) indefinitely; the LRU cap bounds
+        # the pair-combination count for many-field indexes.
+        ckey = (index, fa, fb)
+        with self._pair_lock:
+            hit = self._pair_cache.get(ckey)
+            if (
+                hit is not None
+                and hit[0] == shards_t
+                and hit[1] is fblock
+                and hit[2] is gblock
+            ):
+                self._pair_cache[ckey] = self._pair_cache.pop(ckey)  # LRU touch
+                self.stats.count("pair_stats_cache_hits_total")
+                return functools.partial(
+                    self._pair_fetch, ckey, entries, hit[3], rf, rg
+                )
+            # Miss: dispatch and cache the IN-FLIGHT device array right
+            # away — overlapping windows (pipelined batches, concurrent
+            # HTTP clients) share this one sweep instead of each missing
+            # until the first resolver lands.
+            self.stats.count("pair_stats_sweeps_total")
+            with jax.profiler.TraceAnnotation("pilosa.pair_stats"):
+                flat = self._pair_program()(fblock, gblock)
+            self._pair_cache.pop(ckey, None)
+            self._pair_cache[ckey] = (shards_t, fblock, gblock, flat)
+            while len(self._pair_cache) > MAX_PAIR_CACHE_ENTRIES:
+                self._pair_cache.pop(next(iter(self._pair_cache)))
+        return functools.partial(self._pair_fetch, ckey, entries, flat, rf, rg)
 
-        return resolve
+    def _pair_fetch(self, ckey, entries, flat, rf, rg) -> list[int]:
+        """Resolve stats (device array on first touch, host np after) and
+        derive the batch's counts."""
+        if not isinstance(flat, np.ndarray):
+            stats_np = np.asarray(flat)  # ONE readback for all 3 stats
+            with self._pair_lock:
+                ent = self._pair_cache.get(ckey)
+                if ent is not None and ent[3] is flat:
+                    self._pair_cache[ckey] = ent[:3] + (stats_np,)
+        else:
+            stats_np = flat
+        return self._pair_resolve(entries, stats_np, rf, rg)
+
+    @staticmethod
+    def _pair_resolve(entries, stats_np, rf, rg) -> list[int]:
+        p = stats_np[: rf * rg].reshape(rf, rg)
+        f_ = stats_np[rf * rg : rf * rg + rf]
+        g_ = stats_np[rf * rg + rf :]
+        out = []
+        for op, a, b in entries:
+            ca = int(f_[a]) if a < rf else 0
+            cb = int(g_[b]) if b < rg else 0
+            pi = int(p[a, b]) if (a < rf and b < rg) else 0
+            if op == "A":
+                v = ca
+            elif op == "B":
+                v = cb
+            elif op == "I":
+                v = pi
+            elif op == "U":
+                v = ca + cb - pi
+            elif op == "D":
+                v = ca - pi
+            else:  # X
+                v = ca + cb - 2 * pi
+            out.append(v)
+        return out
+
+    # -- GroupBy device path (VERDICT r2 #4) --------------------------------
+
+    def _group_program(self, n: int, filtered: bool):
+        """Stats program for GroupBy over n Rows children (+ optional
+        filter slab): n=1 -> per-row counts [R] (fused XLA reduce), n=2 ->
+        pair matrix [Rf, Rg] (the Pallas pair_stats sweep — GroupBy over
+        two Rows IS the pair-count matrix, VERDICT r2 weak #6), n=3 ->
+        [Rh, Rf, Rg] via a lax.scan of pair sweeps over the third field's
+        rows. One output array = one host readback."""
+        key = ("groupby", n, filtered)
+        with self._fns_lock:
+            fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        interpret = jax.default_backend() != "tpu"
+
+        def stats(*args):
+            stacks, filt = args[:n], (args[n] if filtered else None)
+            f = stacks[0]
+            if filt is not None:
+                f = f & filt[:, None, :]
+            if n == 1:
+                return jnp.sum(
+                    jax.lax.population_count(f).astype(jnp.int32), axis=(0, 2)
+                )
+            g = stacks[1]
+            if n == 2:
+                return pair_stats(f, g, interpret=interpret)[0]
+            h = stacks[2]
+
+            def step(_, h_c):  # h_c: [S, W] — one row of the third field
+                return None, pair_stats(f & h_c[:, None, :], g, interpret=interpret)[0]
+
+            _, tri = jax.lax.scan(step, None, jnp.moveaxis(h, 1, 0))
+            return tri  # [Rh, Rf, Rg]
+
+        if self.mesh is None:
+            fn = jax.jit(stats)
+        else:
+            mesh = self.mesh
+
+            def body(*args):
+                return jax.lax.psum(stats(*args), mesh.axis)
+
+            n_in = n + (1 if filtered else 0)
+            fn = jax.jit(
+                shard_map(
+                    body,
+                    mesh=mesh.mesh,
+                    in_specs=(P(mesh.axis),) * n_in,
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )
+        with self._fns_lock:
+            fn = self._fns.setdefault(key, fn)
+        return fn
+
+    def group_by(self, index, c: Call, filter_call, child_rows, shards) -> Optional[list]:
+        """Whole-query GroupBy: ONE device program computes the full
+        group-count tensor over every shard; the host enumerates nonzero
+        groups in odometer order (reference groupByIterator semantics,
+        executor.go:3063 — but exact counts in one sweep instead of a
+        per-shard bitmap recursion). Returns None when not lowerable so
+        the executor falls back to the host path."""
+        from pilosa_tpu.exec.result import FieldRow, GroupCount
+
+        children = c.children
+        n = len(children)
+        if n == 0 or n > 3:
+            return None
+        shards_t = tuple(shards)
+        fields = []
+        starts = []
+        for child in children:
+            if "from" in child.args or "to" in child.args:
+                return None  # time-ranged Rows: host path unions quantum views
+            fname = child.args.get("field") or child.args.get("_field")
+            f_obj = self._field(index, fname)  # raises the reference error
+            fields.append((fname, f_obj))
+            prev, has_prev = child.uint64_arg("previous")
+            starts.append(prev + 1 if has_prev else 0)
+        try:
+            stacks = [self._get_block(index, fo, shards_t)[0] for _, fo in fields]
+            filt = None
+            if filter_call is not None:
+                spec, blocks, scalars = self._assemble(index, filter_call, shards_t)
+                filt = self._program("vec", spec, False)(blocks, scalars)
+        except _Unsupported:
+            return None
+        if stacks[0].shape[0] > MAX_PAIR_SHARDS:
+            return None  # int32 accumulator bound (ops/kernels.py)
+        rs = [s.shape[1] for s in stacks]
+        if int(np.prod(rs)) > (1 << 16):
+            return None
+        args = tuple(stacks) + ((filt,) if filt is not None else ())
+        with jax.profiler.TraceAnnotation("pilosa.group_by"):
+            stats_np = np.asarray(self._group_program(n, filt is not None)(*args))
+        cand = []
+        for i in range(n):
+            if child_rows[i] is not None:
+                cand.append([r for r in child_rows[i] if r >= starts[i]])
+            else:
+                cand.append(list(range(starts[i], rs[i])))
+        out = []
+        if n == 1:
+            for a in cand[0]:
+                v = int(stats_np[a]) if a < rs[0] else 0
+                if v > 0:
+                    out.append(GroupCount([FieldRow(fields[0][0], a)], v))
+        elif n == 2:
+            for a in cand[0]:
+                for b in cand[1]:
+                    v = int(stats_np[a, b]) if (a < rs[0] and b < rs[1]) else 0
+                    if v > 0:
+                        out.append(
+                            GroupCount(
+                                [FieldRow(fields[0][0], a), FieldRow(fields[1][0], b)], v
+                            )
+                        )
+        else:
+            for a in cand[0]:
+                for b in cand[1]:
+                    for h in cand[2]:
+                        v = (
+                            int(stats_np[h, a, b])
+                            if (a < rs[0] and b < rs[1] and h < rs[2])
+                            else 0
+                        )
+                        if v > 0:
+                            out.append(
+                                GroupCount(
+                                    [
+                                        FieldRow(fields[0][0], a),
+                                        FieldRow(fields[1][0], b),
+                                        FieldRow(fields[2][0], h),
+                                    ],
+                                    v,
+                                )
+                            )
+        return out
 
     # -- generic batched scan path -----------------------------------------
 

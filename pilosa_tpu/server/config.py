@@ -33,6 +33,9 @@ class Config:
     metric_service: str = "memory"  # memory | none
     tracing: bool = False
     long_query_time: float = 0.0
+    # Cross-request Count coalescing window in seconds (exec/batcher.py);
+    # 0 disables the wait (requests still batch when simultaneous).
+    batch_window: float = 0.004
 
     def _split_bind(self) -> tuple[str, int]:
         """Handles host:port, :port, bare host, [v6]:port, and bare IPv6."""
@@ -70,6 +73,7 @@ class Config:
                 "hosts": self.cluster.hosts,
             },
             "long-query-time": self.long_query_time,
+            "batch-window": self.batch_window,
         }
 
     @staticmethod
@@ -97,6 +101,7 @@ class Config:
             "log-path": "log_path",
             "verbose": "verbose",
             "long-query-time": "long_query_time",
+            "batch-window": "batch_window",
         }
         for k, attr in simple.items():
             if k in data:
@@ -124,6 +129,7 @@ class Config:
             pre + "CLUSTER_REPLICAS": ("cluster.replicas", int),
             pre + "CLUSTER_HOSTS": ("cluster.hosts", lambda v: v.split(",") if v else []),
             pre + "ANTI_ENTROPY_INTERVAL": ("anti_entropy_interval", float),
+            pre + "BATCH_WINDOW": ("batch_window", float),
         }
         for key, (attr, conv) in mapping.items():
             if key in env:
