@@ -52,10 +52,44 @@ def cmd_server(args) -> int:
         executor.long_query_time = cfg.long_query_time
     api = API(holder, executor)
 
-    daemons = []
-    if cfg.cluster.hosts:
-        from pilosa_tpu.cluster import Cluster, Node, Topology, URI
+    def wire_cluster(topo_nodes, local_id):
+        """Shared cluster bootstrap for both the static-hosts and --join
+        paths: build the topology, attach seams, start daemons."""
+        from pilosa_tpu.cluster import Cluster, Topology
         from pilosa_tpu.cluster.sync import FailureDetector, SyncDaemon
+
+        topo = Topology(topo_nodes, replica_n=cfg.cluster.replicas)
+        local = topo.node_by_id(local_id)
+        if local is None:
+            return None
+        cluster = Cluster(local, topo, holder)
+        cluster.logger = log
+        cluster.attach(executor, api)
+        api.cluster = cluster
+        cluster.attach_resizer(log)
+        daemons.append(
+            SyncDaemon(cluster, interval=cfg.anti_entropy_interval, logger=log).start()
+        )
+        daemons.append(FailureDetector(cluster, logger=log).start())
+        return cluster
+
+    daemons = []
+    join_cluster_ref = None
+    if getattr(args, "join", None):
+        # Dynamic join (reference gossip join → listenForJoins
+        # cluster.go:1063): boot as a single-node topology; the announce
+        # fires AFTER the HTTP server is bound (below) so the
+        # coordinator's resize instructions can reach us, and the resize
+        # machinery delivers schema + fragments + the real topology.
+        from pilosa_tpu.cluster import Node, URI
+
+        local_id = f"node-{cfg.host}-{cfg.port}"
+        local = Node(
+            id=local_id, uri=URI(scheme="http", host=cfg.host, port=cfg.port)
+        )
+        join_cluster_ref = wire_cluster([local], local_id)
+    elif cfg.cluster.hosts:
+        from pilosa_tpu.cluster import Node, URI
 
         # Node IDs derive from the URI so every host computes the same
         # ID-sorted ring without an out-of-band registry (the reference
@@ -73,27 +107,29 @@ def cmd_server(args) -> int:
                 n.is_coordinator = n.id == local_id
         elif nodes:
             min(nodes, key=lambda n: n.id).is_coordinator = True
-        topo = Topology(nodes, replica_n=cfg.cluster.replicas)
-        local = topo.node_by_id(local_id)
-        if local is None:
+        cluster = wire_cluster(nodes, local_id)
+        if cluster is None:
             log.printf(
                 "bind %s:%d is not in cluster.hosts %s", cfg.host, cfg.port, cfg.cluster.hosts
             )
             return 1
-        cluster = Cluster(local, topo, holder)
-        cluster.logger = log
-        cluster.attach(executor, api)
-        api.cluster = cluster
-        cluster.attach_resizer(log)
-        daemons.append(SyncDaemon(cluster, interval=cfg.anti_entropy_interval, logger=log).start())
-        daemons.append(FailureDetector(cluster, logger=log).start())
         log.printf(
             "clustered: %d nodes, replicas=%d, coordinator=%s",
             len(nodes), cfg.cluster.replicas, cluster.coordinator().id,
         )
 
-    server = Server(api, host=cfg.host, port=cfg.port)
+    server = Server(api, host=cfg.host, port=cfg.port)  # binds the socket
     log.printf("listening on http://%s:%d (data: %s)", cfg.host, cfg.port, data_dir)
+    if join_cluster_ref is not None:
+        import threading
+
+        def announce():
+            if join_cluster_ref.join_cluster(args.join):
+                log.printf("joined cluster via %s", args.join)
+            else:
+                log.printf("join via %s timed out; still standalone", args.join)
+
+        threading.Thread(target=announce, daemon=True).start()
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -244,6 +280,13 @@ def main(argv=None) -> int:
     sp.add_argument("-b", "--bind", default=None)
     sp.add_argument("-c", "--config", default=None)
     sp.add_argument("--executor", choices=["tpu", "cpu"], default=None)
+    sp.add_argument(
+        "--join",
+        default=None,
+        metavar="URI",
+        help="announce to a live cluster's coordinator and join it "
+        "(dynamic membership; no operator resize call needed)",
+    )
     sp.add_argument("--verbose", action="store_true")
     sp.set_defaults(fn=cmd_server)
 

@@ -140,8 +140,11 @@ class _Handler(BaseHTTPRequestHandler):
 
                 stats = global_stats.with_tags(f"route:{fn_name[7:]}", f"method:{method}")
                 stats.count("http_requests_total")
+                # self.headers is an email.message.Message: its .get() is
+                # case-insensitive, which matters because urllib
+                # normalizes injected header casing (X-trace-id).
                 span = global_tracer.start_span(
-                    f"http.{fn_name}", headers=dict(self.headers)
+                    f"http.{fn_name}", headers=self.headers
                 )
                 try:
                     with stats.timer("http_request_duration_seconds"):

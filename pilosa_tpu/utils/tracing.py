@@ -60,6 +60,12 @@ class Tracer:
             self._local.stack = stack
         return stack
 
+    def active_span(self) -> Optional[Span]:
+        """Innermost unfinished span on this thread, if any — the context
+        the internal client injects into peer RPC headers."""
+        stack = self._stack()
+        return stack[-1] if stack else None
+
     def start_span(self, name: str, headers: Optional[dict] = None) -> Span:
         trace_id = None
         parent_id = None
@@ -121,6 +127,9 @@ class NopTracer:
 
     def start_span(self, name: str, headers=None):
         return self._NopSpan()
+
+    def active_span(self):
+        return None
 
     def recent(self, n: int = 50):
         return []

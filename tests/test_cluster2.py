@@ -338,3 +338,126 @@ class TestBroadcastRecovery:
             assert out["results"][0] == len(cols)
             assert c.nodes[1].holder.index("i") is not None
             assert c.nodes[1].holder.index("i").field("f") is not None
+
+
+class TestDistributedPlumbing:
+    """Round-3 half-wired plumbing (VERDICT r2 #5): trace linkage across
+    nodes, single-RPC bulk key translation, DOWN-state dissemination."""
+
+    def test_cross_node_trace_linkage(self):
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(1, f=1)")
+            with global_tracer.start_span("test.root") as root:
+                # Direct peer RPC: the client must inject root's context.
+                c.nodes[0].cluster.client.query_node(
+                    c.nodes[1].node, "i", "Count(Row(f=1))", remote=True
+                )
+            # The peer's handler/executor spans must join root's trace
+            # (the handler span finishes a beat after the response, so
+            # poll briefly).
+            linked = []
+            for _ in range(50):
+                linked = [
+                    s
+                    for s in global_tracer.recent(300)
+                    if s["traceID"] == root.trace_id
+                    and s["name"] != "test.root"
+                    and s["parentID"] is not None
+                ]
+                if any(s["name"].startswith("http.") for s in linked):
+                    break
+                time.sleep(0.02)
+            assert linked, "peer spans not linked to the caller's trace"
+            assert any(s["name"].startswith("http.") for s in linked)
+            assert any(s["name"].startswith("executor.") for s in linked)
+
+    def test_bulk_translate_keys_is_one_rpc(self):
+        with TestCluster(2) as c:
+            c.create_index("ki", {"keys": True})
+            non_coord = next(
+                cn for cn in c.nodes if not cn.cluster.is_coordinator()
+            )
+            store = non_coord.holder.index("ki").translate_store
+            client = non_coord.cluster.client
+            calls = {"translate_keys": 0, "translate_data": 0}
+            orig_tk, orig_td = client.translate_keys, client.translate_data
+
+            def tk(*a, **k):
+                calls["translate_keys"] += 1
+                return orig_tk(*a, **k)
+
+            def td(*a, **k):
+                calls["translate_data"] += 1
+                return orig_td(*a, **k)
+
+            client.translate_keys, client.translate_data = tk, td
+            keys = [f"user{n}" for n in range(10_000)]
+            ids = store.translate_keys(keys)
+            assert calls["translate_keys"] == 1
+            assert calls["translate_data"] <= 2
+            assert len(set(ids)) == len(keys)
+            # Local replica now serves every key without an RPC.
+            calls["translate_keys"] = 0
+            again = store.translate_keys(keys)
+            assert again == ids and calls["translate_keys"] == 0
+
+    def test_down_state_disseminates(self):
+        with TestCluster(3) as c:
+            c.create_index("i")
+            c.nodes[2].server.close()
+            # Only node0 probes; node1 must learn DOWN via the broadcast.
+            det = FailureDetector(c.nodes[0].cluster, confirm_down=1)
+            det.probe_once()
+            dead_id = c.nodes[2].node.id
+            assert (
+                c.nodes[0].cluster.topology.node_by_id(dead_id).state == "DOWN"
+            )
+            # Dissemination is async (fire-and-forget broadcast threads).
+            peer_view = c.nodes[1].cluster.topology.node_by_id(dead_id)
+            for _ in range(100):
+                if peer_view.state == "DOWN":
+                    break
+                time.sleep(0.02)
+            assert peer_view.state == "DOWN", (
+                "peer did not learn DOWN from the broadcast"
+            )
+
+
+class TestDynamicJoin:
+    """VERDICT r2 #6: a node announces itself and joins a live cluster —
+    no operator resize call (reference gossip join -> listenForJoins
+    cluster.go:1063-1141)."""
+
+    def test_node_joins_without_operator_call(self):
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            for s in range(6):
+                c.query(0, "i", f"Set({s * SHARD_WIDTH + 3}, f=1)")
+            cn = c.spawn_node()
+            assert cn.cluster.join_cluster(c.nodes[0].node, timeout=30)
+            # Topology converged on every node, including the joiner.
+            for node in c.nodes:
+                assert len(node.cluster.topology.nodes) == 3, node.node.id
+                assert node.cluster.state() == "NORMAL"
+            # The joiner serves correct results (its fragments arrived).
+            out = c.query(len(c.nodes) - 1, "i", "Count(Row(f=1))")
+            assert out["results"][0] == 6
+
+    def test_join_ships_node_status_to_coordinator(self):
+        with TestCluster(2) as c:
+            cn = c.spawn_node()
+            # The joiner arrives with pre-existing schema + data.
+            idx = cn.holder.create_index("pre")
+            f = idx.create_field("pf")
+            f.import_bits(np.array([1], dtype=np.uint64),
+                          np.array([5], dtype=np.uint64))
+            assert cn.cluster.join_cluster(c.nodes[0].node, timeout=30)
+            # Coordinator merged the joiner's NodeStatus before resizing.
+            pre = c.nodes[0].holder.index("pre")
+            assert pre is not None and pre.field("pf") is not None
+            assert 0 in pre.field("pf").available_shards().to_array().tolist()
