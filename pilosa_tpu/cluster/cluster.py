@@ -420,9 +420,23 @@ class Cluster:
         """Apply a single-shard write on every replica; OR the changed
         flags (reference executeSetBitField: ret = changed on any node)."""
         replicas = self.topology.shard_nodes(index, shard)
-        peers = [n for n in replicas if n.id != self.local_node.id]
+        # DOWN replicas are skipped (reads already skip them in
+        # map_shards); anti-entropy delivers the write when they return —
+        # but ONLY if at least one live replica takes it now. All
+        # replicas down must fail loudly: a silently dropped write is
+        # unrepairable (no replica ever held it).
+        peers = [
+            n
+            for n in replicas
+            if n.id != self.local_node.id and n.state != NODE_STATE_DOWN
+        ]
+        local_is_replica = any(n.id == self.local_node.id for n in replicas)
+        if replicas and not peers and not local_is_replica:
+            raise ClientError(
+                f"every replica of shard {shard} is down; write not applied"
+            )
         ret = None
-        if any(n.id == self.local_node.id for n in replicas):
+        if local_is_replica:
             ret = local_fn()
         for r in self._parallel_peer_writes(peers, index, c.to_string()):
             if ret is None:
@@ -441,7 +455,17 @@ class Cluster:
         here keeps replicas consistent at write time."""
         by_node: dict[str, tuple[Node, list[int]]] = {}
         for shard in shards:
-            for node in self.topology.shard_nodes(index, shard):
+            reps = self.topology.shard_nodes(index, shard)
+            if reps and all(
+                n.state == NODE_STATE_DOWN and n.id != self.local_node.id
+                for n in reps
+            ):
+                # No live replica for THIS shard: fail loudly — a
+                # silently skipped shard write is unrepairable.
+                raise ClientError(
+                    f"every replica of shard {shard} is down; write not applied"
+                )
+            for node in reps:
                 by_node.setdefault(node.id, (node, []))[1].append(shard)
         ret = None
         local = by_node.pop(self.local_node.id, None)
@@ -449,7 +473,9 @@ class Cluster:
             for shard in local[1]:
                 r = local_fn(shard)
                 ret = r if ret is None else (bool(ret) or bool(r))
-        peers = [node for node, _ in by_node.values()]
+        peers = [
+            node for node, _ in by_node.values() if node.state != NODE_STATE_DOWN
+        ]
         pinned = {node.id: ss for node, ss in by_node.values()}
         for r in self._parallel_peer_writes(peers, index, c.to_string(), pinned):
             if ret is None:
@@ -528,6 +554,8 @@ class Cluster:
                 wrap_translate_stores(self)
         elif typ == bc.MSG_CLUSTER_STATUS:
             self.set_state(msg.get("state", self.state()))
+            if "replicaN" in msg:
+                self.topology.replica_n = int(msg["replicaN"])
             if "nodes" in msg:
                 new_nodes = sorted(
                     (Node.from_json(d) for d in msg["nodes"]), key=lambda n: n.id

@@ -387,6 +387,10 @@ class Resizer:
             bc.MSG_CLUSTER_STATUS,
             state=STATE_NORMAL,
             nodes=[n.to_json() for n in new_nodes],
+            # A --join node boots with its own default; the cluster's
+            # replication factor must override or its shard_nodes view
+            # diverges from every other member.
+            replicaN=self.cluster.topology.replica_n,
         )
         self.cluster.receive_message(status.to_bytes())
         for node in notify:

@@ -694,6 +694,12 @@ class Executor:
                     index, c, filter_call, child_rows, self._shards(index, shards)
                 )
             if results is not None:
+                if opt.remote:
+                    # Partial for the coordinator's merge: cap, never
+                    # offset — trimming here would double-apply the
+                    # window and drop this node's counts for early
+                    # groups.
+                    return results[:cap] if has_lim else results
                 if offset:
                     results = results[offset:]
                 if has_lim:
@@ -709,6 +715,11 @@ class Executor:
 
         results = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
 
+        if opt.remote:
+            # Remote partials return capped-but-untrimmed: the
+            # coordinator merges counts across nodes first, THEN applies
+            # the offset/limit window exactly once.
+            return results
         if offset and offset < len(results):
             results = results[offset:]
         elif offset:

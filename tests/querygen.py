@@ -1,0 +1,103 @@
+"""Randomized PQL generator (reference internal/test/querygenerator.go).
+
+Generates random-but-valid query trees over a fixed schema: set fields
+"f"/"g", a mutex "m", an int (BSI) field "v", and the existence field.
+Used differentially — every generated query runs through both the CPU
+oracle and the device backend, and the results must match exactly. This
+is the cheapest way to shake out device-lowering edge cases the ~15
+hand-picked query shapes in tests/test_tpu.py can't reach.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SET_FIELDS = ("f", "g")
+MUTEX_FIELD = "m"
+INT_FIELD = "v"
+VERBS = ("Intersect", "Union", "Difference", "Xor")
+
+
+class QueryGenerator:
+    def __init__(self, seed: int, max_depth: int = 3, n_rows: int = 5,
+                 int_lo: int = -50, int_hi: int = 50):
+        self.rng = np.random.default_rng(seed)
+        self.max_depth = max_depth
+        self.n_rows = n_rows
+        self.int_lo = int_lo
+        self.int_hi = int_hi
+
+    def _i(self, lo, hi) -> int:
+        return int(self.rng.integers(lo, hi))
+
+    def row_leaf(self) -> str:
+        kind = self._i(0, 4)
+        if kind == 0:  # plain set row (sometimes a missing row id)
+            f = SET_FIELDS[self._i(0, len(SET_FIELDS))]
+            return f"Row({f}={self._i(0, self.n_rows + 2)})"
+        if kind == 1:  # mutex row
+            return f"Row({MUTEX_FIELD}={self._i(0, 3)})"
+        if kind == 2:  # BSI comparison
+            op = ("<", ">", "<=", ">=", "==", "!=")[self._i(0, 6)]
+            val = self._i(self.int_lo - 10, self.int_hi + 10)
+            return f"Row({INT_FIELD} {op} {val})"
+        # BSI between
+        lo = self._i(self.int_lo - 5, self.int_hi)
+        hi = self._i(lo, self.int_hi + 5)
+        return f"Row({lo} <= {INT_FIELD} <= {hi})"
+
+    def bitmap(self, depth: int = 0) -> str:
+        if depth >= self.max_depth or self._i(0, 3) == 0:
+            return self.row_leaf()
+        kind = self._i(0, 6)
+        if kind == 0:
+            return f"Not({self.bitmap(depth + 1)})"
+        verb = VERBS[self._i(0, len(VERBS))]
+        n_children = self._i(2, 4)
+        children = ", ".join(self.bitmap(depth + 1) for _ in range(n_children))
+        return f"{verb}({children})"
+
+    def query(self) -> str:
+        kind = self._i(0, 10)
+        b = self.bitmap()
+        if kind < 4:
+            return f"Count({b})"
+        if kind < 6:
+            return b  # bare bitmap: compares columns
+        if kind == 6:
+            f = SET_FIELDS[self._i(0, len(SET_FIELDS))]
+            return f"TopN({f}, {b}, n={self._i(1, 6)})"
+        if kind == 7:
+            return f"Sum({b}, field={INT_FIELD})"
+        if kind == 8:
+            return f"Min({b}, field={INT_FIELD})"
+        return f"Max({b}, field={INT_FIELD})"
+
+
+def build_schema(holder, rng, shards: int = 2, density: int = 1200):
+    """Populate the generator's fixed schema with random data."""
+    from pilosa_tpu.core.field import FieldOptions, options_for_int
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    idx = holder.create_index("qg")
+    for fname in SET_FIELDS:
+        idx.create_field(fname)
+    idx.create_field(MUTEX_FIELD, FieldOptions(type="mutex"))
+    idx.create_field(INT_FIELD, options_for_int(-50, 50))
+    span = shards * SHARD_WIDTH
+    for fname in SET_FIELDS:
+        for row in range(5):
+            cols = np.unique(rng.integers(0, span, density, dtype=np.uint64))
+            idx.field(fname).import_bits(
+                np.full(cols.size, row, dtype=np.uint64), cols
+            )
+    for row in range(3):
+        cols = np.unique(rng.integers(0, span, density // 2, dtype=np.uint64))
+        idx.field(MUTEX_FIELD).import_bits(
+            np.full(cols.size, row, dtype=np.uint64), cols
+        )
+    cols = np.unique(rng.integers(0, span, density, dtype=np.uint64))
+    idx.field(INT_FIELD).import_value(
+        cols, rng.integers(-50, 51, cols.size)
+    )
+    return idx

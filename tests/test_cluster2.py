@@ -461,3 +461,49 @@ class TestDynamicJoin:
             pre = c.nodes[0].holder.index("pre")
             assert pre is not None and pre.field("pf") is not None
             assert 0 in pre.field("pf").available_shards().to_array().tolist()
+
+
+class TestClusteredGroupByWindow:
+    def test_offset_limit_applied_once_at_coordinator(self):
+        """Remote partials must return untrimmed (capped) group lists;
+        the window applies exactly once at the coordinator (r3 review:
+        double-trim dropped early groups' cross-node counts)."""
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "a")
+            c.create_field("i", "b")
+            # Groups spread across shards owned by BOTH nodes.
+            for s in range(6):
+                base = s * SHARD_WIDTH
+                c.query(0, "i", f"Set({base+1}, a=1) Set({base+1}, b=10)")
+                c.query(0, "i", f"Set({base+2}, a=2) Set({base+2}, b=10)")
+                c.query(0, "i", f"Set({base+3}, a=3) Set({base+3}, b=20)")
+            from pilosa_tpu.exec.result import result_to_json
+
+            full = result_to_json(
+                c.query(0, "i", "GroupBy(Rows(a), Rows(b))")["results"][0]
+            )
+            assert [g["count"] for g in full] == [6, 6, 6]
+            for off in (0, 1, 2):
+                for lim in (1, 2, 3):
+                    got = result_to_json(
+                        c.query(
+                            0, "i",
+                            f"GroupBy(Rows(a), Rows(b), limit={lim}, offset={off})",
+                        )["results"][0]
+                    )
+                    assert got == full[off : off + lim], (off, lim)
+
+    def test_write_fails_when_all_replicas_down(self):
+        with TestCluster(2) as c:  # replica_n=1
+            c.create_index("i")
+            c.create_field("i", "f")
+            topo = c.nodes[0].cluster.topology
+            other = c.nodes[1].node.id
+            shard = next(
+                s for s in range(32) if topo.owns_shard(other, "i", s)
+            )
+            topo.node_by_id(other).state = "DOWN"
+            with pytest.raises(Exception) as ei:
+                c.query(0, "i", f"Set({shard * SHARD_WIDTH + 1}, f=1)")
+            assert "down" in str(ei.value)

@@ -1,0 +1,339 @@
+"""Hardening long tail (VERDICT r2 #7 / missing #4-5):
+
+- codec fuzz: deserialize/apply_ops over truncated and bit-flipped
+  inputs must raise ValueError or parse cleanly — never crash with an
+  unexpected exception type (reference roaring/fuzzer.go).
+- naive differential: a dead-simple set-of-ints bitmap as the trusted
+  reference for randomized op sequences (reference roaring/naive.go).
+- paranoia leg: the roaring suite re-runs in a subprocess with
+  PILOSA_TPU_PARANOIA=1 so the invariant checks actually execute
+  (reference roaringparanoia build tag).
+- subprocess cluster: three REAL server processes on real ports,
+  SIGKILL one mid-load, queries must survive via replicas, and
+  anti-entropy must heal the restarted node (reference
+  internal/clustertests/cluster_test.go:68-92 with pumba).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import Bitmap
+from pilosa_tpu.roaring.codec import apply_ops, deserialize, serialize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _random_bitmap(rng, n=3000, spread=1 << 22) -> Bitmap:
+    b = Bitmap()
+    b.add_many(rng.integers(0, spread, n, dtype=np.uint64), log=False)
+    return b
+
+
+class TestCodecFuzz:
+    def test_truncations_error_cleanly(self, rng):
+        """Every truncation point either raises ValueError or (past the
+        storage region, where the tail is op-log) parses to a bitmap —
+        no IndexError/struct.error/segfault class escapes."""
+        b = _random_bitmap(rng)
+        data = serialize(b)
+        want = b.count()
+        points = sorted(set(rng.integers(0, len(data), 80).tolist()) | {0, 1, 7, 8})
+        for cut in points:
+            try:
+                got = deserialize(data[:cut])
+            except ValueError:
+                continue
+            # Parsed: must be a structurally sound bitmap.
+            assert got.count() <= want
+
+    def test_bitflips_error_or_parse(self, rng):
+        b = _random_bitmap(rng)
+        data = bytearray(serialize(b))
+        for _ in range(300):
+            pos = int(rng.integers(0, len(data)))
+            bit = 1 << int(rng.integers(0, 8))
+            corrupted = bytearray(data)
+            corrupted[pos] ^= bit
+            try:
+                got = deserialize(bytes(corrupted))
+                # Survived: exercise the result; must not blow up.
+                got.count()
+                got.to_array()
+            except ValueError:
+                pass
+
+    def test_oplog_corruption_error_or_clean(self, rng):
+        """apply_ops over random garbage after a valid snapshot must raise
+        ValueError (checksum/shape) or apply cleanly."""
+        b = _random_bitmap(rng, n=500)
+        data = serialize(b)
+        for _ in range(120):
+            garbage = rng.integers(0, 256, int(rng.integers(1, 64)), dtype=np.uint8)
+            blob = data + garbage.tobytes()
+            fresh = deserialize(data)
+            try:
+                apply_ops(fresh, blob, len(data))
+            except ValueError:
+                pass
+
+    def test_hostile_container_counts_bounded(self, rng):
+        """Flipping header bytes (container counts/offsets) must never
+        allocate unboundedly or hang — covered by running the flips over
+        the header region specifically."""
+        b = _random_bitmap(rng, n=100)
+        data = bytearray(serialize(b))
+        header = min(64, len(data))
+        for pos in range(header):
+            for bit in (0x01, 0x80):
+                corrupted = bytearray(data)
+                corrupted[pos] ^= bit
+                try:
+                    deserialize(bytes(corrupted))
+                except ValueError:
+                    pass
+
+
+class NaiveBitmap:
+    """Trusted reference: a plain Python set (reference roaring/naive.go)."""
+
+    def __init__(self):
+        self.s: set[int] = set()
+
+    def add_many(self, vs):
+        self.s.update(int(v) for v in vs)
+
+    def remove_many(self, vs):
+        self.s.difference_update(int(v) for v in vs)
+
+    def count(self):
+        return len(self.s)
+
+    def count_range(self, lo, hi):
+        return sum(1 for v in self.s if lo <= v < hi)
+
+    def to_array(self):
+        return np.array(sorted(self.s), dtype=np.uint64)
+
+
+class TestNaiveDifferential:
+    @pytest.mark.parametrize("seed", [3, 17, 99])
+    def test_random_op_sequences(self, seed):
+        rng = np.random.default_rng(seed)
+        real, naive = Bitmap(), NaiveBitmap()
+        other_real, other_naive = Bitmap(), NaiveBitmap()
+        for vs in (rng.integers(0, 1 << 20, 4000, dtype=np.uint64),):
+            other_real.add_many(vs)
+            other_naive.add_many(vs)
+        for step in range(40):
+            op = int(rng.integers(0, 6))
+            vs = rng.integers(0, 1 << 20, int(rng.integers(1, 800)), dtype=np.uint64)
+            if op == 0:
+                real.add_many(vs)
+                naive.add_many(vs)
+            elif op == 1:
+                real.remove_many(vs)
+                naive.remove_many(vs)
+            elif op == 2:
+                real = real.union(other_real)
+                naive.s = naive.s | other_naive.s
+            elif op == 3:
+                real = real.intersect(other_real)
+                naive.s = naive.s & other_naive.s
+            elif op == 4:
+                real = real.difference(other_real)
+                naive.s = naive.s - other_naive.s
+            else:
+                real = real.xor(other_real)
+                naive.s = naive.s ^ other_naive.s
+            assert real.count() == naive.count(), (seed, step)
+            lo, hi = sorted(rng.integers(0, 1 << 20, 2).tolist())
+            assert real.count_range(lo, hi) == naive.count_range(lo, hi)
+            # Serialize round trip preserves contents exactly.
+            if step % 10 == 0:
+                back = deserialize(serialize(real))
+                np.testing.assert_array_equal(back.to_array(), naive.to_array())
+        np.testing.assert_array_equal(real.to_array(), naive.to_array())
+
+
+class TestParanoiaLeg:
+    def test_roaring_suite_under_paranoia(self):
+        """The invariant checks must actually run against the suite
+        (VERDICT r2 weak #9: the flag existed with zero consumers)."""
+        env = dict(os.environ, PILOSA_TPU_PARANOIA="1", PYTHONPATH=REPO)
+        out = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_roaring.py", "-q",
+             "--no-header", "-x"],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+        # Prove the flag was actually on in that interpreter.
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "from pilosa_tpu.roaring.bitmap import PARANOIA; print(PARANOIA)"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert probe.stdout.strip() == "True"
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(port: int, method: str, path: str, body=None, timeout=10):
+    data = body.encode() if isinstance(body, str) else (
+        json.dumps(body).encode() if body is not None else None
+    )
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method
+    )
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else {}
+
+
+class TestSubprocessCluster:
+    """Real processes, real sockets, real SIGKILL — catches the
+    serialization/lifecycle classes an in-process harness can't
+    (reference internal/clustertests)."""
+
+    N = 3
+
+    def _spawn(self, i, ports, tmp, extra=()):
+        hosts = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            PILOSA_TPU_CLUSTER_HOSTS=hosts,
+            PILOSA_TPU_CLUSTER_REPLICAS=str(self.N),
+            PILOSA_TPU_ANTI_ENTROPY_INTERVAL="1",
+        )
+        return subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", f"{tmp}/node{i}", "-b", f"127.0.0.1:{ports[i]}",
+             "--executor", "cpu", *extra],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+            cwd=REPO,
+        )
+
+    def _wait_ready(self, port, timeout=30):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                _req(port, "GET", "/status", timeout=2)
+                return
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+        raise TimeoutError(f"server on {port} never became ready")
+
+    def test_sigkill_survival_and_heal(self):
+        ports = _free_ports(self.N)
+        tmp = tempfile.mkdtemp(prefix="pilosa-tpu-proctest-")
+        procs = {}
+        try:
+            for i in range(self.N):
+                procs[i] = self._spawn(i, ports, tmp)
+            for p in ports:
+                self._wait_ready(p)
+
+            _req(ports[0], "POST", "/index/i", {})
+            _req(ports[0], "POST", "/index/i/field/f", {})
+            from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+            cols = [s * SHARD_WIDTH + 7 for s in range(4)]
+            _req(ports[0], "POST", "/index/i/query",
+                 " ".join(f"Set({c}, f=1)" for c in cols))
+            out = _req(ports[0], "POST", "/index/i/query", "Count(Row(f=1))")
+            assert out["results"][0] == len(cols)
+
+            # SIGKILL a non-coordinator mid-flight; queries keep working
+            # through replica retry.
+            procs[2].send_signal(signal.SIGKILL)
+            procs[2].wait(timeout=10)
+            out = _req(ports[0], "POST", "/index/i/query", "Count(Row(f=1))",
+                       timeout=30)
+            assert out["results"][0] == len(cols)
+
+            # Wait for the failure detector to mark the node DOWN, then
+            # write — DOWN replicas are skipped, anti-entropy heals them.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                st = _req(ports[0], "GET", "/status")
+                dead = [n for n in st["nodes"] if n["state"] == "DOWN"]
+                if dead:
+                    break
+                time.sleep(0.5)
+            assert dead, "failure detector never marked the killed node DOWN"
+            extra_col = 5 * SHARD_WIDTH + 11
+            _req(ports[0], "POST", "/index/i/query", f"Set({extra_col}, f=1)")
+
+            # Restart the killed node on the same port + data dir;
+            # anti-entropy (interval=1s) must deliver the missed write.
+            procs[2] = self._spawn(2, ports, tmp)
+            self._wait_ready(ports[2])
+
+            # Wait for the heal: node2's LOCAL fragment for the new shard
+            # must appear (checked via the node-local blocks endpoint —
+            # a cluster query would mask missing local data).
+            extra_shard = extra_col // SHARD_WIDTH
+            deadline = time.time() + 45
+            healed = False
+            while time.time() < deadline:
+                try:
+                    _req(ports[2], "GET",
+                         f"/internal/fragment/blocks?index=i&field=f&shard={extra_shard}")
+                    healed = True
+                    break
+                except urllib.error.HTTPError:
+                    time.sleep(0.5)
+            assert healed, "anti-entropy never created the missed fragment"
+
+            # Kill everyone else: only node2's own healed copy can serve.
+            for i in (0, 1):
+                procs[i].send_signal(signal.SIGKILL)
+                procs[i].wait(timeout=10)
+            deadline = time.time() + 45
+            got = None
+            while time.time() < deadline:
+                try:
+                    out = _req(ports[2], "POST", "/index/i/query",
+                               "Count(Row(f=1))", timeout=30)
+                    got = out["results"][0]
+                    if got == len(cols) + 1:
+                        break
+                except (urllib.error.URLError, OSError):
+                    pass
+                time.sleep(1.0)
+            assert got == len(cols) + 1, f"anti-entropy never healed: {got}"
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
