@@ -74,6 +74,9 @@ def cmd_server(args) -> int:
         return cluster
 
     daemons = []
+    from pilosa_tpu.utils.monitor import RuntimeMonitor
+
+    daemons.append(RuntimeMonitor(holder, backend).start())
     join_cluster_ref = None
     if getattr(args, "join", None):
         # Dynamic join (reference gossip join → listenForJoins

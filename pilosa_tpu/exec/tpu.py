@@ -55,7 +55,15 @@ from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.timequantum import parse_time, views_by_time_range
 from pilosa_tpu.core.view import VIEW_STANDARD, bsi_view_name
 from pilosa_tpu.exec.cpu import CPUBackend, QueryError
-from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, _padded_rows, pack_fragment, unpack_row
+from pilosa_tpu.ops.blocks import (
+    ROW_PAD,
+    WORDS_PER_SHARD,
+    _padded_rows,
+    pack_fragment,
+    pack_row,
+    pack_rows,
+    unpack_row,
+)
 from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
@@ -178,6 +186,57 @@ class _StackedBlocks:
         finally:
             with self._lock:
                 self._building.pop(key).set()
+
+    def get_row(self, index: str, field_obj, shards: tuple[int, ...],
+                view_name: str, row_id: int):
+        """[S_pad, 1, W] single-row stack — the on-demand page for fields
+        whose full stack exceeds the HBM budget (VERDICT r2 #8: row
+        paging instead of whole-stack CPU fallback). Cached and
+        LRU-evicted like whole stacks; each entry costs S_pad x 128 KiB."""
+        v = field_obj.view(view_name)
+        fingerprint = (tuple(shards), v.generation if v is not None else -1)
+        key = (index, field_obj.name, view_name, "row", row_id)
+        while True:
+            with self._lock:
+                cached = self._entries.get(key)
+                if cached is not None and cached[0] == fingerprint:
+                    self._entries[key] = self._entries.pop(key)
+                    return cached[1]
+                latch = self._building.get(key)
+                if latch is None:
+                    self._building[key] = threading.Event()
+                    break
+            latch.wait()
+        try:
+            s_pad = self._pad_shards(len(shards))
+            host = np.zeros((s_pad, 1, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, s in enumerate(shards):
+                fr = v.fragment(s) if v is not None else None
+                if fr is not None and row_id <= fr.max_row_id:
+                    host[i, 0] = pack_row(fr, row_id)
+            arr = self._put(host)
+            global_stats.count("hbm_page_uploads_total")
+            global_stats.count("hbm_page_bytes_total", host.nbytes)
+            with self._lock:
+                self._entries.pop(key, None)
+                self._entries[key] = (fingerprint, arr, 1)
+                self._evict(keep=key)
+            return arr
+        finally:
+            with self._lock:
+                self._building.pop(key).set()
+
+    def make_room(self, nbytes: int) -> None:
+        """LRU-evict cached stacks until `nbytes` fits under the budget —
+        used by streaming page sweeps so transient page uploads stay
+        inside max_bytes instead of stacking on top of a full cache."""
+        if self.max_bytes is None:
+            return
+        with self._lock:
+            target = max(0, self.max_bytes - nbytes)
+            while self.resident_bytes() > target and self._entries:
+                self._entries.pop(next(iter(self._entries)))
+                self.evictions += 1
 
     def _evict(self, keep: tuple) -> None:
         if self.max_bytes is None:
@@ -498,7 +557,16 @@ class TPUBackend:
         if "from" in c.args or "to" in c.args:
             return self._build_time_row(index, c, f, row_id, shards, blocks, scalars)
 
-        block, rows_p = self._get_block(index, f, shards)
+        try:
+            block, rows_p = self._get_block(index, f, shards)
+        except _Unsupported:
+            # Row paging: the full stack is over the HBM budget, but one
+            # row always fits — fetch it on demand ([S, 1, W], cached).
+            block = self.blocks.get_row(index, f, shards, VIEW_STANDARD, row_id)
+            blocks.append(block)
+            scalars.append(np.uint32(0))
+            scalars.append(np.uint32(1))
+            return ("R", field_name)
         blocks.append(block)
         scalars.append(np.uint32(min(row_id, rows_p - 1)))
         scalars.append(np.uint32(1 if row_id < rows_p else 0))
@@ -1357,23 +1425,78 @@ class TPUBackend:
                 return None
         block, rp = self.blocks.get(index, f, shards_t)
         if block is None:
-            return None  # over HBM budget: executor uses the 2-pass CPU path
-        s_pad = block.shape[0]
-        reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
-
-        with jax.profiler.TraceAnnotation("pilosa.topn"):
-            if src_call is None:
-                counts = self._program("topn_plain", None, reduce_dev)(block)
-            else:
-                counts = self._program("topn_src", spec, reduce_dev)(
-                    block, blocks, scalars
-                )
-        counts = np.asarray(counts, dtype=np.uint64)
-        if counts.ndim == 2:  # [S, R] partials past the device-sum bound
-            counts = counts.sum(axis=0)
+            # Over the HBM budget: page the row axis through the device
+            # (VERDICT r2 #8) instead of falling back to the CPU path.
+            counts = self._topn_paged_counts(
+                index, f, shards_t,
+                None if src_call is None else (spec, blocks, scalars),
+            )
+        else:
+            s_pad = block.shape[0]
+            reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
+            with jax.profiler.TraceAnnotation("pilosa.topn"):
+                if src_call is None:
+                    counts = self._program("topn_plain", None, reduce_dev)(block)
+                else:
+                    counts = self._program("topn_src", spec, reduce_dev)(
+                        block, blocks, scalars
+                    )
+            counts = np.asarray(counts, dtype=np.uint64)
+            if counts.ndim == 2:  # [S, R] partials past the device-sum bound
+                counts = counts.sum(axis=0)
         order = np.lexsort((np.arange(counts.size), -counts.astype(np.int64)))
         pairs = [Pair(id=int(r), count=int(counts[r])) for r in order if counts[r] > 0]
         return pairs[:n] if n else pairs
+
+    def _topn_paged_counts(
+        self, index: str, f, shards_t: tuple[int, ...], src
+    ) -> np.ndarray:
+        """Streaming per-row popcounts for a field too tall to be
+        HBM-resident: pack fixed-height row pages on the host, upload,
+        popcount (optionally masked by the src tree), accumulate on the
+        host. Two compiled shapes max (page + identical last page via
+        zero-padding); page height sized to half the byte budget."""
+        v = f.view(VIEW_STANDARD)
+        frags = {s: (v.fragment(s) if v is not None else None) for s in shards_t}
+        n_rows = max(
+            [fr.max_row_id + 1 for fr in frags.values() if fr is not None] + [1]
+        )
+        s_pad = self.blocks._pad_shards(len(shards_t))
+        bytes_per_row = s_pad * WORDS_PER_SHARD * 4
+        budget = self.blocks.max_bytes or (1 << 30)
+        page = max(ROW_PAD, (budget // 2) // bytes_per_row // ROW_PAD * ROW_PAD)
+        n_pages = (n_rows + page - 1) // page
+        counts = np.zeros(n_pages * page, dtype=np.uint64)
+        reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
+        # Pages are transient uploads OUTSIDE the stack cache: evict
+        # cached stacks so cache + in-flight pages stay under max_bytes.
+        page_bytes = s_pad * page * WORDS_PER_SHARD * 4
+        self.blocks.make_room(2 * page_bytes)
+        dev = None
+        for start in range(0, n_rows, page):
+            stop = min(start + page, n_rows)
+            host = np.zeros((s_pad, page, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, s in enumerate(shards_t):
+                fr = frags[s]
+                if fr is not None and start <= fr.max_row_id:
+                    host[i, : stop - start] = pack_rows(fr, start, stop)
+            dev = self.blocks._put(host)
+            global_stats.count("hbm_page_uploads_total")
+            global_stats.count("hbm_page_bytes_total", host.nbytes)
+            with jax.profiler.TraceAnnotation("pilosa.topn_page"):
+                if src is None:
+                    out = self._program("topn_plain", None, reduce_dev)(dev)
+                else:
+                    spec, blocks, scalars = src
+                    out = self._program("topn_src", spec, reduce_dev)(
+                        dev, blocks, scalars
+                    )
+            arr = np.asarray(out, dtype=np.uint64)  # readback completes page
+            dev = None  # release before the next upload: 1 page in flight
+            if arr.ndim == 2:
+                arr = arr.sum(axis=0)
+            counts[start : start + page] += arr
+        return counts[:n_rows]
 
     # -- BSI aggregates (device fast path; fragment.go:1111-1268) ----------
 

@@ -78,12 +78,27 @@ def pack_row(frag, row_id: int) -> np.ndarray:
     """One row of a fragment as uint32[WORDS] (the row-paging unit: a
     stack too tall for the HBM budget is served row-by-row instead of
     falling back to the CPU oracle — SURVEY.md §7 hard part (c))."""
-    out = np.zeros(WORDS_PER_SHARD, dtype=np.uint32)
+    return pack_rows(frag, row_id, row_id + 1)[0]
+
+
+def pack_rows(frag, row_start: int, row_end: int) -> np.ndarray:
+    """Rows [row_start, row_end) as uint32[row_end-row_start, WORDS] —
+    one page of a fragment too tall to be fully HBM-resident. Walks only
+    the container-key range of the requested rows (keys are sorted)."""
+    import bisect
+
     storage = frag.storage
-    base_key = row_id * _CONTAINERS_PER_ROW
-    for cidx in range(_CONTAINERS_PER_ROW):
-        c = storage.container(base_key + cidx)
+    arr = np.zeros((row_end - row_start, WORDS_PER_SHARD), dtype=np.uint32)
+    ks = storage.keys()
+    lo = bisect.bisect_left(ks, row_start * _CONTAINERS_PER_ROW)
+    hi = bisect.bisect_left(ks, row_end * _CONTAINERS_PER_ROW)
+    for key in ks[lo:hi]:
+        c = storage.container(key)
         if c is None or c.n == 0:
             continue
-        _scatter_container(out, cidx, c)
-    return out
+        _scatter_container(
+            arr[key // _CONTAINERS_PER_ROW - row_start],
+            key % _CONTAINERS_PER_ROW,
+            c,
+        )
+    return arr

@@ -380,6 +380,15 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.query.get("n", "50"))
         self._reply({"spans": global_tracer.recent(n)})
 
+    @route("GET", r"/debug/diagnostics")
+    def handle_debug_diagnostics(self):
+        """Local diagnostics snapshot (reference diagnostics.go:42-260
+        phone-home payload, served to the operator instead — zero
+        egress)."""
+        from pilosa_tpu.utils.monitor import diagnostics_snapshot
+
+        self._reply(diagnostics_snapshot(self.api.holder))
+
     # -- internal routes (reference http/handler.go:307-318) ---------------
 
     @route("GET", r"/internal/shards/max")

@@ -1,0 +1,135 @@
+"""Process runtime monitor + diagnostics snapshot.
+
+Reference server.go:813-857 (monitorRuntime: heap/GC/goroutine gauges on
+a poll interval, gcnotify/gopsutil) and diagnostics.go:42-260 (hourly
+diagnostics). The TPU build polls the Python/OS equivalents — RSS,
+thread count, open fds, GC collections, uptime — onto the stats
+registry (visible at /metrics), plus device-side gauges (HBM resident
+bytes, eviction count) when a device backend is attached. Diagnostics
+is a local snapshot served at /debug/diagnostics: this environment has
+zero egress, so the reference's phone-home becomes an operator
+endpoint with the same content (version, platform, schema shape,
+uptime) instead of an HTTP POST to a vendor.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import platform
+import threading
+import time
+from typing import Optional
+
+from pilosa_tpu import __version__
+from pilosa_tpu.utils.stats import global_stats
+
+# Single source of process uptime for gauges AND /debug/diagnostics.
+PROCESS_STARTED_AT = time.time()
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+class RuntimeMonitor:
+    """Polls process gauges onto the stats registry (reference
+    monitorRuntime, server.go:813)."""
+
+    def __init__(self, holder=None, backend=None, interval: float = 10.0):
+        self.holder = holder
+        self.backend = backend
+        self.interval = interval
+        self.started_at = PROCESS_STARTED_AT
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> None:
+        s = global_stats
+        s.gauge("runtime_rss_bytes", _rss_bytes())
+        s.gauge("runtime_threads", threading.active_count())
+        s.gauge("runtime_open_fds", _open_fds())
+        s.gauge("runtime_uptime_seconds", time.time() - self.started_at)
+        counts = gc.get_count()
+        s.gauge("runtime_gc_gen0_pending", counts[0])
+        collected = sum(st.get("collected", 0) for st in gc.get_stats())
+        s.gauge("runtime_gc_collected_total", collected)
+        if self.backend is not None:
+            s.gauge("hbm_resident_bytes", self.backend.blocks.resident_bytes())
+            s.gauge("hbm_evictions_total", self.backend.blocks.evictions)
+        if self.holder is not None:
+            for name in list(self.holder.indexes):
+                idx = self.holder.index(name)
+                if idx is None:
+                    continue
+                s.with_tags(f"index:{name}").gauge(
+                    "index_fields", len(idx.fields)
+                )
+                s.with_tags(f"index:{name}").gauge(
+                    "index_available_shards",
+                    int(idx.available_shards().count()),
+                )
+
+    def start(self) -> "RuntimeMonitor":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — gauges must never kill the loop
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def diagnostics_snapshot(holder=None, started_at: Optional[float] = None) -> dict:
+    """The reference's hourly diagnostics payload (diagnostics.go:42-260),
+    served locally instead of phoned home (zero egress here)."""
+    snap = {
+        "version": __version__,
+        "platform": {
+            "os": platform.system(),
+            "arch": platform.machine(),
+            "python": platform.python_version(),
+            "cpus": os.cpu_count(),
+        },
+        "uptime_seconds": round(
+            time.time() - (started_at or PROCESS_STARTED_AT), 1
+        ),
+        "rss_bytes": _rss_bytes(),
+        "threads": threading.active_count(),
+        "open_fds": _open_fds(),
+    }
+    if holder is not None:
+        idx_info = []
+        for name in list(holder.indexes):
+            idx = holder.index(name)
+            if idx is None:
+                continue
+            idx_info.append(
+                {
+                    "name": name,
+                    "fields": len(idx.fields),
+                    "shards": int(idx.available_shards().count()),
+                }
+            )
+        snap["indexes"] = idx_info
+    return snap

@@ -325,3 +325,28 @@ class TestProtobufResponses:
 
             out = decode_query_response(e.read())
             assert "error" in out
+
+
+class TestRuntimeMonitor:
+    def test_gauges_populate(self, server):
+        from pilosa_tpu.utils.monitor import RuntimeMonitor
+        from pilosa_tpu.utils.stats import global_stats
+
+        mon = RuntimeMonitor(server.api.holder)
+        mon.poll_once()
+        text = global_stats.prometheus_text()
+        lines = {
+            l.split()[0]: float(l.split()[1])
+            for l in text.splitlines()
+            if l and not l.startswith("#") and len(l.split()) == 2
+        }
+        assert lines.get("pilosa_runtime_rss_bytes", 0) > 0
+        assert lines.get("pilosa_runtime_threads", 0) >= 1
+        assert lines.get("pilosa_runtime_open_fds", 0) > 0
+
+    def test_diagnostics_endpoint(self, server):
+        out = req(server, "GET", "/debug/diagnostics")
+        assert out["version"]
+        assert out["platform"]["python"]
+        assert out["rss_bytes"] > 0
+        assert "uptime_seconds" in out

@@ -479,6 +479,57 @@ class TestGroupByDevice:
         assert after != before
 
 
+class TestRowPaging:
+    """HBM row paging (VERDICT r2 #8): a field too tall for the byte
+    budget still answers Row/Count/TopN on device via on-demand row
+    fetches and streaming page sweeps — not the CPU oracle."""
+
+    def _tall_field(self, holder, rng, n_rows=2000):
+        idx = holder.create_index("i")
+        idx.create_field("tall")
+        rows = np.arange(n_rows, dtype=np.uint64).repeat(3)
+        cols = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64)
+        idx.field("tall").import_bits(rows, cols)
+        return idx
+
+    def test_row_query_pages_single_row(self, holder, rng):
+        idx = self._tall_field(holder, rng)
+        be = TPUBackend(holder, max_bytes=16 << 20)
+        # The full stack (2000 rows x 128 KiB) exceeds the 16 MiB budget.
+        assert be.blocks.get("i", idx.field("tall"), (0,))[0] is None
+        from pilosa_tpu.pql import parse_string
+
+        for rid in (0, 1500, 1999, 5000):
+            c = parse_string(f"Count(Row(tall={rid}))").calls[0].children[0]
+            want = Executor(holder).backend.count_shard("i", c, 0)
+            assert be.count_shards("i", c, [0]) == want, rid
+        # Combinations of paged rows lower too.
+        c = parse_string("Union(Row(tall=3), Row(tall=1500))").calls[0]
+        want = Executor(holder).backend.count_shard("i", c, 0)
+        assert be.count_shards("i", c, [0]) == want
+
+    def test_topn_paged_matches_oracle(self, holder, rng):
+        self._tall_field(holder, rng)
+        from pilosa_tpu.utils.stats import global_stats
+
+        be = TPUBackend(holder, max_bytes=16 << 20)
+        host = Executor(holder)
+        dev = Executor(holder, backend=be)
+        def uploads() -> float:
+            for line in global_stats.prometheus_text().splitlines():
+                if line.startswith("pilosa_hbm_page_uploads_total"):
+                    return float(line.split()[1])
+            return 0.0
+
+        before = uploads()
+        want = [result_to_json(r) for r in host.execute("i", "TopN(tall, n=10)")]
+        got = [result_to_json(r) for r in dev.execute("i", "TopN(tall, n=10)")]
+        assert got == want
+        # Page traffic from THIS query is observable on /metrics.
+        assert uploads() > before
+        assert "hbm_page_bytes_total" in global_stats.prometheus_text()
+
+
 class TestCountBatcher:
     """exec/batcher.py: cross-request coalescing (VERDICT r2 #2)."""
 
