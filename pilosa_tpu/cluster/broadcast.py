@@ -10,7 +10,6 @@ debuggability; the data plane (imports, fragments) stays binary.
 
 from __future__ import annotations
 
-import json
 import threading
 from typing import Optional, Protocol
 
@@ -39,7 +38,14 @@ EVENT_UPDATE = "update"
 
 
 class Message(dict):
-    """A typed control message; plain dict with a required 'type'."""
+    """A typed control message; plain dict with a required 'type'.
+
+    The wire representation goes through the module serializer seam
+    (reference encoding/proto Serializer, proto.go:29-42): typed binary
+    protobuf frames for registered control messages
+    (cluster/private_wire.py), JSON for unregistered ones, and
+    legacy-JSON sniffing on receive so mixed-version clusters
+    interoperate."""
 
     @staticmethod
     def make(msg_type: str, **fields) -> "Message":
@@ -48,11 +54,37 @@ class Message(dict):
         return m
 
     def to_bytes(self) -> bytes:
-        return json.dumps(self).encode()
+        return _serializer().marshal(self)
 
     @staticmethod
     def from_bytes(data: bytes) -> "Message":
-        return Message(json.loads(data))
+        return Message(_serializer().unmarshal(data))
+
+
+_SERIALIZER = None
+
+
+def _serializer():
+    global _SERIALIZER
+    if _SERIALIZER is None:
+        import os
+
+        from pilosa_tpu.cluster.private_wire import JSONSerializer, ProtoSerializer
+
+        # PILOSA_TPU_CONTROL_WIRE=json keeps frames parseable by
+        # JSON-only peers during a rolling upgrade (see private_wire.py
+        # compatibility notes).
+        if os.environ.get("PILOSA_TPU_CONTROL_WIRE", "").lower() == "json":
+            _SERIALIZER = JSONSerializer()
+        else:
+            _SERIALIZER = ProtoSerializer()
+    return _SERIALIZER
+
+
+def set_serializer(s) -> None:
+    """Swap the control-plane serializer (tests / wire-compat modes)."""
+    global _SERIALIZER
+    _SERIALIZER = s
 
 
 class Broadcaster(Protocol):

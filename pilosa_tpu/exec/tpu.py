@@ -145,6 +145,60 @@ class _StackedBlocks:
         # Keyed by (index, field, view) only: a changed shard set REPLACES
         # the cached stack rather than accumulating per-subset copies in HBM.
         key = (index, field_obj.name, view_name)
+
+        def build():
+            frags = {s: (v.fragment(s) if v is not None else None) for s in shards}
+            n_rows = max(
+                [fr.max_row_id + 1 for fr in frags.values() if fr is not None]
+                + [min_rows]
+            )
+            rows_p = _padded_rows(n_rows)
+            s_pad = self._pad_shards(len(shards))
+            nbytes = s_pad * rows_p * WORDS_PER_SHARD * 4
+            if self.max_bytes is not None and nbytes > self.max_bytes:
+                # Stack can never be resident under the budget: the caller
+                # falls back to row paging or the CPU oracle instead of
+                # blowing HBM. Not cached (None entries are cheap to
+                # recompute and must not evict real stacks).
+                return None, rows_p
+            host = np.zeros((s_pad, rows_p, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, s in enumerate(shards):
+                fr = frags[s]
+                if fr is not None:
+                    host[i] = pack_fragment(fr, n_rows=rows_p)
+            return self._put(host), rows_p
+
+        return self._cached_build(key, fingerprint, build)
+
+    def get_row(self, index: str, field_obj, shards: tuple[int, ...],
+                view_name: str, row_id: int):
+        """[S_pad, 1, W] single-row stack — the on-demand page for fields
+        whose full stack exceeds the HBM budget (VERDICT r2 #8: row
+        paging instead of whole-stack CPU fallback). Cached and
+        LRU-evicted like whole stacks; each entry costs S_pad x 128 KiB."""
+        v = field_obj.view(view_name)
+        fingerprint = (tuple(shards), v.generation if v is not None else -1)
+        key = (index, field_obj.name, view_name, "row", row_id)
+
+        def build():
+            s_pad = self._pad_shards(len(shards))
+            host = np.zeros((s_pad, 1, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, s in enumerate(shards):
+                fr = v.fragment(s) if v is not None else None
+                if fr is not None and row_id <= fr.max_row_id:
+                    host[i, 0] = pack_row(fr, row_id)
+            global_stats.count("hbm_page_uploads_total")
+            global_stats.count("hbm_page_bytes_total", host.nbytes)
+            return self._put(host), 1
+
+        return self._cached_build(key, fingerprint, build)[0]
+
+    def _cached_build(self, key: tuple, fingerprint: tuple, build):
+        """Shared hit/latch/build/evict protocol for stack and row-page
+        entries. build() returns (device_array_or_None, rows_p); a None
+        array means 'cannot be resident' and is returned uncached.
+        Concurrent misses for one key build once (losers wait on the
+        winner's latch, then re-check)."""
         while True:
             with self._lock:
                 cached = self._entries.get(key)
@@ -156,72 +210,18 @@ class _StackedBlocks:
                 if latch is None:
                     self._building[key] = threading.Event()
                     break
-            # Another thread is packing this stack: wait, then re-check —
+            # Another thread is packing this entry: wait, then re-check —
             # its fingerprint usually matches ours (same live fragments).
             latch.wait()
         try:
-            frags = {s: (v.fragment(s) if v is not None else None) for s in shards}
-            n_rows = max(
-                [fr.max_row_id + 1 for fr in frags.values() if fr is not None]
-                + [min_rows]
-            )
-            rows_p = _padded_rows(n_rows)
-            s_pad = self._pad_shards(len(shards))
-            nbytes = s_pad * rows_p * WORDS_PER_SHARD * 4
-            if self.max_bytes is not None and nbytes > self.max_bytes:
-                # Stack can never be resident under the budget: the caller
-                # falls back to the CPU oracle instead of blowing HBM.
+            arr, rows_p = build()
+            if arr is None:
                 return None, rows_p
-            host = np.zeros((s_pad, rows_p, WORDS_PER_SHARD), dtype=np.uint32)
-            for i, s in enumerate(shards):
-                fr = frags[s]
-                if fr is not None:
-                    host[i] = pack_fragment(fr, n_rows=rows_p)
-            arr = self._put(host)
             with self._lock:
                 self._entries.pop(key, None)
                 self._entries[key] = (fingerprint, arr, rows_p)
                 self._evict(keep=key)
             return arr, rows_p
-        finally:
-            with self._lock:
-                self._building.pop(key).set()
-
-    def get_row(self, index: str, field_obj, shards: tuple[int, ...],
-                view_name: str, row_id: int):
-        """[S_pad, 1, W] single-row stack — the on-demand page for fields
-        whose full stack exceeds the HBM budget (VERDICT r2 #8: row
-        paging instead of whole-stack CPU fallback). Cached and
-        LRU-evicted like whole stacks; each entry costs S_pad x 128 KiB."""
-        v = field_obj.view(view_name)
-        fingerprint = (tuple(shards), v.generation if v is not None else -1)
-        key = (index, field_obj.name, view_name, "row", row_id)
-        while True:
-            with self._lock:
-                cached = self._entries.get(key)
-                if cached is not None and cached[0] == fingerprint:
-                    self._entries[key] = self._entries.pop(key)
-                    return cached[1]
-                latch = self._building.get(key)
-                if latch is None:
-                    self._building[key] = threading.Event()
-                    break
-            latch.wait()
-        try:
-            s_pad = self._pad_shards(len(shards))
-            host = np.zeros((s_pad, 1, WORDS_PER_SHARD), dtype=np.uint32)
-            for i, s in enumerate(shards):
-                fr = v.fragment(s) if v is not None else None
-                if fr is not None and row_id <= fr.max_row_id:
-                    host[i, 0] = pack_row(fr, row_id)
-            arr = self._put(host)
-            global_stats.count("hbm_page_uploads_total")
-            global_stats.count("hbm_page_bytes_total", host.nbytes)
-            with self._lock:
-                self._entries.pop(key, None)
-                self._entries[key] = (fingerprint, arr, 1)
-                self._evict(keep=key)
-            return arr
         finally:
             with self._lock:
                 self._building.pop(key).set()
@@ -1464,14 +1464,16 @@ class TPUBackend:
         s_pad = self.blocks._pad_shards(len(shards_t))
         bytes_per_row = s_pad * WORDS_PER_SHARD * 4
         budget = self.blocks.max_bytes or (1 << 30)
-        page = max(ROW_PAD, (budget // 2) // bytes_per_row // ROW_PAD * ROW_PAD)
+        # Quarter-budget pages: the loop holds ONE page in flight, so
+        # cache + page stays within ~1.25x budget even when the cache is
+        # pinned by this query's own src blocks (which make_room cannot
+        # free — they're live references; being MRU they evict last).
+        page = max(ROW_PAD, (budget // 4) // bytes_per_row // ROW_PAD * ROW_PAD)
         n_pages = (n_rows + page - 1) // page
         counts = np.zeros(n_pages * page, dtype=np.uint64)
         reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
-        # Pages are transient uploads OUTSIDE the stack cache: evict
-        # cached stacks so cache + in-flight pages stay under max_bytes.
         page_bytes = s_pad * page * WORDS_PER_SHARD * 4
-        self.blocks.make_room(2 * page_bytes)
+        self.blocks.make_room(page_bytes)
         dev = None
         for start in range(0, n_rows, page):
             stop = min(start + page, n_rows)

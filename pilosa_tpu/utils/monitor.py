@@ -53,6 +53,7 @@ class RuntimeMonitor:
         self.backend = backend
         self.interval = interval
         self.started_at = PROCESS_STARTED_AT
+        self._seen_indexes: set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -70,17 +71,25 @@ class RuntimeMonitor:
             s.gauge("hbm_resident_bytes", self.backend.blocks.resident_bytes())
             s.gauge("hbm_evictions_total", self.backend.blocks.evictions)
         if self.holder is not None:
+            current = set()
             for name in list(self.holder.indexes):
                 idx = self.holder.index(name)
                 if idx is None:
                     continue
-                s.with_tags(f"index:{name}").gauge(
-                    "index_fields", len(idx.fields)
-                )
-                s.with_tags(f"index:{name}").gauge(
+                current.add(name)
+                tagged = s.with_tags(f"index:{name}")
+                tagged.gauge("index_fields", len(idx.fields))
+                tagged.gauge(
                     "index_available_shards",
                     int(idx.available_shards().count()),
                 )
+            # Prune series for deleted indexes; /metrics must not export
+            # a phantom index's last value forever.
+            for name in self._seen_indexes - current:
+                tagged = s.with_tags(f"index:{name}")
+                tagged.remove_gauge("index_fields")
+                tagged.remove_gauge("index_available_shards")
+            self._seen_indexes = current
 
     def start(self) -> "RuntimeMonitor":
         self._thread = threading.Thread(target=self._run, daemon=True)

@@ -49,6 +49,13 @@ class StatsClient:
         with r._lock:
             r._gauges[self._key(name)] = value
 
+    def remove_gauge(self, name: str) -> None:
+        """Drop a gauge series (e.g. a deleted index's per-index gauges —
+        otherwise /metrics exports its last value forever)."""
+        r = self._root
+        with r._lock:
+            r._gauges.pop(self._key(name), None)
+
     def timing(self, name: str, value: float, rate: float = 1.0) -> None:
         r = self._root
         key = self._key(name)
