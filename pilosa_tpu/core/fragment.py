@@ -55,6 +55,63 @@ import itertools
 _fragment_uids = itertools.count(1)
 
 
+class _WalFile:
+    """Lazy, budget-managed WAL append handle.
+
+    The fd opens on first write and registers with the process-wide file
+    budget (utils/syswrap, reference syswrap/os.go:30-60); the budget may
+    call release() from another thread when over the limit, and the next
+    write transparently reopens — append semantics make the handoff safe.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+        self._lock = threading.Lock()
+        self.budget_stamp = 0  # lock-free LRU stamp (syswrap.file_touched)
+
+    def write(self, data: bytes) -> int:
+        from pilosa_tpu.utils import syswrap
+
+        with self._lock:
+            if self._fh is None:
+                # Unbuffered append so each WAL record hits the OS
+                # directly (crash durability without per-record flushes).
+                self._fh = open(self.path, "ab", buffering=0)
+                register = True
+            else:
+                register = False
+            n = self._fh.write(data)
+        # Budget bookkeeping outside self._lock (see syswrap.file_opened
+        # for the lock-order rationale).
+        if register:
+            syswrap.file_opened(self)
+        else:
+            syswrap.file_touched(self)
+        return n
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def release(self) -> None:
+        """Close the fd (budget eviction / snapshot rename) and leave the
+        budget slot; reopens + re-registers on the next write."""
+        from pilosa_tpu.utils import syswrap
+
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+        # Outside self._lock (lock order: holder -> registry, never the
+        # reverse). Idempotent when the evictor already removed us.
+        syswrap.file_closed(self)
+
+    def close(self) -> None:
+        self.release()
+
+
 class Fragment:
     """In-process fragment. Thread-safe for single-writer/multi-reader via a
     coarse lock (the reference uses an RWMutex per fragment, fragment.go:101)."""
@@ -113,9 +170,11 @@ class Fragment:
                 # fragment.go openStorage writes the marshaled bitmap first).
                 with open(self.path, "wb") as f:
                     f.write(serialize(self.storage))
-            # Unbuffered append so each WAL record hits the OS directly
-            # (crash durability without per-record flush syscalls).
-            self._file = open(self.path, "ab", buffering=0)
+            # Lazy, budgeted WAL appender: the fd opens on first write and
+            # the process-wide file budget (utils/syswrap, reference
+            # syswrap/os.go:30-60) can reclaim it — a 100k-fragment holder
+            # must not pin 100k open fds.
+            self._file = _WalFile(self.path)
             self.storage.op_writer = OpWriter(self._file)
             load_cache(self.cache, self.path + CACHE_EXT)
         mx = self.storage.max()
@@ -162,10 +221,10 @@ class Fragment:
                 f.flush()
                 os.fsync(f.fileno())
             if self._file is not None:
-                self._file.close()
+                # Release the fd across the rename; the next WAL write
+                # reopens against the NEW file.
+                self._file.release()
             os.replace(tmp, self.path)
-            self._file = open(self.path, "ab", buffering=0)
-            self.storage.op_writer = OpWriter(self._file)
             self.storage.op_n = 0
 
     # -- mutation ---------------------------------------------------------

@@ -1455,7 +1455,8 @@ class TPUBackend:
         HBM-resident: pack fixed-height row pages on the host, upload,
         popcount (optionally masked by the src tree), accumulate on the
         host. Two compiled shapes max (page + identical last page via
-        zero-padding); page height sized to half the byte budget."""
+        zero-padding); page height sized to a QUARTER of the byte budget
+        (one page in flight + src-pinned cache stays ~within budget)."""
         v = f.view(VIEW_STANDARD)
         frags = {s: (v.fragment(s) if v is not None else None) for s in shards_t}
         n_rows = max(

@@ -50,6 +50,13 @@ def _load() -> ctypes.CDLL | None:
                 lib.pilosa_fnv64a.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
                 lib.pilosa_xxhash64.restype = ctypes.c_uint64
                 lib.pilosa_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+                lib.pilosa_scatter_positions.restype = None
+                lib.pilosa_scatter_positions.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_size_t,
+                    ctypes.c_void_p,
+                    ctypes.c_size_t,
+                ]
                 _lib = lib
                 return _lib
             except Exception:
@@ -95,6 +102,23 @@ def xxhash64(data: bytes, seed: int = 0) -> int:
     # consistent among our own nodes (all nodes agree on which path they use;
     # a native/fallback mixed cluster is not supported).
     return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def scatter_positions(words, base_word: int, pos) -> bool:
+    """OR bit positions (uint16 ndarray) of one array container into a
+    contiguous uint32 word vector at word offset base_word. Returns True
+    when the native path ran; False means the caller must use its
+    numpy fallback (np.bitwise_or.at). The HBM pack hot loop."""
+    lib = _load()
+    if lib is None:
+        return False
+    lib.pilosa_scatter_positions(
+        words.ctypes.data,
+        base_word,
+        pos.ctypes.data,
+        len(pos),
+    )
+    return True
 
 
 def has_native() -> bool:

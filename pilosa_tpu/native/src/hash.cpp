@@ -96,4 +96,16 @@ uint64_t pilosa_xxhash64(const uint8_t* data, size_t n, uint64_t seed) {
     return h;
 }
 
+// Scatter sorted uint16 bit positions of one roaring array container
+// into a dense uint32 word vector (the HBM pack hot loop,
+// pilosa_tpu/ops/blocks.py _scatter_container). Python's fallback is
+// np.bitwise_or.at, an unbuffered ufunc ~50x slower than this loop.
+void pilosa_scatter_positions(uint32_t* words, size_t base_word,
+                              const uint16_t* pos, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        uint16_t p = pos[i];
+        words[base_word + (p >> 5)] |= (1u << (p & 31u));
+    }
+}
+
 }  // extern "C"

@@ -33,17 +33,23 @@ def _padded_rows(n_rows: int) -> int:
 
 def _scatter_container(row_words: np.ndarray, cidx: int, c) -> None:
     """OR one roaring container into a row's word vector at container
-    slot cidx (dense containers memcpy; array containers scatter bits)."""
+    slot cidx (dense containers memcpy; array containers scatter bits —
+    via the native C++ loop when available, np.bitwise_or.at otherwise)."""
     base = cidx * _WORDS_PER_CONTAINER
     if c.typ == "bitmap":
         row_words[base : base + _WORDS_PER_CONTAINER] = c.data.view("<u4")
-    else:
-        pos = c.data.astype(np.uint32)
-        np.bitwise_or.at(
-            row_words,
-            base + (pos >> 5),
-            np.uint32(1) << (pos & np.uint32(31)),
-        )
+        return
+    from pilosa_tpu.native import scatter_positions
+
+    data = np.ascontiguousarray(c.data, dtype=np.uint16)
+    if row_words.flags.c_contiguous and scatter_positions(row_words, base, data):
+        return
+    pos = data.astype(np.uint32)
+    np.bitwise_or.at(
+        row_words,
+        base + (pos >> 5),
+        np.uint32(1) << (pos & np.uint32(31)),
+    )
 
 
 def pack_fragment(frag, n_rows: Optional[int] = None) -> np.ndarray:

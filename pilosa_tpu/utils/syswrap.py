@@ -22,15 +22,70 @@ _map_count = 0
 _max_map_count = DEFAULT_MAX_MAP_COUNT
 _mmap_fallbacks = 0
 
+# File-handle budget (reference syswrap/os.go:30-60: close files over
+# maxFileCount). Long-lived handles — fragment WAL appenders — register
+# here; when the budget is exceeded the least-recently-used holders
+# (by lock-free use stamps) are asked to release() their fds (they
+# reopen lazily on the next write).
+import itertools
+
+_files_lock = threading.Lock()
+_files: dict[int, object] = {}
+_max_file_count = DEFAULT_MAX_FILE_COUNT
+_file_evictions = 0
+_use_counter = itertools.count(1)
+
 
 def set_max_map_count(n: int) -> None:
     global _max_map_count
     _max_map_count = n
 
 
+def set_max_file_count(n: int) -> None:
+    global _max_file_count
+    _max_file_count = n
+
+
+def file_opened(holder) -> None:
+    """Register a budgeted handle holder (must expose release() and a
+    budget_stamp attribute)."""
+    global _file_evictions
+    holder.budget_stamp = next(_use_counter)
+    victims = []
+    with _files_lock:
+        _files[id(holder)] = holder
+        if len(_files) > _max_file_count:
+            over = len(_files) - _max_file_count
+            for v in sorted(_files.values(), key=lambda h: h.budget_stamp)[:over]:
+                _files.pop(id(v), None)
+                victims.append(v)
+                _file_evictions += 1
+    # release() takes the holder's own lock: call OUTSIDE _files_lock so
+    # a concurrent write's acquire (holder lock -> _files_lock) can't
+    # deadlock against this eviction (the opposite order).
+    for v in victims:
+        v.release()
+
+
+def file_touched(holder) -> None:
+    """Lock-free LRU stamp: per-append bookkeeping must not funnel every
+    fragment mutation through one global lock; ordering is derived
+    lazily at eviction time."""
+    holder.budget_stamp = next(_use_counter)
+
+
+def file_closed(holder) -> None:
+    with _files_lock:
+        _files.pop(id(holder), None)
+
+
 def stats() -> dict:
     with _lock:
-        return {"maps": _map_count, "fallbacks": _mmap_fallbacks}
+        out = {"maps": _map_count, "fallbacks": _mmap_fallbacks}
+    with _files_lock:
+        out["open_files"] = len(_files)
+        out["file_evictions"] = _file_evictions
+    return out
 
 
 @contextmanager

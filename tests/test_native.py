@@ -1,0 +1,75 @@
+"""Native C++ helpers + file-handle budget.
+
+The pack hot loop (array-container scatter) runs in C++ when the lazily
+built library is present; outputs must be bit-identical to the numpy
+fallback. The WAL fd budget (reference syswrap/os.go:30-60) must evict
+handles over the limit and transparently reopen on the next write.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.native import has_native, scatter_positions
+from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, pack_fragment
+from pilosa_tpu.utils import syswrap
+
+
+class TestNativeScatter:
+    def test_scatter_matches_numpy(self, rng):
+        if not has_native():
+            pytest.skip("no C++ toolchain")
+        for n in (1, 5, 4096):
+            pos = np.unique(rng.integers(0, 1 << 16, n, dtype=np.uint16))
+            native = np.zeros(2048 + 64, dtype=np.uint32)
+            assert scatter_positions(native, 64, pos)
+            fallback = np.zeros(2048 + 64, dtype=np.uint32)
+            p32 = pos.astype(np.uint32)
+            np.bitwise_or.at(
+                fallback, 64 + (p32 >> 5), np.uint32(1) << (p32 & np.uint32(31))
+            )
+            np.testing.assert_array_equal(native, fallback)
+
+    def test_pack_fragment_uses_scatter(self, rng, tmp_path):
+        """End-to-end: a packed fragment round-trips to the same columns."""
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.ops.blocks import unpack_row
+
+        h = Holder(str(tmp_path / "h")).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        cols = np.unique(rng.integers(0, 1 << 16, 500, dtype=np.uint64))
+        f.import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
+        frag = f.view("standard").fragment(0)
+        words = pack_fragment(frag)
+        np.testing.assert_array_equal(unpack_row(words[0]), cols)
+        h.close()
+
+
+class TestFileBudget:
+    def test_wal_handles_evicted_and_reopen(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        old = syswrap.stats().get("open_files", 0)
+        try:
+            syswrap.set_max_file_count(old + 3)
+            frags = []
+            for i in range(8):
+                fr = Fragment(str(tmp_path / f"frag{i}"), "i", "f", "standard", i).open()
+                fr.set_bit(1, 5)  # opens + registers the WAL fd
+                frags.append(fr)
+            st = syswrap.stats()
+            assert st["open_files"] <= old + 3
+            assert st["file_evictions"] >= 5
+            # An evicted fragment's next write transparently reopens.
+            assert frags[0].set_bit(2, 6)
+            # ... and the record survives a reopen from disk.
+            frags[0].close()
+            back = Fragment(str(tmp_path / "frag0"), "i", "f", "standard", 0).open()
+            assert back.row_count(1) == 1 and back.row_count(2) == 1
+            back.close()
+            for fr in frags[1:]:
+                fr.close()
+        finally:
+            syswrap.set_max_file_count(syswrap.DEFAULT_MAX_FILE_COUNT)
