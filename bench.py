@@ -35,7 +35,6 @@ import json
 import os
 import sys
 import time
-import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -140,34 +139,39 @@ def bench_http(holder, be, queries) -> tuple[float, float]:
     HTTP_QUERIES_PER_REQ Count calls; within a request the executor fuses
     the run, and concurrent requests coalesce through the batcher into
     shared pair-stats dispatches. Returns (qps, single-request p50)."""
+    import http.client
+
     from pilosa_tpu.server.api import API
     from pilosa_tpu.server.http import Server
 
     ex = Executor(holder, backend=be)
-    ex.batcher = CountBatcher(be, window=0.004)
+    ex.batcher = CountBatcher(be, window=0.002)
     srv = Server(API(holder, ex), host="localhost", port=0).open()
-    url = f"http://localhost:{srv.port}/index/bench/query"
+    path = "/index/bench/query"
 
-    def post(body: str) -> list[int]:
-        r = urllib.request.Request(
-            url, data=body.encode(), headers={"Content-Type": "application/json"}
-        )
-        with urllib.request.urlopen(r) as resp:
-            return json.loads(resp.read())["results"]
+    def post(conn, body: str) -> list[int]:
+        # Persistent connection (keep-alive): a per-request TCP connect
+        # costs a round trip AND a fresh server thread per request.
+        conn.request("POST", path, body, {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return json.loads(resp.read())["results"]
 
     per_req = HTTP_QUERIES_PER_REQ
     bodies = ["".join(queries[i : i + per_req]) for i in range(0, len(queries), per_req)]
-    post(bodies[0])  # warm: compile + upload through the serving path
+    warm = http.client.HTTPConnection("localhost", srv.port)
+    post(warm, bodies[0])  # warm: compile + upload through the serving path
 
     counters = [0] * HTTP_CLIENTS
     deadline = time.time() + SECONDS
 
     def client(k: int) -> None:
+        conn = http.client.HTTPConnection("localhost", srv.port)
         j = k
         while time.time() < deadline:
-            post(bodies[j % len(bodies)])
+            post(conn, bodies[j % len(bodies)])
             counters[k] += per_req
             j += 1
+        conn.close()
 
     t0 = time.time()
     with concurrent.futures.ThreadPoolExecutor(HTTP_CLIENTS) as pool:
@@ -178,9 +182,10 @@ def bench_http(holder, be, queries) -> tuple[float, float]:
     lat = []
     for q in queries[: max(5, LATENCY_N // 3)]:
         t0 = time.perf_counter()
-        post(q)
+        post(warm, q)
         lat.append(time.perf_counter() - t0)
     lat.sort()
+    warm.close()
     srv.close()
     return qps, lat[len(lat) // 2]
 

@@ -64,7 +64,10 @@ class View:
         # from a process-global atomic counter: a plain += 1 from two
         # fragments' threads can lose an increment and leave the token
         # equal to a cached fingerprint while data changed underneath.
-        self.generation = 0
+        # Seeded from the counter: pristine views must NOT share a token,
+        # or a deleted-and-recreated field could match a stale cache
+        # fingerprint keyed by (index, field) alone.
+        self.generation = next(_generation_counter)
         # Structure-only callback (fragment create/delete): invalidates
         # the owning field's available-shards cache without paying for it
         # on every data write.

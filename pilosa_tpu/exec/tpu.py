@@ -481,6 +481,10 @@ class TPUBackend:
         # replacing it also drops the strong ref keeping a stale stack
         # alive. Guarded: resolvers run on server worker threads.
         self._pair_cache: dict = {}
+        # Host TopN rank-vector cache: (index, field) -> ((shards, view
+        # generation), counts[R]) — the reference's rank cache idea with
+        # exact device recompute per write epoch (cache.go:136).
+        self._topn_cache: dict = {}
         self._pair_lock = threading.Lock()
         self.stats = global_stats
 
@@ -1423,6 +1427,23 @@ class TPUBackend:
                 spec, blocks, scalars = self._assemble(index, src_call, shards_t)
             except _Unsupported:
                 return None
+        # Host rank-vector cache for the unfiltered case (the reference's
+        # rank cache, cache.go:136, recomputed exactly on device instead
+        # of maintained incrementally): the view generation is the write
+        # epoch, so repeat TopN serves from the host counts vector
+        # without a dispatch.
+        ckey = cfp = None
+        if src_call is None:
+            v = f.view(VIEW_STANDARD)
+            ckey = (index, field_name)
+            cfp = (shards_t, v.generation if v is not None else -1)
+            with self._pair_lock:
+                hit = self._topn_cache.get(ckey)
+            if hit is not None and hit[0] == cfp:
+                # Sort/build OUTSIDE the lock: count_batch resolvers
+                # share it for the pair-stats cache.
+                self.stats.count("topn_cache_hits_total")
+                return self._topn_pairs(hit[1], n)
         block, rp = self.blocks.get(index, f, shards_t)
         if block is None:
             # Over the HBM budget: page the row axis through the device
@@ -1444,6 +1465,15 @@ class TPUBackend:
             counts = np.asarray(counts, dtype=np.uint64)
             if counts.ndim == 2:  # [S, R] partials past the device-sum bound
                 counts = counts.sum(axis=0)
+        if ckey is not None:
+            with self._pair_lock:
+                self._topn_cache[ckey] = (cfp, counts)
+                while len(self._topn_cache) > MAX_PAIR_CACHE_ENTRIES:
+                    self._topn_cache.pop(next(iter(self._topn_cache)))
+        return self._topn_pairs(counts, n)
+
+    @staticmethod
+    def _topn_pairs(counts: np.ndarray, n: int) -> list[Pair]:
         order = np.lexsort((np.arange(counts.size), -counts.astype(np.int64)))
         pairs = [Pair(id=int(r), count=int(counts[r])) for r in order if counts[r] > 0]
         return pairs[:n] if n else pairs

@@ -75,6 +75,17 @@ class Call:
         self.args = args if args is not None else {}
         self.children = children if children is not None else []
 
+    def copy(self) -> "Call":
+        """Structural copy: executors mutate args during key translation,
+        so parse-cache hits must hand out fresh trees. Conditions are
+        immutable post-parse (ops/values never rewritten) and shared;
+        nested Calls in args (GroupBy filter=) are copied."""
+        args = {
+            k: (v.copy() if isinstance(v, Call) else v)
+            for k, v in self.args.items()
+        }
+        return Call(self.name, args, [c.copy() for c in self.children])
+
     # -- typed arg accessors (reference pql/ast.go:297-393) ---------------
 
     def field_arg(self) -> str:
@@ -121,11 +132,7 @@ class Call:
         return list(v), True
 
     def clone(self) -> "Call":
-        return Call(
-            self.name,
-            dict(self.args),
-            [c.clone() for c in self.children],
-        )
+        return self.copy()
 
     def supports_shards(self) -> bool:
         """Whether the call fans out per shard (used by executor option
@@ -184,6 +191,9 @@ class Query:
 
     def __init__(self, calls: Optional[list[Call]] = None):
         self.calls = calls if calls is not None else []
+
+    def copy(self) -> "Query":
+        return Query([c.copy() for c in self.calls])
 
     def write_call_n(self) -> int:
         """Number of mutating calls (reference Query.WriteCallN)."""

@@ -85,6 +85,10 @@ class Server:
 class _Handler(BaseHTTPRequestHandler):
     api: API  # injected per-server subclass
     protocol_version = "HTTP/1.1"
+    # Headers and body go out as separate small writes; without NODELAY
+    # Nagle + the peer's delayed ACK stall every keep-alive response by
+    # ~40 ms — 10x the whole handling cost.
+    disable_nagle_algorithm = True
 
     # quiet default logging
     def log_message(self, fmt, *args):  # noqa: A003
