@@ -389,19 +389,28 @@ class Bitmap:
         vs = np.asarray(vs, dtype=np.uint64)
         if vs.size == 0:
             return 0
-        keys = vs >> np.uint64(16)
-        lows = (vs & np.uint64(0xFFFF)).astype(np.uint16)
-        order = np.argsort(keys, kind="stable")
-        keys, lows = keys[order], lows[order]
+        # ONE global value sort + dedup: keys come out grouped AND each
+        # group's lows sorted+unique, so the per-container O(n log n)
+        # np.unique disappears (import was sort-bound; the reference's
+        # DirectAddN gets pre-sorted input from importPositions too,
+        # fragment.go:2053).
+        sv = np.unique(vs)
+        keys = sv >> np.uint64(16)
+        lows = (sv & np.uint64(0xFFFF)).astype(np.uint16)
         boundaries = np.nonzero(np.diff(keys))[0] + 1
         starts = np.concatenate(([0], boundaries))
         ends = np.concatenate((boundaries, [keys.size]))
         changed = 0
         for s, e in zip(starts, ends):
             key = int(keys[s])
-            chunk = np.unique(lows[s:e])
+            chunk = lows[s:e]
             c = self._cs.get(key)
-            nc = Container.from_positions(chunk) if c is None else c.with_many(chunk)
+            if c is None:
+                # Copy: from_positions would store the slice VIEW, pinning
+                # the whole batch's lows buffer for the container's life.
+                nc = Container.from_positions(chunk.copy())
+            else:
+                nc = c.with_many(chunk)
             changed += nc.n - (c.n if c is not None else 0)
             self._put(key, nc)
         if changed and log and self.op_writer is not None:
@@ -415,10 +424,9 @@ class Bitmap:
         vs = np.asarray(vs, dtype=np.uint64)
         if vs.size == 0:
             return 0
-        keys = vs >> np.uint64(16)
-        lows = (vs & np.uint64(0xFFFF)).astype(np.uint16)
-        order = np.argsort(keys, kind="stable")
-        keys, lows = keys[order], lows[order]
+        sv = np.unique(vs)  # see add_many: grouped keys + sorted lows
+        keys = sv >> np.uint64(16)
+        lows = (sv & np.uint64(0xFFFF)).astype(np.uint16)
         boundaries = np.nonzero(np.diff(keys))[0] + 1
         starts = np.concatenate(([0], boundaries))
         ends = np.concatenate((boundaries, [keys.size]))
@@ -427,7 +435,7 @@ class Bitmap:
             key = int(keys[s])
             c = self._cs.get(key)
             if c is not None:
-                nc = c.without_many(np.unique(lows[s:e]))
+                nc = c.without_many(lows[s:e])
                 changed += c.n - nc.n
                 self._put(key, nc)
         if changed and log and self.op_writer is not None:

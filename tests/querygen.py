@@ -57,8 +57,23 @@ class QueryGenerator:
         children = ", ".join(self.bitmap(depth + 1) for _ in range(n_children))
         return f"{verb}({children})"
 
+    def group_by(self) -> str:
+        fields = list(SET_FIELDS) + [MUTEX_FIELD]
+        n = self._i(1, 4)
+        self.rng.shuffle(fields)
+        rows = ", ".join(f"Rows({f})" for f in fields[:n])
+        extras = []
+        if self._i(0, 2):
+            extras.append(f"filter={self.row_leaf()}")
+        if self._i(0, 2):
+            extras.append(f"limit={self._i(1, 8)}")
+            if self._i(0, 2):
+                extras.append(f"offset={self._i(0, 4)}")
+        tail = (", " + ", ".join(extras)) if extras else ""
+        return f"GroupBy({rows}{tail})"
+
     def query(self) -> str:
-        kind = self._i(0, 10)
+        kind = self._i(0, 11)
         b = self.bitmap()
         if kind < 4:
             return f"Count({b})"
@@ -71,7 +86,9 @@ class QueryGenerator:
             return f"Sum({b}, field={INT_FIELD})"
         if kind == 8:
             return f"Min({b}, field={INT_FIELD})"
-        return f"Max({b}, field={INT_FIELD})"
+        if kind == 9:
+            return f"Max({b}, field={INT_FIELD})"
+        return self.group_by()
 
 
 def build_schema(holder, rng, shards: int = 2, density: int = 1200):
