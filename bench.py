@@ -70,6 +70,14 @@ def build_index(h: Holder):
             rows = np.repeat(np.arange(ROWS, dtype=np.uint64), n_bits)
             cols = rng.integers(0, SHARD_WIDTH, ROWS * n_bits, dtype=np.uint64) + base
             field.import_bits(rows, cols)
+    # Small third field for the 3-field GroupBy measurement (4 rows,
+    # lighter density — the group tensor axis, not the bandwidth load).
+    field = idx.create_field("h")
+    for shard in range(SHARDS):
+        base = shard * SHARD_WIDTH
+        rows = np.repeat(np.arange(4, dtype=np.uint64), n_bits // 4)
+        cols = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64) + base
+        field.import_bits(rows, cols)
     return idx
 
 
@@ -190,6 +198,26 @@ def bench_http(holder, be, queries) -> tuple[float, float]:
     return qps, lat[len(lat) // 2]
 
 
+def bench_group_by(holder, be) -> tuple[float, float]:
+    """3-field GroupBy at the full shape: ONE device program builds the
+    [Rh, Rf, Rg] group-count tensor (VERDICT r2 #4's 'completes in
+    seconds' criterion — the host iterator took minutes here). Cold
+    includes the one-time h-stack pack + program compile; warm is the
+    steady-state dispatch (a write would re-trigger only the sweep)."""
+    ex = Executor(holder, backend=be)
+    t0 = time.perf_counter()
+    res = ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
+    cold = time.perf_counter() - t0
+    assert res and len(res[0]) > 0
+    # Warm = re-dispatch with resident stacks + compiled programs; drop
+    # the tensor cache so this measures the sweep, not a dict hit.
+    be._agg_cache.clear()
+    t0 = time.perf_counter()
+    ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
+    warm = time.perf_counter() - t0
+    return cold, warm
+
+
 def bench_cpu(holder, parsed_queries) -> float:
     """Same pre-parsed queries through the numpy-oracle executor."""
     ex = Executor(holder)
@@ -223,6 +251,7 @@ def main():
     p50, p99 = bench_tpu_single(be, queries)
     topn_p50 = bench_topn(be)
     http_qps, http_p50 = bench_http(h, be, queries)
+    groupby_cold_s, groupby_warm_s = bench_group_by(h, be)
 
     # Correctness cross-check: TPU batch results must equal the CPU oracle.
     ex = Executor(h)
@@ -253,6 +282,8 @@ def main():
                 "single_query_p50_ms": round(p50 * 1e3, 2),
                 "single_query_p99_ms": round(p99 * 1e3, 2),
                 "topn_p50_ms": round(topn_p50 * 1e3, 2),
+                "groupby_3field_cold_s": round(groupby_cold_s, 2),
+                "groupby_3field_warm_ms": round(groupby_warm_s * 1e3, 1),
                 "hbm_read_gbps_direct": round(hbm_gbps, 1),
                 "bytes_touched_per_query_logical": bytes_per_query,
                 "bytes_touched_per_query_physical": sweep_bytes // BATCH,

@@ -485,6 +485,10 @@ class TPUBackend:
         # generation), counts[R]) — the reference's rank cache idea with
         # exact device recompute per write epoch (cache.go:136).
         self._topn_cache: dict = {}
+        # Unfiltered BSI aggregate results (Sum/Min/Max): tiny scalars
+        # cached per (kind, index, field) against the BSI view's write
+        # epoch — same invalidation discipline as the pair/TopN caches.
+        self._agg_cache: dict = {}
         self._pair_lock = threading.Lock()
         self.stats = global_stats
 
@@ -1213,12 +1217,13 @@ class TPUBackend:
     # -- GroupBy device path (VERDICT r2 #4) --------------------------------
 
     def _group_program(self, n: int, filtered: bool):
-        """Stats program for GroupBy over n Rows children (+ optional
+        """Stats program for GroupBy over 1 or 2 Rows children (+ optional
         filter slab): n=1 -> per-row counts [R] (fused XLA reduce), n=2 ->
         pair matrix [Rf, Rg] (the Pallas pair_stats sweep — GroupBy over
-        two Rows IS the pair-count matrix, VERDICT r2 weak #6), n=3 ->
-        [Rh, Rf, Rg] via a lax.scan of pair sweeps over the third field's
-        rows. One output array = one host readback."""
+        two Rows IS the pair-count matrix, VERDICT r2 weak #6). The
+        3-child case composes already-compiled programs instead (see
+        _group3_stats): compiling a Pallas-in-scan mega-program cost ~30 s
+        on real hardware for a one-line win."""
         key = ("groupby", n, filtered)
         with self._fns_lock:
             fn = self._fns.get(key)
@@ -1235,16 +1240,7 @@ class TPUBackend:
                 return jnp.sum(
                     jax.lax.population_count(f).astype(jnp.int32), axis=(0, 2)
                 )
-            g = stacks[1]
-            if n == 2:
-                return pair_stats(f, g, interpret=interpret)[0]
-            h = stacks[2]
-
-            def step(_, h_c):  # h_c: [S, W] — one row of the third field
-                return None, pair_stats(f & h_c[:, None, :], g, interpret=interpret)[0]
-
-            _, tri = jax.lax.scan(step, None, jnp.moveaxis(h, 1, 0))
-            return tri  # [Rh, Rf, Rg]
+            return pair_stats(f, stacks[1], interpret=interpret)[0]
 
         if self.mesh is None:
             fn = jax.jit(stats)
@@ -1267,6 +1263,57 @@ class TPUBackend:
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
+
+    def _and_h_program(self, filtered: bool):
+        """Tiny elementwise program: f & h_row (& filter) — the per-row
+        prefilter feeding the shared pair program in _group3_stats."""
+        key = ("groupby_and", filtered)
+        with self._fns_lock:
+            fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+
+        def body(f, hc, *rest):
+            out = f & hc[:, None, :]
+            if filtered:
+                out = out & rest[0][:, None, :]
+            return out
+
+        if self.mesh is None:
+            fn = jax.jit(body)
+        else:
+            n_in = 2 + (1 if filtered else 0)
+            fn = jax.jit(
+                shard_map(
+                    body,
+                    mesh=self.mesh.mesh,
+                    in_specs=(P(self.mesh.axis),) * n_in,
+                    out_specs=P(self.mesh.axis),
+                )
+            )
+        with self._fns_lock:
+            fn = self._fns.setdefault(key, fn)
+        return fn
+
+    def _group3_stats(self, f, g, h, filt) -> np.ndarray:
+        """[Rh, Rf, Rg] group tensor by composing compiled programs: for
+        each row of the third field, AND it into f (tiny elementwise
+        program) and run the SAME pair_stats program the Count path
+        compiled — all rows dispatched before any readback so the
+        sweeps pipeline past the relay round trips."""
+        rf, rg, rh = f.shape[1], g.shape[1], h.shape[1]
+        and_h = self._and_h_program(filt is not None)
+        pair = self._pair_program()
+        flats = []
+        for c in range(rh):
+            hc = h[:, c, :]
+            fb = and_h(f, hc, filt) if filt is not None else and_h(f, hc)
+            flats.append(pair(fb, g))
+        out = np.zeros((rh, rf, rg), dtype=np.int64)
+        for c, fl in enumerate(flats):
+            arr = np.asarray(fl)
+            out[c] = arr[: rf * rg].reshape(rf, rg)
+        return out
 
     def group_by(self, index, c: Call, filter_call, child_rows, shards) -> Optional[list]:
         """Whole-query GroupBy: ONE device program computes the full
@@ -1292,6 +1339,23 @@ class TPUBackend:
             fields.append((fname, f_obj))
             prev, has_prev = child.uint64_arg("previous")
             starts.append(prev + 1 if has_prev else 0)
+        # Group-tensor cache (unfiltered): the stats do not depend on
+        # candidate restrictions (limit/column/previous filter only the
+        # host enumeration), so the write epoch of the child views keys
+        # a reusable tensor — same discipline as the pair/TopN caches.
+        # Fingerprint captured BEFORE the stack fetch: a write racing
+        # this query must yield a never-matching entry, not a stale one.
+        ckey = cfp = hit = None
+        if filter_call is None:
+            ckey = ("groupby", index, tuple(fname for fname, _ in fields))
+            cfp = (
+                shards_t,
+                tuple(
+                    (fo.view(VIEW_STANDARD).generation
+                     if fo.view(VIEW_STANDARD) is not None else -1)
+                    for _, fo in fields
+                ),
+            )
         try:
             stacks = [self._get_block(index, fo, shards_t)[0] for _, fo in fields]
             filt = None
@@ -1305,9 +1369,30 @@ class TPUBackend:
         rs = [s.shape[1] for s in stacks]
         if int(np.prod(rs)) > (1 << 16):
             return None
-        args = tuple(stacks) + ((filt,) if filt is not None else ())
-        with jax.profiler.TraceAnnotation("pilosa.group_by"):
-            stats_np = np.asarray(self._group_program(n, filt is not None)(*args))
+        if ckey is not None:
+            with self._pair_lock:
+                hit = self._agg_cache.get(ckey)
+            if hit is not None and hit[0] == cfp:
+                self.stats.count("agg_cache_hits_total")
+                stats_np = hit[1]
+            else:
+                hit = None
+        if hit is None:
+            with jax.profiler.TraceAnnotation("pilosa.group_by"):
+                if n == 3:
+                    stats_np = self._group3_stats(
+                        stacks[0], stacks[1], stacks[2], filt
+                    )
+                else:
+                    args = tuple(stacks) + ((filt,) if filt is not None else ())
+                    stats_np = np.asarray(
+                        self._group_program(n, filt is not None)(*args)
+                    )
+            if ckey is not None:
+                with self._pair_lock:
+                    self._agg_cache[ckey] = (cfp, stats_np)
+                    while len(self._agg_cache) > MAX_PAIR_CACHE_ENTRIES:
+                        self._agg_cache.pop(next(iter(self._agg_cache)))
         cand = []
         for i in range(n):
             if child_rows[i] is not None:
@@ -1558,6 +1643,11 @@ class TPUBackend:
         """Distributed Sum(field): per-plane popcounts fused on device
         (+psum over ICI with a mesh), exact host weighting. Returns
         (sum, count) or None when not lowerable."""
+        # Fingerprint BEFORE the data snapshot: a write racing this query
+        # must produce a never-matching cache entry, never a stale serve.
+        hit = self._agg_lookup("sum", index, field_name, shards, filter_call)
+        if hit is not None and hit[1] is not None:
+            return hit[1]
         try:
             f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
                 index, field_name, shards, filter_call
@@ -1575,7 +1665,38 @@ class TPUBackend:
         neg_c = np.asarray(neg_c, dtype=np.uint64)
         total = sum((int(pos_c[i]) - int(neg_c[i])) << i for i in range(depth))
         count = int(cnt)
-        return total + opts.base * count, count
+        result = (total + opts.base * count, count)
+        if hit is not None:
+            self._agg_store("sum", index, field_name, hit[0], result)
+        return result
+
+    def _agg_fingerprint(self, index, field_name, shards):
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx else None
+        v = f.view(bsi_view_name(field_name)) if f is not None else None
+        return (tuple(shards), v.generation if v is not None else -1)
+
+    def _agg_lookup(self, kind, index, field_name, shards, filter_call):
+        """(fingerprint, result) cache hit for an UNFILTERED aggregate,
+        else None (filtered aggregates depend on other fields' epochs).
+        The returned fingerprint is captured BEFORE any sweep so a write
+        racing the compute can only produce a never-matching entry,
+        never a stale serve — pass it unchanged to _agg_store."""
+        if filter_call is not None:
+            return None
+        cfp = self._agg_fingerprint(index, field_name, shards)
+        with self._pair_lock:
+            hit = self._agg_cache.get((kind, index, field_name))
+        if hit is not None and hit[0] == cfp:
+            self.stats.count("agg_cache_hits_total")
+            return hit
+        return (cfp, None)
+
+    def _agg_store(self, kind, index, field_name, cfp, result):
+        with self._pair_lock:
+            self._agg_cache[(kind, index, field_name)] = (cfp, result)
+            while len(self._agg_cache) > MAX_PAIR_CACHE_ENTRIES:
+                self._agg_cache.pop(next(iter(self._agg_cache)))
 
     def bsi_min(self, index, field_name, shards, filter_call=None):
         return self._bsi_minmax("bsi_min", index, field_name, shards, filter_call)
@@ -1587,6 +1708,10 @@ class TPUBackend:
         """Per-shard Min/Max via plane narrowing with on-device selects (no
         host sync inside the scan), host reduce across shards with the
         executor's tie semantics. Returns (val, count) or None."""
+        # Fingerprint BEFORE the data snapshot (see bsi_sum).
+        hit = self._agg_lookup(kind, index, field_name, shards, filter_call)
+        if hit is not None and hit[1] is not None:
+            return hit[1]
         try:
             f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
                 index, field_name, shards, filter_call
@@ -1635,4 +1760,6 @@ class TPUBackend:
                 best_val, best_cnt = val, cnt
             elif val == best_val:
                 best_cnt += cnt
+        if hit is not None:
+            self._agg_store(kind, index, field_name, hit[0], (best_val, best_cnt))
         return best_val, best_cnt

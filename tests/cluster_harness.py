@@ -18,11 +18,12 @@ from pilosa_tpu.server.http import Server
 
 
 class ClusterNode:
-    def __init__(self, i: int, data_dir: str):
+    def __init__(self, i: int, data_dir: str, backend_factory=None):
         self.i = i
         self.data_dir = data_dir
         self.holder = Holder(data_dir).open()
-        self.executor = Executor(self.holder)
+        backend = backend_factory(i, self.holder) if backend_factory else None
+        self.executor = Executor(self.holder, backend=backend)
         self.api = API(self.holder, self.executor)
         self.server = Server(self.api, host="127.0.0.1", port=0).open()
         self.node = Node(
@@ -42,13 +43,14 @@ class TestCluster:
 
     __test__ = False  # not a pytest class
 
-    def __init__(self, n: int, replica_n: int = 1, hasher=None):
+    def __init__(self, n: int, replica_n: int = 1, hasher=None, backend_factory=None):
         self._tmp = tempfile.mkdtemp(prefix="pilosa-tpu-cluster-")
         self._replica_n = replica_n
         self._hasher = hasher or JmpHasher()
         self._next_i = n
         self.nodes: list[ClusterNode] = [
-            ClusterNode(i, f"{self._tmp}/node{i}") for i in range(n)
+            ClusterNode(i, f"{self._tmp}/node{i}", backend_factory=backend_factory)
+            for i in range(n)
         ]
         members = [cn.node for cn in self.nodes]
         for cn in self.nodes:

@@ -479,6 +479,36 @@ class TestGroupByDevice:
         assert after != before
 
 
+class TestAggCache:
+    """Unfiltered Sum/Min/Max results cache against the BSI view's write
+    epoch and must invalidate on writes."""
+
+    def test_hit_and_invalidation(self, holder, rng):
+        idx = holder.create_index("i")
+        idx.create_field("v", options_for_int(-100, 100))
+        cols = np.unique(rng.integers(0, SHARD_WIDTH, 400, dtype=np.uint64))
+        vals = rng.integers(-100, 101, cols.size)
+        idx.field("v").import_value(cols, vals)
+        be = TPUBackend(holder)
+        first = be.bsi_sum("i", "v", [0])
+        assert first is not None
+        assert be.bsi_sum("i", "v", [0]) == first  # cache hit
+        assert len(be._agg_cache) == 1
+        mn, mx = be.bsi_min("i", "v", [0]), be.bsi_max("i", "v", [0])
+        # Oracle agreement.
+        want_sum = Executor(holder).execute("i", "Sum(field=v)")[0]
+        assert first == (want_sum.val, want_sum.count)
+        # A new value invalidates: sum/min/max all change deterministically.
+        free_col = int(cols.max()) + 1
+        idx.field("v").set_value(free_col, -100)
+        after = be.bsi_sum("i", "v", [0])
+        assert after == (first[0] - 100, first[1] + 1)
+        assert be.bsi_min("i", "v", [0])[0] == -100
+        want_max = Executor(holder).execute("i", "Max(field=v)")[0]
+        assert be.bsi_max("i", "v", [0]) == (want_max.val, want_max.count)
+        assert (mn, mx) != (None, None)
+
+
 class TestRowPaging:
     """HBM row paging (VERDICT r2 #8): a field too tall for the byte
     budget still answers Row/Count/TopN on device via on-demand row
