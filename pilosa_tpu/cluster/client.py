@@ -20,9 +20,12 @@ from pilosa_tpu.utils.tracing import global_tracer
 
 
 class ClientError(Exception):
-    def __init__(self, msg: str, status: int = 0):
+    def __init__(self, msg: str, status: int = 0, code: str = ""):
         super().__init__(msg)
         self.status = status
+        # Machine-readable error class from the peer's JSON error body
+        # (e.g. "not-found"); empty when the body carried none.
+        self.code = code
 
 
 def _uri_str(uri: Union[URI, Node, str]) -> str:
@@ -78,12 +81,16 @@ class InternalClient:
                 data = resp.read()
         except urllib.error.HTTPError as e:
             detail = ""
+            err_code = ""
             try:
                 detail = e.read().decode("utf-8", "replace")
+                err_code = json.loads(detail).get("code", "")
             except Exception:
                 pass
             raise ClientError(
-                f"{method} {url}: status {e.code}: {detail}", status=e.code
+                f"{method} {url}: status {e.code}: {detail}",
+                status=e.code,
+                code=err_code,
             ) from e
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             raise ClientError(f"{method} {url}: {e}") from e

@@ -331,19 +331,22 @@ class Cluster:
                 node, index, c.to_string(), shards=shards, remote=True
             )
         except ClientError as e:
-            # A peer that missed a DDL broadcast answers "not found": push
-            # it the schema and retry once (ADVICE r1: pull schema on
+            # A peer that missed a DDL broadcast answers code=not-found:
+            # push it the schema and retry once (ADVICE r1: pull schema on
             # NotFound instead of failing until anti-entropy). At most one
             # repair attempt per (node, index): a genuinely nonexistent
             # field otherwise costs a schema push + duplicate remote
-            # execution on EVERY query (ADVICE r2).
+            # execution on EVERY query (ADVICE r2). The structured error
+            # code replaces substring matching (ADVICE r2 #4): an
+            # unrelated error merely containing 'not found' can no longer
+            # trigger a repair storm.
             repair_key = (node.id, index)
             last = self._repair_attempted.get(repair_key)
             throttled = (
                 last is not None
                 and time.monotonic() - last < self.repair_retry_interval
             )
-            if "not found" not in str(e) or throttled:
+            if getattr(e, "code", "") != "not-found" or throttled:
                 raise
             self._repair_attempted[repair_key] = time.monotonic()
             self._push_state_to(node, index)

@@ -24,6 +24,14 @@ class QueryError(Exception):
     pass
 
 
+class NotFoundError(QueryError):
+    """Index/field genuinely absent. Distinguished structurally so the
+    cluster's missed-DDL repair can tell 'peer lacks schema' apart from
+    'object does not exist' without string matching (ADVICE r2 #4); the
+    HTTP error body carries code='not-found' while the status stays the
+    reference's 400."""
+
+
 class CPUBackend:
     def __init__(self, holder):
         self.holder = holder
@@ -33,13 +41,13 @@ class CPUBackend:
     def _index(self, index: str):
         idx = self.holder.index(index)
         if idx is None:
-            raise QueryError(f"index not found: {index}")
+            raise NotFoundError(f"index not found: {index}")
         return idx
 
     def _field(self, index: str, name: str):
         f = self._index(index).field(name)
         if f is None:
-            raise QueryError(f"field not found: {name}")
+            raise NotFoundError(f"field not found: {name}")
         return f
 
     def _fragment(self, index: str, field: str, view: str, shard: int):

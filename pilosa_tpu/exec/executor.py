@@ -23,7 +23,7 @@ from pilosa_tpu.core.index import EXISTENCE_FIELD_NAME
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.timequantum import parse_time, views_by_time_range
 from pilosa_tpu.core.view import VIEW_STANDARD
-from pilosa_tpu.exec.cpu import CPUBackend, QueryError
+from pilosa_tpu.exec.cpu import CPUBackend, NotFoundError, QueryError
 from pilosa_tpu.exec.result import (
     FieldRow,
     GroupCount,
@@ -94,7 +94,7 @@ class Executor:
             query = parse_string(query)
         idx = self.holder.index(index)
         if idx is None:
-            raise QueryError(f"index not found: {index}")
+            raise NotFoundError(f"index not found: {index}")
         if opt.shards:
             shards = list(opt.shards)
 
@@ -377,7 +377,7 @@ class Executor:
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx else None
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         return f
 
     def _execute_sum(self, index, c, shards, opt) -> ValCount:
@@ -464,7 +464,7 @@ class Executor:
         idx = self.holder.index(index)
         f = idx.field(field_name)
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         v = f.view(VIEW_STANDARD)
         return v.fragment(shard) if v is not None else None
 
@@ -564,7 +564,7 @@ class Executor:
             idx = self.holder.index(index)
             f = idx.field(field_name)
             if f is None:
-                raise QueryError(f"field not found: {field_name}")
+                raise NotFoundError(f"field not found: {field_name}")
             src = self._filter_row_shard(index, c, shard)
             # With explicit ids (pass 2) or a src filter, never trim per
             # shard — a local top-n would drop cross-shard count
@@ -612,7 +612,7 @@ class Executor:
         idx = self.holder.index(index)
         f = idx.field(field_name)
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         views = [VIEW_STANDARD]
         if f.options.type == FIELD_TYPE_TIME:
             from_t = parse_time(c.args["from"]) if "from" in c.args else None
@@ -804,7 +804,7 @@ class Executor:
         idx = self.holder.index(index)
         f = idx.field(field_name)
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
 
         # Track column existence (reference executor.go:2101-2106).
         ef = idx.existence_field()
@@ -844,7 +844,7 @@ class Executor:
         idx = self.holder.index(index)
         f = idx.field(field_name)
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         if f.options.type == FIELD_TYPE_INT:
             frag = f._bsi_fragment(col_id // SHARD_WIDTH)
             if frag is None:
@@ -860,7 +860,7 @@ class Executor:
         idx = self.holder.index(index)
         f = idx.field(field_name)
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         if f.options.type not in ("set", "time", "mutex", "bool"):
             raise QueryError(f"ClearRow() is not supported on {f.options.type} fields")
         row_id, ok = c.uint64_arg(field_name)
@@ -917,7 +917,7 @@ class Executor:
         idx = self.holder.index(index)
         f = idx.field(field_name)
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         row_id, ok = c.uint64_arg("_row")
         if not ok:
             raise QueryError("SetRowAttrs() row argument required")

@@ -54,7 +54,7 @@ from pilosa_tpu.core.fragment import BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BI
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.timequantum import parse_time, views_by_time_range
 from pilosa_tpu.core.view import VIEW_STANDARD, bsi_view_name
-from pilosa_tpu.exec.cpu import CPUBackend, QueryError
+from pilosa_tpu.exec.cpu import CPUBackend, NotFoundError, QueryError
 from pilosa_tpu.ops.blocks import (
     ROW_PAD,
     WORDS_PER_SHARD,
@@ -506,7 +506,7 @@ class TPUBackend:
         idx = self.holder.index(index)
         f = idx.field(name) if idx else None
         if f is None:
-            raise QueryError(f"field not found: {name}")
+            raise NotFoundError(f"field not found: {name}")
         return f
 
     def _build(self, index: str, c: Call, shards: tuple[int, ...],
@@ -1372,6 +1372,8 @@ class TPUBackend:
         if ckey is not None:
             with self._pair_lock:
                 hit = self._agg_cache.get(ckey)
+                if hit is not None and hit[0] == cfp:
+                    self._agg_cache[ckey] = self._agg_cache.pop(ckey)  # LRU
             if hit is not None and hit[0] == cfp:
                 self.stats.count("agg_cache_hits_total")
                 stats_np = hit[1]
@@ -1503,7 +1505,7 @@ class TPUBackend:
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx else None
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         if f.view(VIEW_STANDARD) is None:
             return []
         shards_t = tuple(shards)
@@ -1622,7 +1624,7 @@ class TPUBackend:
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx else None
         if f is None:
-            raise QueryError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         if f.options.type != FIELD_TYPE_INT:
             raise _Unsupported("not an int field")
         opts = f.bsi_group()
@@ -1687,6 +1689,12 @@ class TPUBackend:
         cfp = self._agg_fingerprint(index, field_name, shards)
         with self._pair_lock:
             hit = self._agg_cache.get((kind, index, field_name))
+            if hit is not None and hit[0] == cfp:
+                # LRU touch (mirrors the pair cache): hot aggregates must
+                # outlive cold entries under the shared cap.
+                self._agg_cache[(kind, index, field_name)] = self._agg_cache.pop(
+                    (kind, index, field_name)
+                )
         if hit is not None and hit[0] == cfp:
             self.stats.count("agg_cache_hits_total")
             return hit

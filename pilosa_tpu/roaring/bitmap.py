@@ -63,6 +63,14 @@ class Container:
 
     def __init__(self, typ: str, data: np.ndarray, n: Optional[int] = None):
         self.typ = typ
+        if PARANOIA and isinstance(data, np.ndarray):
+            # Sentinel mode (reference roaringsentinel build tag,
+            # roaring_sentinel.go): containers are immutable-by-convention
+            # and structurally shared by clones/snapshots; freezing the
+            # array makes any accidental in-place mutation raise instead
+            # of silently corrupting every sharer.
+            data = data.view()
+            data.flags.writeable = False
         self.data = data
         if n is None:
             if typ == TYPE_ARRAY:

@@ -24,14 +24,20 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
 class APIError(Exception):
-    def __init__(self, msg: str, status: int = 400):
+    def __init__(self, msg: str, status: int = 400, code: str = ""):
         super().__init__(msg)
         self.status = status
+        # Machine-readable error class carried in the JSON body (additive
+        # — the HTTP status stays reference-compatible). "not-found" lets
+        # the cluster's missed-DDL repair distinguish a genuinely absent
+        # index/field from a peer that lacks schema, without string
+        # matching (ADVICE r2 #4).
+        self.code = code
 
 
 class NotFoundError(APIError):
     def __init__(self, msg: str):
-        super().__init__(msg, status=404)
+        super().__init__(msg, status=404, code="not-found")
 
 
 class ConflictError(APIError):
@@ -85,8 +91,12 @@ class API:
         from pilosa_tpu.cluster.client import ClientError
         from pilosa_tpu.cluster.cluster import ShardUnavailableError
 
+        from pilosa_tpu.exec.cpu import NotFoundError as ExecNotFound
+
         try:
             results = self.executor.execute(index, query, shards=shards, opt=opt)
+        except ExecNotFound as e:
+            raise APIError(str(e), code="not-found") from e
         except (ParseError, QueryError, ValueError) as e:
             raise APIError(str(e)) from e
         except ShardUnavailableError as e:

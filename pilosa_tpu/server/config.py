@@ -152,6 +152,7 @@ class Config:
             f"max-writes-per-request = {c.max_writes_per_request}\n"
             f"verbose = {str(c.verbose).lower()}\n"
             f"long-query-time = {c.long_query_time}\n"
+            f"batch-window = {c.batch_window}\n"
             "\n[anti-entropy]\n"
             f"interval = {c.anti_entropy_interval}\n"
             "\n[metric]\n"

@@ -125,8 +125,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, msg: str, status: int = 400) -> None:
-        self._reply({"error": msg}, status=status)
+    def _error(self, msg: str, status: int = 400, code: str = "") -> None:
+        body = {"error": msg}
+        if code:
+            body["code"] = code
+        self._reply(body, status=status)
 
     def _dispatch(self, method: str) -> None:
         parsed = urlparse(self.path)
@@ -155,7 +158,9 @@ class _Handler(BaseHTTPRequestHandler):
                         getattr(self, fn_name)(**match.groupdict())
                 except APIError as e:
                     stats.count("http_request_errors_total")
-                    self._error(str(e), status=e.status)
+                    self._error(
+                        str(e), status=e.status, code=getattr(e, "code", "")
+                    )
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # mirror the reference's panic trap

@@ -47,6 +47,7 @@ class TestCluster:
         self._tmp = tempfile.mkdtemp(prefix="pilosa-tpu-cluster-")
         self._replica_n = replica_n
         self._hasher = hasher or JmpHasher()
+        self._backend_factory = backend_factory
         self._next_i = n
         self.nodes: list[ClusterNode] = [
             ClusterNode(i, f"{self._tmp}/node{i}", backend_factory=backend_factory)
@@ -76,7 +77,9 @@ class TestCluster:
         real topology from the resize instruction)."""
         i = self._next_i
         self._next_i += 1
-        cn = ClusterNode(i, f"{self._tmp}/node{i}")
+        cn = ClusterNode(
+            i, f"{self._tmp}/node{i}", backend_factory=self._backend_factory
+        )
         cn.node.is_coordinator = False
         self._wire(cn, [cn.node])
         self.nodes.append(cn)

@@ -337,3 +337,26 @@ class TestSubprocessCluster:
                 if p.poll() is None:
                     p.kill()
                     p.wait(timeout=10)
+
+
+class TestSentinelMode:
+    def test_containers_frozen_under_paranoia(self):
+        """Sentinel analog (reference roaringsentinel): under
+        PILOSA_TPU_PARANOIA=1, in-place mutation of a shared container
+        array raises instead of corrupting every structural sharer."""
+        env = dict(os.environ, PILOSA_TPU_PARANOIA="1", PYTHONPATH=REPO)
+        probe = subprocess.run(
+            [sys.executable, "-c", (
+                "import numpy as np\n"
+                "from pilosa_tpu.roaring import Bitmap\n"
+                "b = Bitmap([1, 2, 3])\n"
+                "c = b.container(0)\n"
+                "try:\n"
+                "    c.data[0] = 99\n"
+                "    print('MUTATED')\n"
+                "except ValueError:\n"
+                "    print('FROZEN')\n"
+            )],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert probe.stdout.strip() == "FROZEN", probe.stdout + probe.stderr

@@ -436,6 +436,7 @@ class TestGroupByDevice:
         "GroupBy(Rows(a))",
         "GroupBy(Rows(a), Rows(b))",
         "GroupBy(Rows(a), Rows(b), Rows(c))",
+        "GroupBy(Rows(a), Rows(b), Rows(c), filter=Row(a=1))",
         "GroupBy(Rows(a), Rows(b), filter=Row(c=1))",
         "GroupBy(Rows(a), filter=Row(b=2))",
         "GroupBy(Rows(a), Rows(b), limit=3)",
