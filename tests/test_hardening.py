@@ -216,10 +216,8 @@ def _req(port: int, method: str, path: str, body=None, timeout=10):
     return json.loads(raw) if raw else {}
 
 
-class TestSubprocessCluster:
-    """Real processes, real sockets, real SIGKILL — catches the
-    serialization/lifecycle classes an in-process harness can't
-    (reference internal/clustertests)."""
+class _ProcHarness:
+    """Shared multi-process helpers (real servers, real ports)."""
 
     N = 3
 
@@ -252,6 +250,19 @@ class TestSubprocessCluster:
             except (urllib.error.URLError, OSError):
                 time.sleep(0.2)
         raise TimeoutError(f"server on {port} never became ready")
+
+    @staticmethod
+    def _kill_all(procs) -> None:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+class TestSubprocessCluster(_ProcHarness):
+    """Real processes, real sockets, real SIGKILL — catches the
+    serialization/lifecycle classes an in-process harness can't
+    (reference internal/clustertests)."""
 
     def test_sigkill_survival_and_heal(self):
         ports = _free_ports(self.N)
@@ -333,10 +344,7 @@ class TestSubprocessCluster:
                 time.sleep(1.0)
             assert got == len(cols) + 1, f"anti-entropy never healed: {got}"
         finally:
-            for p in procs.values():
-                if p.poll() is None:
-                    p.kill()
-                    p.wait(timeout=10)
+            self._kill_all(procs)
 
 
 class TestSentinelMode:
@@ -360,3 +368,66 @@ class TestSentinelMode:
             cwd=REPO, env=env, capture_output=True, text=True, timeout=60,
         )
         assert probe.stdout.strip() == "FROZEN", probe.stdout + probe.stderr
+
+
+class TestSubprocessJoin(_ProcHarness):
+    """The REAL `server --join` path: a fourth process announces to a
+    live cluster and becomes a serving member with no operator call."""
+
+    def test_cli_join(self):
+        ports = _free_ports(self.N + 1)
+        tmp = tempfile.mkdtemp(prefix="pilosa-tpu-jointest-")
+        procs = {}
+        try:
+            for i in range(self.N):
+                procs[i] = self._spawn(i, ports[: self.N], tmp)
+            for p in ports[: self.N]:
+                self._wait_ready(p)
+            _req(ports[0], "POST", "/index/i", {})
+            _req(ports[0], "POST", "/index/i/field/f", {})
+            from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+            cols = [s * SHARD_WIDTH + 3 for s in range(5)]
+            _req(ports[0], "POST", "/index/i/query",
+                 " ".join(f"Set({c}, f=1)" for c in cols))
+
+            # Joiner: its own env (no static hosts), --join at the
+            # coordinator.
+            env = dict(
+                os.environ,
+                PYTHONPATH=REPO,
+                JAX_PLATFORMS="cpu",
+                PILOSA_TPU_ANTI_ENTROPY_INTERVAL="1",
+            )
+            for k in ("PILOSA_TPU_CLUSTER_HOSTS",
+                      "PILOSA_TPU_CLUSTER_REPLICAS",
+                      "PILOSA_TPU_CLUSTER_COORDINATOR"):
+                env.pop(k, None)
+            jp = ports[self.N]
+            procs["join"] = subprocess.Popen(
+                [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                 "-d", f"{tmp}/joiner", "-b", f"127.0.0.1:{jp}",
+                 "--executor", "cpu", "--join", f"http://127.0.0.1:{ports[0]}"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+                cwd=REPO,
+            )
+            self._wait_ready(jp)
+            # Wait until the joiner is a member of the full topology.
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                st = _req(jp, "GET", "/status")
+                if len(st["nodes"]) == self.N + 1 and st["state"] == "NORMAL":
+                    break
+                time.sleep(0.5)
+            assert len(st["nodes"]) == self.N + 1, st
+            assert st["state"] == "NORMAL", st
+            # The joiner answers queries with correct cluster-wide counts.
+            out = _req(jp, "POST", "/index/i/query", "Count(Row(f=1))",
+                       timeout=30)
+            assert out["results"][0] == len(cols)
+            # Every original node agrees on the new topology.
+            for p in ports[: self.N]:
+                st = _req(p, "GET", "/status")
+                assert len(st["nodes"]) == self.N + 1, p
+        finally:
+            self._kill_all(procs)
