@@ -64,7 +64,7 @@ from pilosa_tpu.ops.blocks import (
     pack_rows,
     unpack_row,
 )
-from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats
+from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats, pair_stats_masked
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
 from pilosa_tpu.utils.stats import global_stats
@@ -1264,31 +1264,37 @@ class TPUBackend:
             fn = self._fns.setdefault(key, fn)
         return fn
 
-    def _and_h_program(self, filtered: bool):
-        """Tiny elementwise program: f & h_row (& filter) — the per-row
-        prefilter feeding the shared pair program in _group3_stats."""
-        key = ("groupby_and", filtered)
+    def _pair_masked_program(self):
+        """Compiled masked pair sweep (ops/kernels.py pair_stats_masked):
+        the mask ANDs into F inside the kernel tiles, so no [S, R, W]
+        masked temp is materialized. Single flat output (1 readback),
+        shard_map+psum under a mesh — mirrors _pair_program."""
+        key = ("pair2m",)
         with self._fns_lock:
             fn = self._fns.get(key)
         if fn is not None:
             return fn
-
-        def body(f, hc, *rest):
-            out = f & hc[:, None, :]
-            if filtered:
-                out = out & rest[0][:, None, :]
-            return out
-
+        interpret = jax.default_backend() != "tpu"
         if self.mesh is None:
-            fn = jax.jit(body)
+
+            def flat(fb, gb, mb):
+                return pair_stats_masked(fb, gb, mb, interpret=interpret).ravel()
+
+            fn = jax.jit(flat)
         else:
-            n_in = 2 + (1 if filtered else 0)
+            mesh = self.mesh
+
+            def body(fb, gb, mb):
+                pair = pair_stats_masked(fb, gb, mb, interpret=interpret)
+                return jax.lax.psum(pair.ravel(), mesh.axis)
+
             fn = jax.jit(
                 shard_map(
                     body,
-                    mesh=self.mesh.mesh,
-                    in_specs=(P(self.mesh.axis),) * n_in,
-                    out_specs=P(self.mesh.axis),
+                    mesh=mesh.mesh,
+                    in_specs=(P(mesh.axis),) * 3,
+                    out_specs=P(),
+                    check_vma=False,
                 )
             )
         with self._fns_lock:
@@ -1296,19 +1302,19 @@ class TPUBackend:
         return fn
 
     def _group3_stats(self, f, g, h, filt) -> np.ndarray:
-        """[Rh, Rf, Rg] group tensor by composing compiled programs: for
-        each row of the third field, AND it into f (tiny elementwise
-        program) and run the SAME pair_stats program the Count path
-        compiled — all rows dispatched before any readback so the
-        sweeps pipeline past the relay round trips."""
+        """[Rh, Rf, Rg] group tensor: one masked pair sweep per row of
+        the third field (mask = that row, & the filter slab when
+        present), all rows dispatched before any readback so the sweeps
+        pipeline past the relay round trips. The mask fuses inside the
+        kernel — no per-row [S, R, W] AND temp."""
         rf, rg, rh = f.shape[1], g.shape[1], h.shape[1]
-        and_h = self._and_h_program(filt is not None)
-        pair = self._pair_program()
+        pair_m = self._pair_masked_program()
         flats = []
         for c in range(rh):
-            hc = h[:, c, :]
-            fb = and_h(f, hc, filt) if filt is not None else and_h(f, hc)
-            flats.append(pair(fb, g))
+            mask = h[:, c, :]
+            if filt is not None:
+                mask = mask & filt  # [S, W] & [S, W]: tiny fused op
+            flats.append(pair_m(f, g, mask))
         out = np.zeros((rh, rf, rg), dtype=np.int64)
         for c, fl in enumerate(flats):
             arr = np.asarray(fl)

@@ -121,6 +121,64 @@ def pair_stats(f_stack, g_stack, interpret: bool = False):
     )(f_stack, g_stack)
 
 
+def _pair_stats_masked_kernel(f_ref, g_ref, m_ref, pair_ref):
+    """Pair matrix with a per-shard column mask fused into the sweep: the
+    3-field GroupBy uses mask = one row of the third field (and filtered
+    GroupBy ANDs the filter slab in), so no [S, R, W] masked temp is ever
+    materialized in HBM. Only the pair matrix is emitted — the one
+    consumer (the group tensor) never reads count vectors, so computing
+    them here would be ~25% wasted popcount work per sweep."""
+    s = pl.program_id(0)
+    w = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(s == 0, w == 0))
+    def _():
+        pair_ref[...] = jnp.zeros_like(pair_ref)
+
+    m = m_ref[0, 0]  # [WT] (mask carries a singleton row axis: Mosaic
+    # requires block dims divisible by (8, 128) OR equal to the array
+    # dim — a [S, W] mask's (1, wt) block satisfies neither)
+    f = f_ref[0] & m[None, :]  # [Rf, WT]
+    g = g_ref[0]  # [Rg, WT]
+    pc = jax.lax.population_count(f[:, None, :] & g[None, :, :]).astype(jnp.int32)
+    pair_ref[...] += jnp.sum(pc, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pair_stats_masked(f_stack, g_stack, mask, interpret: bool = False):
+    """(uint32[S, Rf, W], uint32[S, Rg, W], uint32[S, W]) ->
+    pair int32[Rf, Rg] over (F & mask, G). Same tiling/accumulator
+    bounds as pair_stats."""
+    s, rf, w = f_stack.shape
+    rg = g_stack.shape[1]
+    mask = mask[:, None, :]  # [S, 1, W]: see kernel comment
+    wt = _word_tile(rf, rg, w)
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        params = pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.ARBITRARY,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            )
+        )
+    except (ImportError, AttributeError):  # pragma: no cover
+        params = None
+    return pl.pallas_call(
+        _pair_stats_masked_kernel,
+        grid=(s, w // wt),
+        in_specs=[
+            pl.BlockSpec((1, rf, wt), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, rg, wt), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, wt), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((rf, rg), lambda i, j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rf, rg), jnp.int32),
+        compiler_params=params,
+        interpret=interpret,
+    )(f_stack, g_stack, mask)
+
+
 def pair_stats_xla(f_stack, g_stack):
     """Fused-XLA reference formulation of pair_stats (same results; used
     as the differential oracle for the Pallas kernel and as the fallback

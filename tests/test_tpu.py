@@ -654,3 +654,17 @@ class TestCountBatcher:
             "Count(Row(f=2))Count(Union(Row(f=1), Row(g=9)))",
         ):
             assert ex.execute("i", q) == Executor(holder).execute("i", q)
+
+
+class TestMaskedPairKernel:
+    def test_masked_matches_premasked(self, rng):
+        """pair_stats_masked(F, G, m) must equal pair_stats(F & m, G)."""
+        from pilosa_tpu.ops.kernels import pair_stats, pair_stats_masked
+
+        S, RF, RG, W = 3, 8, 8, 512
+        f = rng.integers(0, 1 << 32, (S, RF, W), dtype=np.uint32)
+        g = rng.integers(0, 1 << 32, (S, RG, W), dtype=np.uint32)
+        m = rng.integers(0, 1 << 32, (S, W), dtype=np.uint32)
+        want = pair_stats((f & m[:, None, :]), g, interpret=True)[0]
+        got = pair_stats_masked(f, g, m, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
