@@ -64,7 +64,7 @@ from pilosa_tpu.ops.blocks import (
     pack_rows,
     unpack_row,
 )
-from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats, pair_stats_masked
+from pilosa_tpu.ops.kernels import MAX_PAIR_SHARDS, pair_stats, tri_stats
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
 from pilosa_tpu.utils.stats import global_stats
@@ -1264,12 +1264,13 @@ class TPUBackend:
             fn = self._fns.setdefault(key, fn)
         return fn
 
-    def _pair_masked_program(self):
-        """Compiled masked pair sweep (ops/kernels.py pair_stats_masked):
-        the mask ANDs into F inside the kernel tiles, so no [S, R, W]
-        masked temp is materialized. Single flat output (1 readback),
-        shard_map+psum under a mesh — mirrors _pair_program."""
-        key = ("pair2m",)
+    def _tri_program(self, filtered: bool):
+        """Compiled whole-tensor 3-field GroupBy sweep (ops/kernels.py
+        tri_stats): the third field's rows AND into F inside the kernel
+        tiles over a 3-D grid, so ONE dispatch + ONE readback produce
+        [Rh, Rf, Rg] — no per-row dispatches (each a relay round trip)
+        and no [S, R, W] masked temp. shard_map+psum under a mesh."""
+        key = ("tri", filtered)
         with self._fns_lock:
             fn = self._fns.get(key)
         if fn is not None:
@@ -1277,22 +1278,29 @@ class TPUBackend:
         interpret = jax.default_backend() != "tpu"
         if self.mesh is None:
 
-            def flat(fb, gb, mb):
-                return pair_stats_masked(fb, gb, mb, interpret=interpret).ravel()
+            def flat(fb, gb, hb, *rest):
+                return tri_stats(
+                    fb, gb, hb, rest[0] if filtered else None,
+                    interpret=interpret,
+                )
 
             fn = jax.jit(flat)
         else:
             mesh = self.mesh
 
-            def body(fb, gb, mb):
-                pair = pair_stats_masked(fb, gb, mb, interpret=interpret)
-                return jax.lax.psum(pair.ravel(), mesh.axis)
+            def body(fb, gb, hb, *rest):
+                tri = tri_stats(
+                    fb, gb, hb, rest[0] if filtered else None,
+                    interpret=interpret,
+                )
+                return jax.lax.psum(tri, mesh.axis)
 
+            n_in = 3 + (1 if filtered else 0)
             fn = jax.jit(
                 shard_map(
                     body,
                     mesh=mesh.mesh,
-                    in_specs=(P(mesh.axis),) * 3,
+                    in_specs=(P(mesh.axis),) * n_in,
                     out_specs=P(),
                     check_vma=False,
                 )
@@ -1302,24 +1310,10 @@ class TPUBackend:
         return fn
 
     def _group3_stats(self, f, g, h, filt) -> np.ndarray:
-        """[Rh, Rf, Rg] group tensor: one masked pair sweep per row of
-        the third field (mask = that row, & the filter slab when
-        present), all rows dispatched before any readback so the sweeps
-        pipeline past the relay round trips. The mask fuses inside the
-        kernel — no per-row [S, R, W] AND temp."""
-        rf, rg, rh = f.shape[1], g.shape[1], h.shape[1]
-        pair_m = self._pair_masked_program()
-        flats = []
-        for c in range(rh):
-            mask = h[:, c, :]
-            if filt is not None:
-                mask = mask & filt  # [S, W] & [S, W]: tiny fused op
-            flats.append(pair_m(f, g, mask))
-        out = np.zeros((rh, rf, rg), dtype=np.int64)
-        for c, fl in enumerate(flats):
-            arr = np.asarray(fl)
-            out[c] = arr[: rf * rg].reshape(rf, rg)
-        return out
+        """[Rh, Rf, Rg] group tensor in ONE dispatch + ONE readback."""
+        prog = self._tri_program(filt is not None)
+        out = prog(f, g, h, filt) if filt is not None else prog(f, g, h)
+        return np.asarray(out, dtype=np.int64)
 
     def group_by(self, index, c: Call, filter_call, child_rows, shards) -> Optional[list]:
         """Whole-query GroupBy: ONE device program computes the full
@@ -1388,9 +1382,14 @@ class TPUBackend:
         if hit is None:
             with jax.profiler.TraceAnnotation("pilosa.group_by"):
                 if n == 3:
-                    stats_np = self._group3_stats(
-                        stacks[0], stacks[1], stacks[2], filt
-                    )
+                    try:
+                        stats_np = self._group3_stats(
+                            stacks[0], stacks[1], stacks[2], filt
+                        )
+                    except Exception:  # noqa: BLE001 — Mosaic VMEM/compile
+                        # limits only real hardware can hit: host fallback
+                        # answers the query correctly instead of a 500.
+                        return None
                 else:
                     args = tuple(stacks) + ((filt,) if filt is not None else ())
                     stats_np = np.asarray(

@@ -121,38 +121,65 @@ def pair_stats(f_stack, g_stack, interpret: bool = False):
     )(f_stack, g_stack)
 
 
-def _pair_stats_masked_kernel(f_ref, g_ref, m_ref, pair_ref):
-    """Pair matrix with a per-shard column mask fused into the sweep: the
-    3-field GroupBy uses mask = one row of the third field (and filtered
-    GroupBy ANDs the filter slab in), so no [S, R, W] masked temp is ever
-    materialized in HBM. Only the pair matrix is emitted — the one
-    consumer (the group tensor) never reads count vectors, so computing
-    them here would be ~25% wasted popcount work per sweep."""
-    s = pl.program_id(0)
-    w = pl.program_id(1)
+def _make_tri_kernel(filtered: bool):
+    """One kernel body for both variants — a copy-pasted filtered twin
+    would have to track every fix in lockstep."""
 
-    @pl.when(jnp.logical_and(s == 0, w == 0))
-    def _():
-        pair_ref[...] = jnp.zeros_like(pair_ref)
+    def kernel(f_ref, g_ref, h_ref, *rest):
+        if filtered:
+            filt_ref, pair_ref = rest
+        else:
+            (pair_ref,) = rest
+        # Grid order is (k, s, w): the reduction dims (shards, word
+        # tiles) MUST be the innermost grid dims so each output block's
+        # visits are consecutive — with shards outermost, Pallas flushes
+        # the accumulator when k advances and never restores it.
+        k = pl.program_id(0)
+        s = pl.program_id(1)
+        w = pl.program_id(2)
 
-    m = m_ref[0, 0]  # [WT] (mask carries a singleton row axis: Mosaic
-    # requires block dims divisible by (8, 128) OR equal to the array
-    # dim — a [S, W] mask's (1, wt) block satisfies neither)
-    f = f_ref[0] & m[None, :]  # [Rf, WT]
-    g = g_ref[0]  # [Rg, WT]
-    pc = jax.lax.population_count(f[:, None, :] & g[None, :, :]).astype(jnp.int32)
-    pair_ref[...] += jnp.sum(pc, axis=-1)
+        @pl.when(jnp.logical_and(s == 0, w == 0))
+        def _():
+            pair_ref[...] = jnp.zeros_like(pair_ref)
+
+        # h's block spans ALL rows (Mosaic block dims must divide (8,128)
+        # or equal the array dim); the grid's k axis selects the row
+        # in-kernel.
+        m = h_ref[0, k]  # [WT]
+        if filtered:
+            m = m & filt_ref[0, 0]
+        f = f_ref[0] & m[None, :]
+        g = g_ref[0]
+        pc = jax.lax.population_count(
+            f[:, None, :] & g[None, :, :]
+        ).astype(jnp.int32)
+        pair_ref[0] += jnp.sum(pc, axis=-1)
+
+    return kernel
+
+
+_tri_stats_kernel = _make_tri_kernel(False)
+_tri_stats_filtered_kernel = _make_tri_kernel(True)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def pair_stats_masked(f_stack, g_stack, mask, interpret: bool = False):
-    """(uint32[S, Rf, W], uint32[S, Rg, W], uint32[S, W]) ->
-    pair int32[Rf, Rg] over (F & mask, G). Same tiling/accumulator
-    bounds as pair_stats."""
+def tri_stats(f_stack, g_stack, h_stack, filt=None, interpret: bool = False):
+    """The whole 3-field GroupBy tensor in ONE sweep:
+    (uint32[S, Rf, W], uint32[S, Rg, W], uint32[S, Rh, W][, uint32[S, W]])
+    -> int32[Rh, Rf, Rg] with tri[k, a, b] = popcount(F_a & H_k & G_b
+    [& filt]). 3-D grid (shards, h-rows, word tiles); the [Rf, Rg]
+    accumulator block is revisited per h-row, so one dispatch replaces
+    Rh masked pair sweeps (each a full relay round trip). f/g tiles are
+    re-read per h-row — the same HBM traffic the separate sweeps paid.
+    Accumulator bound: same MAX_PAIR_SHARDS int32 argument."""
     s, rf, w = f_stack.shape
     rg = g_stack.shape[1]
-    mask = mask[:, None, :]  # [S, 1, W]: see kernel comment
-    wt = _word_tile(rf, rg, w)
+    rh = h_stack.shape[1]
+    # Tile budget must cover the [rf,rg,wt] broadcast AND the full-rows
+    # h block (rh, wt) that stays VMEM-resident.
+    wt = w
+    while (rf * rg + rh) * wt * 4 > _VMEM_TILE_BYTES and wt % 2 == 0:
+        wt //= 2
     try:
         from jax.experimental.pallas import tpu as pltpu
 
@@ -160,23 +187,33 @@ def pair_stats_masked(f_stack, g_stack, mask, interpret: bool = False):
             dimension_semantics=(
                 pltpu.GridDimensionSemantics.ARBITRARY,
                 pltpu.GridDimensionSemantics.ARBITRARY,
+                pltpu.GridDimensionSemantics.ARBITRARY,
             )
         )
     except (ImportError, AttributeError):  # pragma: no cover
         params = None
+    in_specs = [
+        pl.BlockSpec((1, rf, wt), lambda k, i, j: (i, 0, j)),
+        pl.BlockSpec((1, rg, wt), lambda k, i, j: (i, 0, j)),
+        pl.BlockSpec((1, rh, wt), lambda k, i, j: (i, 0, j)),
+    ]
+    operands = [f_stack, g_stack, h_stack]
+    kernel = _tri_stats_kernel
+    if filt is not None:
+        in_specs.append(pl.BlockSpec((1, 1, wt), lambda k, i, j: (i, 0, j)))
+        operands.append(filt[:, None, :])  # singleton row axis (Mosaic)
+        kernel = _tri_stats_filtered_kernel
     return pl.pallas_call(
-        _pair_stats_masked_kernel,
-        grid=(s, w // wt),
-        in_specs=[
-            pl.BlockSpec((1, rf, wt), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, rg, wt), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, 1, wt), lambda i, j: (i, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((rf, rg), lambda i, j: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((rf, rg), jnp.int32),
+        kernel,
+        # k outermost; shard + word-tile reduction dims innermost (see
+        # kernel comment — accumulator-visit contiguity).
+        grid=(rh, s, w // wt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, rf, rg), lambda k, i, j: (k, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rh, rf, rg), jnp.int32),
         compiler_params=params,
         interpret=interpret,
-    )(f_stack, g_stack, mask)
+    )(*operands)
 
 
 def pair_stats_xla(f_stack, g_stack):

@@ -656,15 +656,23 @@ class TestCountBatcher:
             assert ex.execute("i", q) == Executor(holder).execute("i", q)
 
 
-class TestMaskedPairKernel:
-    def test_masked_matches_premasked(self, rng):
-        """pair_stats_masked(F, G, m) must equal pair_stats(F & m, G)."""
-        from pilosa_tpu.ops.kernels import pair_stats, pair_stats_masked
+class TestTriStatsKernel:
+    def test_tri_matches_premasked_pairs(self, rng):
+        """tri_stats[k] must equal pair_stats(F & H_k [& filt], G)."""
+        from pilosa_tpu.ops.kernels import pair_stats, tri_stats
 
-        S, RF, RG, W = 3, 8, 8, 512
+        S, RF, RG, RH, W = 3, 8, 8, 4, 512
         f = rng.integers(0, 1 << 32, (S, RF, W), dtype=np.uint32)
         g = rng.integers(0, 1 << 32, (S, RG, W), dtype=np.uint32)
-        m = rng.integers(0, 1 << 32, (S, W), dtype=np.uint32)
-        want = pair_stats((f & m[:, None, :]), g, interpret=True)[0]
-        got = pair_stats_masked(f, g, m, interpret=True)
-        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        h = rng.integers(0, 1 << 32, (S, RH, W), dtype=np.uint32)
+        filt = rng.integers(0, 1 << 32, (S, W), dtype=np.uint32)
+        tri = np.asarray(tri_stats(f, g, h, interpret=True))
+        tri_f = np.asarray(tri_stats(f, g, h, filt, interpret=True))
+        for k in range(RH):
+            m = h[:, k, :]
+            want = np.asarray(pair_stats((f & m[:, None, :]), g, interpret=True)[0])
+            np.testing.assert_array_equal(tri[k], want)
+            want_f = np.asarray(
+                pair_stats((f & (m & filt)[:, None, :]), g, interpret=True)[0]
+            )
+            np.testing.assert_array_equal(tri_f[k], want_f)
