@@ -47,6 +47,14 @@ def cmd_server(args) -> int:
         from pilosa_tpu.exec.batcher import CountBatcher
 
         executor.batcher = CountBatcher(backend, window=cfg.batch_window)
+        if cfg.preheat:
+            import threading as _threading
+
+            def _preheat():
+                n = backend.preheat(logger=log)
+                log.printf("preheat: %d stacks resident", n)
+
+            _threading.Thread(target=_preheat, daemon=True).start()
     executor.logger = log
     if cfg.long_query_time > 0:
         executor.long_query_time = cfg.long_query_time

@@ -38,6 +38,10 @@ class Config:
     # small next to a cache-miss dispatch (~80 ms relay RTT) and only ~2x
     # the per-request handling cost it can save under concurrency.
     batch_window: float = 0.002
+    # Pack + upload every field's HBM stack in the background at startup
+    # so first queries skip the cold upload (off by default: it fronts
+    # HBM residency for ALL fields, wanted only on read-serving nodes).
+    preheat: bool = False
 
     def _split_bind(self) -> tuple[str, int]:
         """Handles host:port, :port, bare host, [v6]:port, and bare IPv6."""
@@ -76,6 +80,7 @@ class Config:
             },
             "long-query-time": self.long_query_time,
             "batch-window": self.batch_window,
+            "preheat": self.preheat,
         }
 
     @staticmethod
@@ -104,6 +109,7 @@ class Config:
             "verbose": "verbose",
             "long-query-time": "long_query_time",
             "batch-window": "batch_window",
+            "preheat": "preheat",
         }
         for k, attr in simple.items():
             if k in data:
@@ -132,6 +138,7 @@ class Config:
             pre + "CLUSTER_HOSTS": ("cluster.hosts", lambda v: v.split(",") if v else []),
             pre + "ANTI_ENTROPY_INTERVAL": ("anti_entropy_interval", float),
             pre + "BATCH_WINDOW": ("batch_window", float),
+            pre + "PREHEAT": ("preheat", lambda v: v.lower() in ("1", "true")),
         }
         for key, (attr, conv) in mapping.items():
             if key in env:
@@ -153,6 +160,7 @@ class Config:
             f"verbose = {str(c.verbose).lower()}\n"
             f"long-query-time = {c.long_query_time}\n"
             f"batch-window = {c.batch_window}\n"
+            f"preheat = {str(c.preheat).lower()}\n"
             "\n[anti-entropy]\n"
             f"interval = {c.anti_entropy_interval}\n"
             "\n[metric]\n"

@@ -553,3 +553,35 @@ class TestMeshPerNodeCluster:
             # Multi-Count requests ride each node's batched path.
             out = c.query(0, "i", "Count(Row(f=1))Count(Row(g=2))Count(Xor(Row(f=1), Row(g=2)))")
             assert out["results"] == [16, 8, 8]
+
+    def test_anti_entropy_heals_device_results(self):
+        """Mesh-backend nodes must serve HEALED data after anti-entropy:
+        repair writes go through fragment mutators, so view generations
+        bump and the device stack caches refresh."""
+        import jax
+
+        from pilosa_tpu.exec.tpu import TPUBackend
+        from pilosa_tpu.parallel import ShardMesh
+
+        devices = jax.devices()
+        assert len(devices) >= 8
+
+        def factory(i, holder):
+            return TPUBackend(holder, mesh=ShardMesh(devices[i * 4 : (i + 1) * 4]))
+
+        with TestCluster(2, replica_n=2, backend_factory=factory) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(1, f=3) Set(100, f=3)")
+            # Prime both nodes' device caches.
+            for node in (0, 1):
+                assert c.query(node, "i", "Count(Row(f=3))")["results"][0] == 2
+            # Diverge node0's replica behind the cluster's back.
+            v = c.nodes[0].holder.index("i").field("f").view("standard")
+            v.fragment(0).set_bit(3, 777)
+            c.sync_all()
+            # Device-backed queries on BOTH nodes see the healed bit.
+            for node in (0, 1):
+                out = c.query(node, "i", "Row(f=3)")
+                assert out["results"][0]["columns"] == [1, 100, 777], node
+                assert c.query(node, "i", "Count(Row(f=3))")["results"][0] == 3
