@@ -93,6 +93,7 @@ class Broadcaster(Protocol):
     def send_sync(self, msg: Message) -> None: ...
     def send_async(self, msg: Message) -> None: ...
     def send_to(self, node, msg: Message) -> None: ...
+    def reset_wire_negotiation(self) -> None: ...
 
 
 class NopBroadcaster:
@@ -106,6 +107,9 @@ class NopBroadcaster:
         pass
 
     def send_to(self, node, msg: Message) -> None:
+        pass
+
+    def reset_wire_negotiation(self) -> None:
         pass
 
 
@@ -123,22 +127,90 @@ class HTTPBroadcaster:
         from pilosa_tpu.cluster.client import InternalClient
 
         self.client = client or InternalClient()
+        # Peers that rejected a binary frame and accepted the JSON retry:
+        # JSON-only older builds mid-rolling-upgrade (ADVICE r3: the
+        # binary default would otherwise require the operator to pre-set
+        # PILOSA_TPU_CONTROL_WIRE=json on every sender). Subsequent sends
+        # to them go straight to JSON (every receiver, old or new, parses
+        # JSON — receive sniffs the frame). Cleared on membership change
+        # (cluster.receive_message MSG_CLUSTER_STATUS) so a replaced or
+        # upgraded-in-place node re-negotiates.
+        self._json_peers: set[str] = set()
 
     def _peers(self):
         local_id = self.cluster.local_node.id
         return [n for n in self.cluster.topology.nodes if n.id != local_id]
 
+    def reset_wire_negotiation(self) -> None:
+        """Forget per-peer wire pins (called by the cluster on membership
+        change: a replaced or upgraded-in-place node may speak binary)."""
+        self._json_peers.clear()
+
+    @staticmethod
+    def _is_parse_failure(e) -> bool:
+        """True when an HTTP error means 'the peer could not PARSE the
+        frame' (safe to retry as JSON). Current peers answer a structured
+        code='bad-frame' 400 before any side effect; legacy JSON-only
+        builds surface json.JSONDecodeError through their panic trap, so
+        the 500 body's final traceback line names the decoder. Anything
+        else (a handler error AFTER the message was parsed and possibly
+        partially applied) must NOT be retried — control messages are
+        idempotent by design, but re-running a half-applied handler is
+        still the sender guessing about receiver state. (Deliberately
+        narrow: only the exception NAME is matched, because a panic-trap
+        body carries a full traceback whose source lines could contain
+        arbitrary function names.)"""
+        if e.status < 400:
+            return False
+        if getattr(e, "code", "") == "bad-frame":
+            return True
+        return getattr(e, "code", "") == "" and "JSONDecodeError" in str(e)
+
+    def _deliver(self, node, msg: Message, payload: Optional[bytes] = None) -> None:
+        """Send with per-peer wire negotiation: a peer that answers a
+        parse failure to the default (possibly binary) frame gets ONE
+        retry with legacy JSON; success pins that peer to JSON. Transport
+        failures (status 0: refused/timeout) are not retried — the frame
+        never reached a parser. Broadcast paths pass the default payload
+        in so an N-peer send marshals once, not N times."""
+        from pilosa_tpu.cluster.client import ClientError
+        from pilosa_tpu.cluster.private_wire import JSONSerializer
+
+        node_id = getattr(node, "id", None)
+        if payload is None:
+            payload = msg.to_bytes()
+        json_payload = None  # marshalled only on the fallback paths
+        if node_id in self._json_peers:
+            json_payload = JSONSerializer().marshal(msg)
+            if json_payload == payload:
+                json_payload = None  # already JSON: nothing to negotiate
+            else:
+                payload = json_payload
+        try:
+            self.client.send_message(node, payload)
+            return
+        except ClientError as e:
+            if not self._is_parse_failure(e):
+                raise
+            if json_payload is None:
+                json_payload = JSONSerializer().marshal(msg)
+            if json_payload == payload:
+                raise  # frame WAS JSON; nothing better to offer
+        self.client.send_message(node, json_payload)
+        if node_id is not None:
+            self._json_peers.add(node_id)
+
     def send_sync(self, msg: Message) -> None:
-        payload = msg.to_bytes()
         peers = self._peers()
         if not peers:
             return
+        payload = msg.to_bytes()  # marshal once for all peers
         errors: list[str] = []
         lock = threading.Lock()
 
         def send(node):
             try:
-                self.client.send_message(node, payload)
+                self._deliver(node, msg, payload)
             except Exception as e:  # collected, not fatal per-peer
                 with lock:
                     errors.append(f"{node.id}: {e}")
@@ -153,18 +225,18 @@ class HTTPBroadcaster:
             raise RuntimeError("broadcast failed: " + "; ".join(errors))
 
     def send_async(self, msg: Message) -> None:
-        payload = msg.to_bytes()
+        payload = msg.to_bytes()  # marshal once for all peers
         for node in self._peers():
             t = threading.Thread(
-                target=self._send_quiet, args=(node, payload), daemon=True
+                target=self._send_quiet, args=(node, msg, payload), daemon=True
             )
             t.start()
 
-    def _send_quiet(self, node, payload: bytes) -> None:
+    def _send_quiet(self, node, msg: Message, payload: bytes) -> None:
         try:
-            self.client.send_message(node, payload)
+            self._deliver(node, msg, payload)
         except Exception:
             pass
 
     def send_to(self, node, msg: Message) -> None:
-        self.client.send_message(node, msg.to_bytes())
+        self._deliver(node, msg)

@@ -230,7 +230,11 @@ class Cluster:
             if time.monotonic() - last_announce >= announce_every:
                 last_announce = time.monotonic()
                 try:
-                    self.client.send_message(coordinator_uri, msg.to_bytes())
+                    # Through the broadcaster so the announce gets the
+                    # per-peer JSON wire fallback too — a JSON-only
+                    # coordinator mid-rolling-upgrade must still accept
+                    # a new build's join (code review r4).
+                    self.broadcaster.send_to(coordinator_uri, msg)
                 except Exception as e:  # noqa: BLE001 — keep re-announcing
                     self._log("join announce failed (will retry): %s", e)
             time.sleep(0.05)
@@ -537,7 +541,9 @@ class Cluster:
     # -- message receive (reference server.go receiveMessage :569) ---------
 
     def receive_message(self, payload: bytes) -> None:
-        msg = Message.from_bytes(payload)
+        self.apply_message(Message.from_bytes(payload))
+
+    def apply_message(self, msg: Message) -> None:
         typ = msg.get("type")
         if typ == bc.MSG_CREATE_SHARD:
             idx = self.holder.index(msg["index"]) if self.holder else None
@@ -550,11 +556,14 @@ class Cluster:
             if f is not None:
                 f.remove_available_shard(int(msg["shard"]))
         elif typ == bc.MSG_NODE_STATUS:
-            if self.api is not None and "schema" in msg:
-                self.api.apply_schema(msg["schema"])
-                from pilosa_tpu.cluster.sync import wrap_translate_stores
-
-                wrap_translate_stores(self)
+            # schema + (optionally) per-field available shards — the
+            # rejoin path ships both so a restarted node immediately fans
+            # queries out over every shard (code review r4: schema alone
+            # left available_shards empty until anti-entropy, silently
+            # undercounting queries routed through the rejoined node).
+            self.merge_node_status(
+                {k: msg[k] for k in ("schema", "available") if k in msg}
+            )
         elif typ == bc.MSG_CLUSTER_STATUS:
             self.set_state(msg.get("state", self.state()))
             if "replicaN" in msg:
@@ -565,6 +574,9 @@ class Cluster:
                 )
                 self.topology.nodes = new_nodes
                 self._repair_attempted.clear()
+                # Membership changed: re-negotiate control-plane wire
+                # format per peer (a replaced node may speak binary now).
+                self.broadcaster.reset_wire_negotiation()
                 # Keep the local node's identity object in sync (it may
                 # have just become or stopped being a member/coordinator).
                 mine = next((n for n in new_nodes if n.id == self.local_node.id), None)

@@ -97,7 +97,44 @@ class Resizer:
         try:
             self.add_node(node)
         except ResizeError:
-            pass  # already a member: nothing to do
+            # Two reasons land here. A resize job already running: do
+            # nothing, the joiner keeps re-announcing. Already a member:
+            # that is a RESTARTED --join node (ADVICE r3 medium) — it
+            # boots single-node believing itself coordinator while the
+            # cluster still routes shards to it, so re-send the current
+            # schema + cluster status directly instead of silently
+            # dropping the announce (reference nodeJoin re-sends
+            # ClusterStatus to existing members, cluster.go:2121-2134).
+            if self.cluster.topology.node_by_id(node.id) is None:
+                return
+            schema = (
+                {"indexes": self.cluster.holder.schema()}
+                if self.cluster.holder is not None
+                else {}
+            )
+            status = Message.make(
+                bc.MSG_CLUSTER_STATUS,
+                state=self.cluster.state(),
+                nodes=[n.to_json() for n in self.cluster.topology.nodes],
+                replicaN=self.cluster.topology.replica_n,
+            )
+            try:
+                self.cluster.broadcaster.send_to(
+                    node,
+                    Message.make(
+                        bc.MSG_NODE_STATUS,
+                        schema=schema,
+                        # available shards too: the restarted node must
+                        # fan queries out over every shard immediately,
+                        # not after the next anti-entropy pass (the
+                        # normal join path ships this in the resize
+                        # instruction for the same reason).
+                        available=self._available_map(),
+                    ),
+                )
+                self.cluster.broadcaster.send_to(node, status)
+            except Exception as e:  # noqa: BLE001 — joiner re-announces
+                self.log.printf("resize: rejoin status to %s failed: %s", node.id, e)
 
     def _start_job(self, new_nodes: list[Node], removed: Optional[Node] = None) -> int:
         if not self.cluster.is_coordinator():

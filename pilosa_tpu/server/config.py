@@ -33,11 +33,13 @@ class Config:
     metric_service: str = "memory"  # memory | none
     tracing: bool = False
     long_query_time: float = 0.0
-    # Cross-request Count coalescing window in seconds (exec/batcher.py);
-    # 0 disables the wait (requests still batch when simultaneous). 2 ms:
-    # small next to a cache-miss dispatch (~80 ms relay RTT) and only ~2x
-    # the per-request handling cost it can save under concurrency.
-    batch_window: float = 0.002
+    # Optional fixed Count-coalescing sleep in seconds (exec/batcher.py).
+    # 0 (default) = backpressure batching: an uncontended single Count
+    # dispatches immediately with no added latency, and requests arriving
+    # during the in-flight device round trip coalesce into the next batch
+    # (ADVICE r3: the fixed window taxed every lone query ~2 ms for no
+    # batching benefit). Set >0 only to force deterministic batch windows.
+    batch_window: float = 0.0
     # Pack + upload every field's HBM stack in the background at startup
     # so first queries skip the cold upload (off by default: it fronts
     # HBM residency for ALL fields, wanted only on read-serving nodes).

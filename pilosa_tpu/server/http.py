@@ -540,7 +540,19 @@ class _Handler(BaseHTTPRequestHandler):
         if self.api.cluster is None:
             self._error("not clustered", status=400)
             return
-        self.api.cluster.receive_message(self._body())
+        from pilosa_tpu.cluster.broadcast import Message
+
+        body = self._body()
+        try:
+            msg = Message.from_bytes(body)
+        except Exception:
+            # Structured parse-failure code BEFORE any side effect: the
+            # sender's wire negotiation (broadcast.py _deliver) retries
+            # with legacy JSON on exactly this; handler errors below keep
+            # the generic panic trap and are never retried.
+            self._error("unparseable control frame", status=400, code="bad-frame")
+            return
+        self.api.cluster.apply_message(msg)
         self._reply({"success": True})
 
     @route("POST", r"/internal/translate/keys")

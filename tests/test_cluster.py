@@ -308,3 +308,144 @@ class TestInternalClientHTTP:
             st = client.status(tc[0].node)
             assert st["state"] == "NORMAL"
             assert len(st["nodes"]) == 2
+
+
+class TestRejoin:
+    def test_restarted_join_node_rejoins(self):
+        """ADVICE r3 medium: a member that restarts and re-announces must
+        receive the current cluster status + schema instead of staying
+        standalone while the cluster routes shards to it."""
+        with TestCluster(2) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            tc.query(0, "i", "Set(3, f=1)")
+            # Simulate node1 restarting: it boots single-node (sees only
+            # itself, believes itself coordinator) with empty schema.
+            import shutil as _shutil
+
+            n1 = tc[1]
+            n1.holder.close()
+            _shutil.rmtree(n1.data_dir, ignore_errors=True)
+            from pilosa_tpu.core.holder import Holder
+
+            n1.holder = Holder(n1.data_dir).open()
+            n1.api.holder = n1.holder
+            n1.executor.holder = n1.holder
+            n1.node.is_coordinator = True
+            tc._wire(n1, [n1.node])
+            assert len(n1.cluster.topology.nodes) == 1
+            # Re-announce to the coordinator: handle_join sees an existing
+            # member and re-sends schema + cluster status directly.
+            ok = n1.cluster.join_cluster(tc[0].node.uri, timeout=10.0)
+            assert ok
+            assert len(n1.cluster.topology.nodes) == 2
+            assert not n1.cluster.local_node.is_coordinator
+            assert n1.holder.index("i") is not None
+            f = n1.holder.index("i").field("f")
+            assert f is not None
+            # Available shards ship with the rejoin status: queries
+            # routed through the rejoined node fan out over every shard
+            # immediately (code review r4).
+            assert 0 in f.available_shards().to_array().tolist()
+
+
+class TestWireFallback:
+    def test_sender_falls_back_to_json_per_peer(self):
+        """ADVICE r3: a JSON-only peer rejecting a binary control frame
+        gets ONE JSON retry and is pinned to JSON for later sends."""
+        import json as _json
+
+        from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, Message
+        from pilosa_tpu.cluster.client import ClientError
+
+        class JSONOnlyPeer:
+            def __init__(self):
+                self.binary_rejects = 0
+                self.accepted = []
+
+            def send_message(self, node, payload):
+                try:
+                    _json.loads(payload)
+                except Exception:
+                    # A legacy build surfaces the decode error through
+                    # its panic trap; a current build answers the
+                    # structured bad-frame code — cover the legacy shape.
+                    self.binary_rejects += 1
+                    raise ClientError(
+                        "PANIC: json.decoder.JSONDecodeError: ...",
+                        status=500,
+                    )
+                self.accepted.append(payload)
+
+        class _Stub:
+            pass
+
+        cluster = _Stub()
+        cluster.local_node = Node("n0", URI(port=1), True)
+        cluster.topology = Topology(nodes=[cluster.local_node])
+        fake = JSONOnlyPeer()
+        b = HTTPBroadcaster(cluster, client=fake)
+        peer = Node("n1", URI(port=2), False)
+        msg = Message.make("cluster-status", state="NORMAL")
+        binary = msg.to_bytes()
+        b.send_to(peer, msg)
+        b.send_to(peer, msg)
+        assert len(fake.accepted) == 2
+        if binary != _json.dumps(msg).encode():
+            # Binary default: exactly one rejected attempt, then pinned.
+            assert fake.binary_rejects == 1
+            assert "n1" in b._json_peers
+
+    def test_transport_failure_not_retried_as_json(self):
+        from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, Message
+        from pilosa_tpu.cluster.client import ClientError
+
+        attempts = []
+
+        class DeadPeer:
+            def send_message(self, node, payload):
+                attempts.append(payload)
+                raise ClientError("connection refused")  # status 0
+
+        class _Stub:
+            pass
+
+        cluster = _Stub()
+        cluster.local_node = Node("n0", URI(port=1), True)
+        cluster.topology = Topology(nodes=[cluster.local_node])
+        b = HTTPBroadcaster(cluster, client=DeadPeer())
+        peer = Node("n1", URI(port=2), False)
+        try:
+            b.send_to(peer, Message.make("cluster-status", state="NORMAL"))
+            raise AssertionError("expected ClientError")
+        except ClientError:
+            pass
+        assert len(attempts) == 1
+
+    def test_handler_error_not_retried_as_json(self):
+        """A post-parse handler error (generic PANIC, no decode marker)
+        must not be re-sent — the peer may have partially applied it."""
+        from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, Message
+        from pilosa_tpu.cluster.client import ClientError
+
+        attempts = []
+
+        class AngryPeer:
+            def send_message(self, node, payload):
+                attempts.append(payload)
+                raise ClientError("PANIC: KeyError: 'nodes'", status=500)
+
+        class _Stub:
+            pass
+
+        cluster = _Stub()
+        cluster.local_node = Node("n0", URI(port=1), True)
+        cluster.topology = Topology(nodes=[cluster.local_node])
+        b = HTTPBroadcaster(cluster, client=AngryPeer())
+        peer = Node("n1", URI(port=2), False)
+        try:
+            b.send_to(peer, Message.make("cluster-status", state="NORMAL"))
+            raise AssertionError("expected ClientError")
+        except ClientError:
+            pass
+        assert len(attempts) == 1
