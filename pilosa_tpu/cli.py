@@ -56,6 +56,18 @@ def cmd_server(args) -> int:
 
             _threading.Thread(target=_preheat, daemon=True).start()
     executor.logger = log
+    if backend is not None:
+        # Device-fallback one-line logs (exec/tpu.py _count_device_fallback)
+        # need the server logger or they count on /metrics but never log.
+        backend.logger = log
+    if cfg.profile_port:
+        try:
+            import jax
+
+            jax.profiler.start_server(cfg.profile_port)
+            log.printf("jax profiler server on :%d", cfg.profile_port)
+        except Exception as e:  # noqa: BLE001 — profiling is optional
+            log.printf("jax profiler server failed: %s", e)
     if cfg.long_query_time > 0:
         executor.long_query_time = cfg.long_query_time
     api = API(holder, executor)

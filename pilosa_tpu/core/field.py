@@ -483,6 +483,17 @@ class Field:
             self._import_view(vname, row_ids[sel], column_ids[sel], clear)
 
     def _import_view(self, vname: str, rows_v: np.ndarray, cols_v: np.ndarray, clear: bool) -> None:
+        if cols_v.size == 0:
+            return
+        lo = int(cols_v.min()) // SHARD_WIDTH
+        hi = int(cols_v.max()) // SHARD_WIDTH
+        if lo == hi:
+            # Single-shard batch (the bulk loader's common shape): skip
+            # the per-shard mask/unique/fancy-index passes entirely.
+            frag = self.create_view_if_not_exists(vname).create_fragment_if_not_exists(lo)
+            frag.bulk_import(rows_v, cols_v, clear=clear)
+            self.add_available_shard(lo)
+            return
         shards = cols_v // np.uint64(SHARD_WIDTH)
         for shard in np.unique(shards):
             ssel = shards == shard

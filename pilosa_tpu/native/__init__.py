@@ -21,6 +21,7 @@ _LIB = os.path.join(_HERE, "build", "libpilosa_native.so")
 _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
+_scratch = threading.local()
 
 FNV32_OFFSET = 2166136261
 FNV64_OFFSET = 14695981039346656037
@@ -38,11 +39,18 @@ def _load() -> ctypes.CDLL | None:
                 stale = not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
                 if stale or attempt == "rebuild":
                     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
-                    subprocess.run(
-                        ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
-                        check=True,
-                        capture_output=True,
-                    )
+                    base = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC]
+                    try:
+                        # -march=native: the .so is built per host on
+                        # first use, so host-specific vectorization is
+                        # safe; retried without for exotic toolchains.
+                        subprocess.run(
+                            base[:2] + ["-march=native"] + base[2:],
+                            check=True,
+                            capture_output=True,
+                        )
+                    except subprocess.CalledProcessError:
+                        subprocess.run(base, check=True, capture_output=True)
                 lib = ctypes.CDLL(_LIB)
                 lib.pilosa_fnv32a.restype = ctypes.c_uint32
                 lib.pilosa_fnv32a.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
@@ -56,6 +64,17 @@ def _load() -> ctypes.CDLL | None:
                     ctypes.c_size_t,
                     ctypes.c_void_p,
                     ctypes.c_size_t,
+                ]
+                lib.pilosa_import_containers.restype = ctypes.c_longlong
+                lib.pilosa_import_containers.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_void_p,
+                    ctypes.c_size_t,
+                    ctypes.c_uint32,
+                    ctypes.c_size_t,
+                    ctypes.c_void_p,
+                    ctypes.c_void_p,
+                    ctypes.c_void_p,
                 ]
                 _lib = lib
                 return _lib
@@ -119,6 +138,48 @@ def scatter_positions(words, base_word: int, pos) -> bool:
         len(pos),
     )
     return True
+
+
+def import_containers(rows, cols, shard_width_exp: int, key_cap: int = 1 << 16):
+    """Container-granular import groups (reference ImportRoaringBits,
+    roaring/roaring.go:1511): one shard's (row, col) uint64 arrays ->
+    (keys u32 ascending, counts u32, lows u16 concatenated sorted
+    unique). None means 'use the numpy comparison-sort fallback' (no
+    toolchain, or rows too tall for the counting table)."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    rows = np.ascontiguousarray(rows, dtype=np.uint64)
+    cols = np.ascontiguousarray(cols, dtype=np.uint64)
+    n = rows.size
+    cap = min(n, key_cap)
+    # Thread-local output scratch: callers (Bitmap.import_container_groups)
+    # copy out of the returned views before the next import call on this
+    # thread, so reusing the buffers saves ~1 MB of allocation per shard.
+    scr = getattr(_scratch, "bufs", None)
+    if scr is None or scr[2].size < n or scr[0].size < cap:
+        scr = (
+            np.empty(max(cap, 1 << 12), dtype=np.uint32),
+            np.empty(max(cap, 1 << 12), dtype=np.uint32),
+            np.empty(max(n, 1 << 16), dtype=np.uint16),
+        )
+        _scratch.bufs = scr
+    out_keys, out_counts, out_lows = scr
+    rc = lib.pilosa_import_containers(
+        rows.ctypes.data,
+        cols.ctypes.data,
+        n,
+        shard_width_exp,
+        key_cap,
+        out_keys.ctypes.data,
+        out_counts.ctypes.data,
+        out_lows.ctypes.data,
+    )
+    if rc < 0:
+        return None
+    return out_keys[:rc], out_counts[:rc], out_lows
 
 
 def has_native() -> bool:

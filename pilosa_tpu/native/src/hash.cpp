@@ -9,6 +9,8 @@
 //  - fnv64a: shard->partition hash (reference cluster.go:871-880)
 #include <cstdint>
 #include <cstddef>
+#include <cstdlib>
+#include <cstring>
 
 extern "C" {
 
@@ -106,6 +108,106 @@ void pilosa_scatter_positions(uint32_t* words, size_t base_word,
         uint16_t p = pos[i];
         words[base_word + (p >> 5)] |= (1u << (p & 31u));
     }
+}
+
+// Container-granular bulk import (the ImportRoaringBits shape,
+// reference roaring/roaring.go:1511 — bits group by container key and
+// merge at container level instead of value-at-a-time): from one
+// shard's (row, col) pairs, produce per-container SORTED UNIQUE low
+// bits in one pass — a counting sort over container keys followed by an
+// 8 KiB-bitset dedupe per container (O(n + containers); no comparison
+// sort anywhere). numpy's np.unique comparison sort was the import
+// bottleneck (~70 M bits/s for the sort alone on one core).
+//
+// Outputs: out_keys/out_counts (one entry per non-empty container, keys
+// ascending) and out_lows (each container's sorted unique lows,
+// concatenated; caller sizes it to n). Returns the number of container
+// groups, -1 when a key exceeds key_cap (caller falls back to the
+// comparison-sort path — rows too tall for the counting table), -2 on
+// allocation failure.
+long long pilosa_import_containers(const uint64_t* rows, const uint64_t* cols,
+                                   size_t n, uint32_t shard_width_exp,
+                                   size_t key_cap, uint32_t* out_keys,
+                                   uint32_t* out_counts, uint16_t* out_lows) {
+    if (n == 0) return 0;
+    const uint64_t col_mask = (1ULL << shard_width_exp) - 1;
+    const uint32_t key_shift = shard_width_exp - 16;
+    // Reusable scratch (grown on demand, zeroed cursor maintained by
+    // clearing only touched keys below): the bulk loader calls this once
+    // per shard, so per-call malloc/calloc of ~3.5 MB was measurable.
+    static thread_local uint32_t* kbuf = nullptr;
+    static thread_local uint16_t* lbuf = nullptr;
+    static thread_local uint16_t* bucket = nullptr;
+    static thread_local size_t scratch_n = 0;
+    static thread_local uint32_t* cursor = nullptr;
+    static thread_local size_t cursor_cap = 0;
+    if (scratch_n < n) {
+        free(kbuf); free(lbuf); free(bucket);
+        kbuf = (uint32_t*)malloc(n * sizeof(uint32_t));
+        lbuf = (uint16_t*)malloc(n * sizeof(uint16_t));
+        bucket = (uint16_t*)malloc(n * sizeof(uint16_t));
+        scratch_n = (kbuf && lbuf && bucket) ? n : 0;
+        if (!scratch_n) return -2;
+    }
+    if (cursor_cap < key_cap) {
+        free(cursor);
+        cursor = (uint32_t*)calloc(key_cap, sizeof(uint32_t));
+        cursor_cap = cursor ? key_cap : 0;
+        if (!cursor_cap) return -2;
+    }
+    size_t bad = 0;
+    for (size_t i = 0; i < n; i++) {
+        uint64_t local = cols[i] & col_mask;
+        uint64_t key = (rows[i] << key_shift) + (local >> 16);
+        bad |= key >= key_cap;
+        if (key >= key_cap) break;
+        kbuf[i] = (uint32_t)key;
+        lbuf[i] = (uint16_t)(local & 0xFFFFu);
+        cursor[key]++;
+    }
+    if (bad) {
+        memset(cursor, 0, key_cap * sizeof(uint32_t));
+        return -1;
+    }
+    // counts -> scatter cursors (exclusive prefix sums); the whole table
+    // is memset back to zero at the end — 256 KiB, microseconds.
+    uint32_t acc = 0;
+    size_t nk = 0;
+    for (size_t k = 0; k < key_cap; k++) {
+        uint32_t c = cursor[k];
+        if (c) out_keys[nk++] = (uint32_t)k;
+        cursor[k] = acc;
+        acc += c;
+    }
+    for (size_t i = 0; i < n; i++) bucket[cursor[kbuf[i]]++] = lbuf[i];
+    // cursor[k] is now the END offset of bucket k; dedupe-sort each
+    // group through a 64 Kib bitset.
+    uint64_t bits[1024];
+    size_t lo = 0, start = 0;
+    for (size_t j = 0; j < nk; j++) {
+        uint32_t k = out_keys[j];
+        size_t end = cursor[k];
+        memset(bits, 0, sizeof(bits));
+        for (size_t i = start; i < end; i++) {
+            uint16_t p = bucket[i];
+            bits[p >> 6] |= 1ULL << (p & 63u);
+        }
+        size_t wrote = 0;
+        for (uint32_t w = 0; w < 1024; w++) {
+            uint64_t word = bits[w];
+            while (word) {
+                uint32_t tz = (uint32_t)__builtin_ctzll(word);
+                out_lows[lo++] = (uint16_t)((w << 6) | tz);
+                wrote++;
+                word &= word - 1;
+            }
+        }
+        out_counts[j] = (uint32_t)wrote;
+        start = end;
+    }
+    // Restore the zero-cursor invariant for the next call.
+    memset(cursor, 0, key_cap * sizeof(uint32_t));
+    return (long long)nk;
 }
 
 }  // extern "C"

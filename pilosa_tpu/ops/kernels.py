@@ -121,15 +121,19 @@ def pair_stats(f_stack, g_stack, interpret: bool = False):
     )(f_stack, g_stack)
 
 
-def _make_tri_kernel(filtered: bool):
-    """One kernel body for both variants — a copy-pasted filtered twin
-    would have to track every fix in lockstep."""
+def _make_nary_kernel(n_extra: int, extra_rows: tuple, filtered: bool):
+    """Kernel for the N-field group tensor: 2 'pair' fields broadcast in
+    VMEM + n_extra mask fields whose row combination is selected by the
+    grid's k axis (k decomposes by static div/mod over extra_rows, last
+    field fastest — odometer order). One body generated per
+    (n_extra, extra_rows, filtered) — a copy-pasted twin per arity would
+    have to track every fix in lockstep."""
 
-    def kernel(f_ref, g_ref, h_ref, *rest):
+    def kernel(f_ref, g_ref, *rest):
+        h_refs = rest[:n_extra]
         if filtered:
-            filt_ref, pair_ref = rest
-        else:
-            (pair_ref,) = rest
+            filt_ref = rest[n_extra]
+        pair_ref = rest[-1]
         # Grid order is (k, s, w): the reduction dims (shards, word
         # tiles) MUST be the innermost grid dims so each output block's
         # visits are consecutive — with shards outermost, Pallas flushes
@@ -142,10 +146,16 @@ def _make_tri_kernel(filtered: bool):
         def _():
             pair_ref[...] = jnp.zeros_like(pair_ref)
 
-        # h's block spans ALL rows (Mosaic block dims must divide (8,128)
-        # or equal the array dim); the grid's k axis selects the row
-        # in-kernel.
-        m = h_ref[0, k]  # [WT]
+        # Extra blocks span ALL their rows (Mosaic block dims must divide
+        # (8,128) or equal the array dim); the grid's k axis selects the
+        # row combination in-kernel via static div/mod.
+        m = None
+        rem = k
+        for t in range(n_extra - 1, -1, -1):
+            rh = extra_rows[t]
+            row = h_refs[t][0, rem % rh]  # [WT]
+            rem = rem // rh
+            m = row if m is None else (m & row)
         if filtered:
             m = m & filt_ref[0, 0]
         f = f_ref[0] & m[None, :]
@@ -158,27 +168,39 @@ def _make_tri_kernel(filtered: bool):
     return kernel
 
 
-_tri_stats_kernel = _make_tri_kernel(False)
-_tri_stats_filtered_kernel = _make_tri_kernel(True)
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def tri_stats(f_stack, g_stack, h_stack, filt=None, interpret: bool = False):
+    """The whole 3-field GroupBy tensor in ONE sweep — the 1-extra-field
+    case of nary_stats (kept as the named entry point the backend and
+    tests compile against): -> int32[Rh, Rf, Rg]."""
+    return nary_stats(f_stack, g_stack, (h_stack,), filt, interpret=interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def tri_stats(f_stack, g_stack, h_stack, filt=None, interpret: bool = False):
-    """The whole 3-field GroupBy tensor in ONE sweep:
-    (uint32[S, Rf, W], uint32[S, Rg, W], uint32[S, Rh, W][, uint32[S, W]])
-    -> int32[Rh, Rf, Rg] with tri[k, a, b] = popcount(F_a & H_k & G_b
-    [& filt]). 3-D grid (shards, h-rows, word tiles); the [Rf, Rg]
-    accumulator block is revisited per h-row, so one dispatch replaces
-    Rh masked pair sweeps (each a full relay round trip). f/g tiles are
-    re-read per h-row — the same HBM traffic the separate sweeps paid.
+def nary_stats(f_stack, g_stack, extras, filt=None, interpret: bool = False):
+    """The whole N-field GroupBy tensor in ONE sweep (VERDICT r3 #4 —
+    removes the 3-field cliff):
+
+    (uint32[S, Rf, W], uint32[S, Rg, W], (uint32[S, Rh1, W], ...)
+    [, uint32[S, W]]) -> int32[K, Rf, Rg] with K = prod(Rhi) and
+    out[k, a, b] = popcount(F_a & G_b & H1_{k1} & ... & Hm_{km} [& filt])
+    where k = odometer over (k1..km), LAST extra field fastest.
+
+    3-D grid (row-combination k, shards, word tiles); the [Rf, Rg]
+    accumulator block is revisited per k, so one dispatch replaces K
+    masked pair sweeps (each a full relay round trip). f/g tiles are
+    re-read per k — the same HBM traffic the separate sweeps paid.
     Accumulator bound: same MAX_PAIR_SHARDS int32 argument."""
     s, rf, w = f_stack.shape
     rg = g_stack.shape[1]
-    rh = h_stack.shape[1]
-    # Tile budget must cover the [rf,rg,wt] broadcast AND the full-rows
-    # h block (rh, wt) that stays VMEM-resident.
+    extra_rows = tuple(h.shape[1] for h in extras)
+    k_total = 1
+    for rh in extra_rows:
+        k_total *= rh
+    # Tile budget must cover the [rf,rg,wt] broadcast AND every extra
+    # field's full-rows block that stays VMEM-resident.
     wt = w
-    while (rf * rg + rh) * wt * 4 > _VMEM_TILE_BYTES and wt % 2 == 0:
+    while (rf * rg + sum(extra_rows)) * wt * 4 > _VMEM_TILE_BYTES and wt % 2 == 0:
         wt //= 2
     try:
         from jax.experimental.pallas import tpu as pltpu
@@ -195,22 +217,23 @@ def tri_stats(f_stack, g_stack, h_stack, filt=None, interpret: bool = False):
     in_specs = [
         pl.BlockSpec((1, rf, wt), lambda k, i, j: (i, 0, j)),
         pl.BlockSpec((1, rg, wt), lambda k, i, j: (i, 0, j)),
-        pl.BlockSpec((1, rh, wt), lambda k, i, j: (i, 0, j)),
+    ] + [
+        pl.BlockSpec((1, rh, wt), lambda k, i, j: (i, 0, j))
+        for rh in extra_rows
     ]
-    operands = [f_stack, g_stack, h_stack]
-    kernel = _tri_stats_kernel
+    operands = [f_stack, g_stack, *extras]
     if filt is not None:
         in_specs.append(pl.BlockSpec((1, 1, wt), lambda k, i, j: (i, 0, j)))
         operands.append(filt[:, None, :])  # singleton row axis (Mosaic)
-        kernel = _tri_stats_filtered_kernel
+    kernel = _make_nary_kernel(len(extras), extra_rows, filt is not None)
     return pl.pallas_call(
         kernel,
         # k outermost; shard + word-tile reduction dims innermost (see
         # kernel comment — accumulator-visit contiguity).
-        grid=(rh, s, w // wt),
+        grid=(k_total, s, w // wt),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, rf, rg), lambda k, i, j: (k, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((rh, rf, rg), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((k_total, rf, rg), jnp.int32),
         compiler_params=params,
         interpret=interpret,
     )(*operands)

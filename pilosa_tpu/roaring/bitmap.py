@@ -410,22 +410,40 @@ class Bitmap:
         ends = np.concatenate((boundaries, [keys.size]))
         changed = 0
         for s, e in zip(starts, ends):
-            key = int(keys[s])
-            chunk = lows[s:e]
-            c = self._cs.get(key)
-            if c is None:
-                # Copy: from_positions would store the slice VIEW, pinning
-                # the whole batch's lows buffer for the container's life.
-                nc = Container.from_positions(chunk.copy())
-            else:
-                nc = c.with_many(chunk)
-            changed += nc.n - (c.n if c is not None else 0)
-            self._put(key, nc)
+            changed += self._merge_lows(int(keys[s]), lows[s:e])
         if changed and log and self.op_writer is not None:
             # opN counts mutated values like the reference's op.count()
             # (roaring.go:1620), so it matches what a WAL replay computes.
             self.op_writer.append_add_batch(vs)
             self.op_n += int(vs.size)
+        return changed
+
+    def _merge_lows(self, key: int, chunk: np.ndarray) -> int:
+        """Union one container's sorted-unique lows; returns bits added."""
+        c = self._cs.get(key)
+        if c is None:
+            # Copy: from_positions would store the slice VIEW, pinning
+            # the whole batch's lows buffer for the container's life.
+            nc = Container.from_positions(chunk.copy())
+        else:
+            nc = c.with_many(chunk)
+        self._put(key, nc)
+        return nc.n - (c.n if c is not None else 0)
+
+    def import_container_groups(
+        self, keys: np.ndarray, counts: np.ndarray, lows: np.ndarray
+    ) -> int:
+        """Container-granular union (reference ImportRoaringBits,
+        roaring/roaring.go:1511): pre-grouped sorted-unique lows per key
+        (native.import_containers output) merge one container at a time —
+        no per-value work, no comparison sort. Returns bits added.
+        Op-logging is the caller's job (it holds the positions)."""
+        changed = 0
+        off = 0
+        for j in range(keys.size):
+            cnt = int(counts[j])
+            changed += self._merge_lows(int(keys[j]), lows[off : off + cnt])
+            off += cnt
         return changed
 
     def remove_many(self, vs: np.ndarray, log: bool = True) -> int:

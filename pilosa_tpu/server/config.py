@@ -44,6 +44,11 @@ class Config:
     # so first queries skip the cold upload (off by default: it fronts
     # HBM residency for ALL fields, wanted only on read-serving nodes).
     preheat: bool = False
+    # TCP port for jax.profiler.start_server (TensorBoard-connectable
+    # device traces; the reference's profile.* config, server/config.go
+    # :153-155). 0 = off. Python CPU profiling needs no config — it's
+    # always-available via /debug/pprof/* (utils/profiler.py).
+    profile_port: int = 0
 
     def _split_bind(self) -> tuple[str, int]:
         """Handles host:port, :port, bare host, [v6]:port, and bare IPv6."""
@@ -83,6 +88,7 @@ class Config:
             "long-query-time": self.long_query_time,
             "batch-window": self.batch_window,
             "preheat": self.preheat,
+            "profile": {"port": self.profile_port},
         }
 
     @staticmethod
@@ -116,6 +122,8 @@ class Config:
         for k, attr in simple.items():
             if k in data:
                 setattr(self, attr, data[k])
+        if "profile" in data and "port" in data["profile"]:
+            self.profile_port = int(data["profile"]["port"])
         if "anti-entropy" in data and "interval" in data["anti-entropy"]:
             self.anti_entropy_interval = float(data["anti-entropy"]["interval"])
         if "metric" in data and "service" in data["metric"]:
@@ -141,6 +149,7 @@ class Config:
             pre + "ANTI_ENTROPY_INTERVAL": ("anti_entropy_interval", float),
             pre + "BATCH_WINDOW": ("batch_window", float),
             pre + "PREHEAT": ("preheat", lambda v: v.lower() in ("1", "true")),
+            pre + "PROFILE_PORT": ("profile_port", int),
         }
         for key, (attr, conv) in mapping.items():
             if key in env:
@@ -163,6 +172,7 @@ class Config:
             f"long-query-time = {c.long_query_time}\n"
             f"batch-window = {c.batch_window}\n"
             f"preheat = {str(c.preheat).lower()}\n"
+            f"[profile]\nport = {c.profile_port}\n"
             "\n[anti-entropy]\n"
             f"interval = {c.anti_entropy_interval}\n"
             "\n[metric]\n"

@@ -421,7 +421,7 @@ class TestGroupByDevice:
 
     def _setup(self, holder, rng):
         idx = holder.create_index("i")
-        for fname, nrows in (("a", 3), ("b", 2), ("c", 2)):
+        for fname, nrows in (("a", 3), ("b", 2), ("c", 2), ("d", 2)):
             idx.create_field(fname)
             for row in range(1, nrows + 1):
                 cols = np.unique(
@@ -443,6 +443,12 @@ class TestGroupByDevice:
         "GroupBy(Rows(a), Rows(b), limit=2, offset=1)",
         "GroupBy(Rows(a, limit=2), Rows(b))",
         "GroupBy(Rows(a, previous=1), Rows(b))",
+        # 4-field shapes: the N-field odometer kernel (VERDICT r3 #4
+        # removed the 3-field cliff).
+        "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d))",
+        "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d), filter=Row(a=2))",
+        "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d), limit=5, offset=2)",
+        "GroupBy(Rows(a), Rows(b), Rows(c, limit=1), Rows(d))",
     ]
 
     def test_differential_vs_host(self, holder, rng):
@@ -701,3 +707,126 @@ class TestTriStatsKernel:
                 pair_stats((f & (m & filt)[:, None, :]), g, interpret=True)[0]
             )
             np.testing.assert_array_equal(tri_f[k], want_f)
+
+
+class TestIncrementalStackUpdate:
+    """VERDICT r3 #1: a write touching one shard must refresh the
+    resident stack by splicing that shard's slab, not repacking the
+    whole stack."""
+
+    def _build(self, holder, rng, n_shards=4):
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        for shard in range(n_shards):
+            base = shard * SHARD_WIDTH
+            cols = np.unique(
+                rng.integers(0, SHARD_WIDTH, 3000, dtype=np.uint64)
+            ) + base
+            f.import_bits(np.full(cols.size, 1, dtype=np.uint64), cols)
+        return idx
+
+    def test_single_shard_write_is_incremental_and_correct(self, holder, rng):
+        from pilosa_tpu.pql import parse_string
+        from pilosa_tpu.utils.stats import global_stats
+
+        idx = self._build(holder, rng, n_shards=16)
+        be = TPUBackend(holder)
+        shards = list(range(16))
+        call = parse_string("Count(Row(f=1))").calls[0].children[0]
+        before_total = be.count_shards("i", call, shards)
+        old_arr = be.blocks._entries[("i", "f", "standard")][1]
+
+        def updates():
+            return global_stats._counters.get(
+                ("stack_incremental_updates_total", ()), 0
+            )
+
+        n0 = updates()
+        # One write in shard 3 (a fresh column: count must grow by 1).
+        idx.field("f").set_bit(1, 3 * SHARD_WIDTH + 777_777)
+        after_total = be.count_shards("i", call, shards)
+        assert after_total == before_total + 1
+        assert updates() == n0 + 1
+        new_arr = be.blocks._entries[("i", "f", "standard")][1]
+        # New array object: identity-keyed caches see a fresh epoch.
+        assert new_arr is not old_arr
+        # And a repeat query is a pure fingerprint hit (no new update).
+        assert be.count_shards("i", call, shards) == after_total
+        assert updates() == n0 + 1
+
+    def test_many_dirty_shards_full_rebuild(self, holder, rng):
+        from pilosa_tpu.pql import parse_string
+        from pilosa_tpu.utils.stats import global_stats
+
+        idx = self._build(holder, rng, n_shards=4)
+        be = TPUBackend(holder)
+        shards = list(range(4))
+        call = parse_string("Count(Row(f=1))").calls[0].children[0]
+        base = be.count_shards("i", call, shards)
+
+        def updates():
+            return global_stats._counters.get(
+                ("stack_incremental_updates_total", ()), 0
+            )
+
+        n0 = updates()
+        # Dirty 3 of 4 shards: over the 1/8 cutoff -> full rebuild.
+        for s in range(3):
+            idx.field("f").set_bit(1, s * SHARD_WIDTH + 999_999)
+        assert be.count_shards("i", call, shards) == base + 3
+        assert updates() == n0
+
+    def test_row_growth_forces_rebuild(self, holder, rng):
+        """A write that adds a new max row changes the stack height —
+        never incrementally spliceable."""
+        from pilosa_tpu.pql import parse_string
+
+        idx = self._build(holder, rng, n_shards=16)
+        be = TPUBackend(holder)
+        shards = list(range(16))
+        call = parse_string("Count(Row(f=63))").calls[0].children[0]
+        assert be.count_shards("i", call, shards) == 0
+        idx.field("f").set_bit(63, 5 * SHARD_WIDTH + 42)
+        assert be.count_shards("i", call, shards) == 1
+
+
+class TestRowsDevice:
+    """Rows() served from the counts vector (VERDICT r3 #5) must match
+    the host fragment walk in every shape."""
+
+    def _setup(self, holder, rng):
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        for row in (0, 2, 5):
+            cols = np.unique(rng.integers(0, 3 * SHARD_WIDTH, 2500, dtype=np.uint64))
+            f.import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+        return idx
+
+    QUERIES = [
+        "Rows(f)",
+        "Rows(f, previous=1)",
+        "Rows(f, previous=2)",
+        "Rows(f, limit=2)",
+        "Rows(f, previous=0, limit=1)",
+        f"Rows(f, column={SHARD_WIDTH + 17})",
+    ]
+
+    def test_differential_vs_host(self, holder, rng):
+        self._setup(holder, rng)
+        host = Executor(holder)
+        dev = Executor(holder, backend=TPUBackend(holder))
+        for q in self.QUERIES:
+            assert dev.execute("i", q) == host.execute("i", q), q
+
+    def test_device_path_taken_and_row_clear(self, holder, rng):
+        idx = self._setup(holder, rng)
+        be = TPUBackend(holder)
+        shards = [0, 1, 2]
+        assert be.rows_field("i", "f", shards) == [0, 2, 5]
+        assert be.rows_field("i", "f", shards, start=1) == [2, 5]
+        # Clearing every bit of a row removes it (empty containers drop).
+        Executor(holder).execute("i", "ClearRow(f=2)")
+        assert be.rows_field("i", "f", shards) == [0, 5]
+        assert Executor(holder, backend=be).execute("i", "Rows(f)") == Executor(
+            holder
+        ).execute("i", "Rows(f)")
