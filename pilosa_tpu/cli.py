@@ -75,14 +75,15 @@ def cmd_server(args) -> int:
     def wire_cluster(topo_nodes, local_id):
         """Shared cluster bootstrap for both the static-hosts and --join
         paths: build the topology, attach seams, start daemons."""
-        from pilosa_tpu.cluster import Cluster, Topology
+        from pilosa_tpu.cluster import Cluster, InternalClient, Topology
         from pilosa_tpu.cluster.sync import FailureDetector, SyncDaemon
 
         topo = Topology(topo_nodes, replica_n=cfg.cluster.replicas)
         local = topo.node_by_id(local_id)
         if local is None:
             return None
-        cluster = Cluster(local, topo, holder)
+        cluster = Cluster(local, topo, holder,
+                          client=InternalClient(timeout=cfg.client_timeout))
         cluster.logger = log
         cluster.attach(executor, api)
         api.cluster = cluster
@@ -225,10 +226,13 @@ def cmd_import(args) -> int:
 
 
 def cmd_export(args) -> int:
-    """reference ctl/export.go."""
+    """reference ctl/export.go: exports the whole field across every
+    shard and node by default; --shard restricts to one shard."""
     import urllib.request
 
-    url = f"{args.host.rstrip('/')}/export?index={args.index}&field={args.field}&shard={args.shard}"
+    url = f"{args.host.rstrip('/')}/export?index={args.index}&field={args.field}"
+    if args.shard is not None:
+        url += f"&shard={args.shard}"
     resp = urllib.request.urlopen(urllib.request.Request(url))
     sys.stdout.write(resp.read().decode())
     return 0
@@ -324,11 +328,14 @@ def main(argv=None) -> int:
     sp.add_argument("files", nargs="+")
     sp.set_defaults(fn=cmd_import)
 
-    sp = sub.add_parser("export", help="export a fragment as CSV")
+    sp = sub.add_parser(
+        "export", help="export a whole field (all shards/nodes) as CSV"
+    )
     sp.add_argument("--host", default="http://localhost:10101")
     sp.add_argument("-i", "--index", required=True)
     sp.add_argument("-f", "--field", required=True)
-    sp.add_argument("-s", "--shard", type=int, default=0)
+    sp.add_argument("-s", "--shard", type=int, default=None,
+                    help="restrict to one shard (default: all)")
     sp.set_defaults(fn=cmd_export)
 
     sp = sub.add_parser("check", help="check fragment files for corruption")

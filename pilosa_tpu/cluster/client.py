@@ -252,6 +252,18 @@ class InternalClient:
         self._do("POST", uri, "/internal/cluster/message", payload,
                  content_type="application/octet-stream")
 
+    def export_csv_shard(self, uri, index: str, field: str, shard: int) -> str:
+        """One shard's CSV from the node that holds it (whole-field
+        export fans out through this; reference ctl/export.go)."""
+        from urllib.parse import quote
+
+        raw = self._do(
+            "GET", uri,
+            f"/export?index={quote(index)}&field={quote(field)}&shard={shard}",
+            raw=True,
+        )
+        return raw.decode()
+
     # -- translation -------------------------------------------------------
 
     def translate_keys(self, uri, index: str, field: str, keys: Sequence[str]) -> list[int]:

@@ -28,6 +28,7 @@ from pilosa_tpu.cluster import broadcast as bc
 from pilosa_tpu.cluster.broadcast import Message
 from pilosa_tpu.cluster.client import ClientError
 from pilosa_tpu.cluster.topology import (
+    NODE_STATE_DOWN,
     Node,
     STATE_NORMAL,
     STATE_RESIZING,
@@ -153,7 +154,13 @@ class Resizer:
         self._active_job = job
         self._new_nodes = new_topo.nodes
         instructions = self._build_instructions(old_topo, new_topo, removed)
-        self._pending_nodes = {n.id for n in new_topo.nodes}
+        # DOWN members cannot follow instructions or report completion —
+        # waiting on them (or fail-fasting on their freeze delivery)
+        # would wedge every post-failover join until the dead node
+        # returns. They keep their membership; anti-entropy re-syncs
+        # them when they come back.
+        live_new = [n for n in new_topo.nodes if n.state != NODE_STATE_DOWN]
+        self._pending_nodes = {n.id for n in live_new}
         # Final-status recipients: the union of old and new membership — a
         # removed node must still see the flip back to NORMAL.
         notify = {n.id: n for n in old_topo.nodes}
@@ -174,23 +181,24 @@ class Resizer:
             # (the reference leaves removed-node data dirs behind too).
             self.cluster.set_state(STATE_RESIZING)
             freeze = Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
-            new_ids = {n.id for n in new_topo.nodes}
+            live_ids = {n.id for n in live_new}
             for node in self._notify_nodes:
                 if node.id == self.cluster.local_node.id:
                     continue
                 try:
                     self.cluster.broadcaster.send_to(node, freeze)
                 except Exception as e:
-                    if node.id in new_ids:
+                    if node.id in live_ids:
                         raise ResizeError(
                             f"freeze broadcast to {node.id} failed: {e}"
                         ) from e
                     self.log.printf(
-                        "resize: freeze to leaving node %s failed: %s", node.id, e
+                        "resize: freeze to leaving/down node %s failed: %s",
+                        node.id, e,
                     )
             schema = {"indexes": self.cluster.holder.schema()} if self.cluster.holder else {}
             available = self._available_map()
-            for node in new_topo.nodes:
+            for node in live_new:
                 msg = Message.make(
                     bc.MSG_RESIZE_INSTRUCTION,
                     job=job,
@@ -292,7 +300,7 @@ class Resizer:
                 for shard in f.available_shards().to_array().tolist():
                     old_owners = [
                         n for n in old_topo.shard_nodes(index_name, shard)
-                        if n.id != gone_id
+                        if n.id != gone_id and n.state != NODE_STATE_DOWN
                     ]
                     if removed is not None:
                         # The leaving node's data must survive: it stays a

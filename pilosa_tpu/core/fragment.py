@@ -212,6 +212,10 @@ class Fragment:
         """Atomically rewrite the storage file without the op log
         (reference fragment.go:2311-2394)."""
         with self.lock:
+            # Re-pack runny containers as RLE while we're already paying
+            # a full-storage pass (reference calls Optimize on snapshot;
+            # mutating ops leave array/bitmap forms behind).
+            self.storage.optimize()
             if self.path is None:
                 self.storage.op_n = 0
                 return

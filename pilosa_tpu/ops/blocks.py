@@ -39,6 +39,12 @@ def _scatter_container(row_words: np.ndarray, cidx: int, c) -> None:
     if c.typ == "bitmap":
         row_words[base : base + _WORDS_PER_CONTAINER] = c.data.view("<u4")
         return
+    if c.typ == "run":
+        # RLE containers pack via their materialized bitmap words (run
+        # fills would need per-run partial-word masking for no gain —
+        # packing is once per write epoch).
+        row_words[base : base + _WORDS_PER_CONTAINER] = c.bitmap_words().view("<u4")
+        return
     from pilosa_tpu.native import scatter_positions
 
     data = np.ascontiguousarray(c.data, dtype=np.uint16)

@@ -31,8 +31,21 @@ MAX_CONTAINER_KEY = (1 << 48) - 1
 
 TYPE_ARRAY = "array"
 TYPE_BITMAP = "bitmap"
+# First-class in-memory RLE containers (VERDICT r3 missing #5; reference
+# roaring.go:64-69,1940-1943): data is uint16[R, 2] of [start, last]
+# INCLUSIVE runs, sorted, non-overlapping, non-adjacent. Reads (contains,
+# counts, pack, serialize) are run-native; mutating/set-algebra ops
+# convert to array/bitmap first (the result re-packs to runs on the next
+# Bitmap.optimize()) — a full 2^16 run costs 4 bytes here vs 8 KiB as a
+# bitmap, which is the parity point: host RAM on runny data.
+TYPE_RUN = "run"
 
 _EMPTY_U16 = np.empty(0, dtype=np.uint16)
+
+# Keep a container as runs when its RLE form is smaller than both other
+# encodings (the serializer's pick-smallest rule, reference Optimize).
+def _runs_win(run_count: int, n: int) -> bool:
+    return 4 * run_count < min(2 * n, 8 * BITMAP_N)
 
 
 def _as_bitmap_words(arr: np.ndarray) -> np.ndarray:
@@ -75,6 +88,10 @@ class Container:
         if n is None:
             if typ == TYPE_ARRAY:
                 n = int(data.size)
+            elif typ == TYPE_RUN:
+                n = int(
+                    (data[:, 1].astype(np.int64) - data[:, 0].astype(np.int64) + 1).sum()
+                )
             else:
                 n = int(np.bitwise_count(data).sum())
         self._n = n
@@ -95,6 +112,23 @@ class Container:
                 raise AssertionError(
                     f"container {key}: n={self._n} != array size {a.size}"
                 )
+        elif self.typ == TYPE_RUN:
+            r = self.data
+            if r.ndim != 2 or r.shape[1] != 2 or r.dtype != np.uint16:
+                raise AssertionError(f"container {key}: run shape {r.shape} {r.dtype}")
+            if (r[:, 1] < r[:, 0]).any():
+                raise AssertionError(f"container {key}: inverted run")
+            if r.shape[0] > 1 and not (
+                r[1:, 0].astype(np.int64) > r[:-1, 1].astype(np.int64) + 1
+            ).all():
+                raise AssertionError(
+                    f"container {key}: runs overlap or are adjacent"
+                )
+            real = int(
+                (r[:, 1].astype(np.int64) - r[:, 0].astype(np.int64) + 1).sum()
+            )
+            if self._n != real:
+                raise AssertionError(f"container {key}: n={self._n} != runs {real}")
         else:
             if self.data.size != BITMAP_N:
                 raise AssertionError(
@@ -126,8 +160,13 @@ class Container:
 
     @staticmethod
     def from_runs(runs: np.ndarray) -> "Container":
-        """runs: int array [[start, last], ...] inclusive (codec form)."""
+        """runs: int array [[start, last], ...] inclusive (codec form).
+        Stays RLE in memory when runs are the smallest encoding
+        (VERDICT r3 #5 — this used to always inflate to array/bitmap,
+        costing 8 KiB of host RAM for a 4-byte full-container run)."""
         n = int((runs[:, 1].astype(np.int64) - runs[:, 0].astype(np.int64) + 1).sum())
+        if _runs_win(runs.shape[0], n):
+            return Container(TYPE_RUN, np.asarray(runs, dtype=np.uint16), n)
         if n <= ARRAY_MAX_SIZE:
             parts = [np.arange(s, l + 1, dtype=np.uint16) for s, l in runs]
             return Container(TYPE_ARRAY, np.concatenate(parts) if parts else _EMPTY_U16, n)
@@ -147,16 +186,32 @@ class Container:
         """Sorted uint16 positions regardless of representation."""
         if self.typ == TYPE_ARRAY:
             return self.data
+        if self.typ == TYPE_RUN:
+            if self.data.shape[0] == 0:
+                return _EMPTY_U16
+            parts = [
+                np.arange(int(s), int(l) + 1, dtype=np.uint16)
+                for s, l in self.data
+            ]
+            return np.concatenate(parts)
         return _bitmap_to_positions(self.data)
 
     def bitmap_words(self) -> np.ndarray:
         """uint64[1024] words regardless of representation."""
         if self.typ == TYPE_BITMAP:
             return self.data
+        if self.typ == TYPE_RUN:
+            bits = np.zeros(CONTAINER_WIDTH, dtype=bool)
+            for s, l in self.data:
+                bits[int(s) : int(l) + 1] = True
+            return np.packbits(bits, bitorder="little").view(np.uint64).copy()
         return _as_bitmap_words(self.data)
 
     def runs(self) -> np.ndarray:
-        """Detect runs: returns [[start, last], ...] inclusive, as int32."""
+        """Runs [[start, last], ...] inclusive, as int32 (native for RUN
+        containers, detected for the others)."""
+        if self.typ == TYPE_RUN:
+            return self.data.astype(np.int32)
         pos = self.positions().astype(np.int32)
         if pos.size == 0:
             return np.empty((0, 2), dtype=np.int32)
@@ -165,10 +220,23 @@ class Container:
         ends = np.concatenate((breaks, [pos.size - 1]))
         return np.stack([pos[starts], pos[ends]], axis=1)
 
+    def _unrun(self) -> "Container":
+        """RUN -> array/bitmap twin (same bits) for ops with no RLE
+        form; identity for the other types."""
+        if self.typ != TYPE_RUN:
+            return self
+        if self._n <= ARRAY_MAX_SIZE:
+            return Container(TYPE_ARRAY, self.positions(), self._n)
+        return Container(TYPE_BITMAP, self.bitmap_words(), self._n)
+
     def contains(self, v: int) -> bool:
         if self.typ == TYPE_ARRAY:
             i = np.searchsorted(self.data, np.uint16(v))
             return i < self.data.size and self.data[i] == v
+        if self.typ == TYPE_RUN:
+            # Find the last run with start <= v; v is inside iff v <= last.
+            i = int(np.searchsorted(self.data[:, 0], np.uint16(v), side="right")) - 1
+            return i >= 0 and v <= int(self.data[i, 1])
         return bool((int(self.data[v >> 6]) >> (v & 63)) & 1)
 
     def count_range(self, start: int, end: int) -> int:
@@ -179,6 +247,12 @@ class Container:
                 self.data, np.uint16(end), side="left"
             )
             return int(hi - lo)
+        if self.typ == TYPE_RUN:
+            # Clip every run to [start, end): sum of positive overlaps.
+            s = self.data[:, 0].astype(np.int64)
+            l = self.data[:, 1].astype(np.int64)
+            overlap = np.minimum(l, end - 1) - np.maximum(s, start) + 1
+            return int(np.maximum(overlap, 0).sum())
         # Popcount whole words, masking the partial edge words.
         end = min(end, CONTAINER_WIDTH)
         if end <= start:
@@ -198,6 +272,8 @@ class Container:
     def with_bit(self, v: int) -> "Container":
         if self.contains(v):
             return self
+        if self.typ == TYPE_RUN:
+            return self._unrun().with_bit(v)
         if self.typ == TYPE_ARRAY:
             i = int(np.searchsorted(self.data, np.uint16(v)))
             arr = np.insert(self.data, i, np.uint16(v))
@@ -211,6 +287,8 @@ class Container:
     def without_bit(self, v: int) -> "Container":
         if not self.contains(v):
             return self
+        if self.typ == TYPE_RUN:
+            return self._unrun().without_bit(v)
         if self.typ == TYPE_ARRAY:
             i = int(np.searchsorted(self.data, np.uint16(v)))
             return Container(TYPE_ARRAY, np.delete(self.data, i), self._n - 1)
@@ -222,6 +300,8 @@ class Container:
         """Union with a sorted-or-not uint16 position array."""
         if vs.size == 0:
             return self
+        if self.typ == TYPE_RUN:
+            return self._unrun().with_many(vs)
         if self.typ == TYPE_ARRAY:
             arr = np.union1d(self.data, vs.astype(np.uint16))
             return Container.from_positions(arr)
@@ -232,6 +312,8 @@ class Container:
     def without_many(self, vs: np.ndarray) -> "Container":
         if vs.size == 0:
             return self
+        if self.typ == TYPE_RUN:
+            return self._unrun().without_many(vs)
         if self.typ == TYPE_ARRAY:
             arr = np.setdiff1d(self.data, vs.astype(np.uint16), assume_unique=False)
             return Container(TYPE_ARRAY, arr.astype(np.uint16), int(arr.size))
@@ -242,7 +324,7 @@ class Container:
     # -- set algebra -----------------------------------------------------
 
     def intersect(self, other: "Container") -> "Container":
-        a, b = self, other
+        a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             return Container.from_positions(
                 np.intersect1d(a.data, b.data, assume_unique=True)
@@ -255,7 +337,7 @@ class Container:
         return Container.from_bitmap_words(a.data & b.data)
 
     def intersection_count(self, other: "Container") -> int:
-        a, b = self, other
+        a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             return int(np.intersect1d(a.data, b.data, assume_unique=True).size)
         if a.typ == TYPE_ARRAY:
@@ -266,13 +348,13 @@ class Container:
         return int(np.bitwise_count(a.data & b.data).sum())
 
     def union(self, other: "Container") -> "Container":
-        a, b = self, other
+        a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             return Container.from_positions(np.union1d(a.data, b.data))
         return Container.from_bitmap_words(a.bitmap_words() | b.bitmap_words())
 
     def difference(self, other: "Container") -> "Container":
-        a, b = self, other
+        a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY:
             if b.typ == TYPE_ARRAY:
                 out = np.setdiff1d(a.data, b.data, assume_unique=True)
@@ -283,7 +365,7 @@ class Container:
         return Container.from_bitmap_words(a.data & ~b.bitmap_words())
 
     def xor(self, other: "Container") -> "Container":
-        a, b = self, other
+        a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             return Container.from_positions(np.setxor1d(a.data, b.data, assume_unique=True))
         return Container.from_bitmap_words(a.bitmap_words() ^ b.bitmap_words())
@@ -468,6 +550,25 @@ class Bitmap:
             self.op_writer.append_remove_batch(vs)
             self.op_n += int(vs.size)
         return changed
+
+    def optimize(self) -> int:
+        """Re-pack containers as RLE runs where that is the smallest
+        encoding (reference roaring.go Optimize): mutating ops leave
+        array/bitmap results, so long-lived runny fragments call this
+        after bulk loads / snapshots to reclaim host RAM. Returns the
+        number of containers converted."""
+        converted = 0
+        for key in self.keys():
+            c = self._cs[key]
+            if c.typ == TYPE_RUN:
+                continue
+            runs = c.runs()
+            if _runs_win(runs.shape[0], c.n):
+                self._cs[key] = Container(
+                    TYPE_RUN, runs.astype(np.uint16), c.n
+                )
+                converted += 1
+        return converted
 
     def contains(self, v: int) -> bool:
         c = self._cs.get(v >> 16)

@@ -49,6 +49,10 @@ class Config:
     # :153-155). 0 = off. Python CPU profiling needs no config — it's
     # always-available via /debug/pprof/* (utils/profiler.py).
     profile_port: int = 0
+    # Internal HTTP client timeout in seconds (peer queries, probes,
+    # broadcasts). The SIGSTOP/partition tests lower it so hung-peer
+    # retries happen in test time (reference Cluster.stuttering timeouts).
+    client_timeout: float = 30.0
 
     def _split_bind(self) -> tuple[str, int]:
         """Handles host:port, :port, bare host, [v6]:port, and bare IPv6."""
@@ -118,6 +122,7 @@ class Config:
             "long-query-time": "long_query_time",
             "batch-window": "batch_window",
             "preheat": "preheat",
+            "client-timeout": "client_timeout",
         }
         for k, attr in simple.items():
             if k in data:
@@ -150,6 +155,7 @@ class Config:
             pre + "BATCH_WINDOW": ("batch_window", float),
             pre + "PREHEAT": ("preheat", lambda v: v.lower() in ("1", "true")),
             pre + "PROFILE_PORT": ("profile_port", int),
+            pre + "CLIENT_TIMEOUT": ("client_timeout", float),
         }
         for key, (attr, conv) in mapping.items():
             if key in env:
@@ -172,6 +178,7 @@ class Config:
             f"long-query-time = {c.long_query_time}\n"
             f"batch-window = {c.batch_window}\n"
             f"preheat = {str(c.preheat).lower()}\n"
+            f"client-timeout = {c.client_timeout}\n"
             f"[profile]\nport = {c.profile_port}\n"
             "\n[anti-entropy]\n"
             f"interval = {c.anti_entropy_interval}\n"

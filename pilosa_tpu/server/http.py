@@ -375,8 +375,10 @@ class _Handler(BaseHTTPRequestHandler):
     def handle_get_export(self):
         index = self.query.get("index", "")
         field = self.query.get("field", "")
-        shard = int(self.query.get("shard", "0"))
-        csv = self.api.export_csv(index, field, shard)
+        shard = self.query.get("shard")  # absent = whole field, all nodes
+        csv = self.api.export_csv(
+            index, field, int(shard) if shard is not None else None
+        )
         self._reply(csv, content_type="text/csv")
 
     @route("POST", r"/recalculate-caches")
@@ -413,7 +415,13 @@ class _Handler(BaseHTTPRequestHandler):
         utils/profiler.py for why sampling, not cProfile."""
         seconds = min(float(self.query.get("seconds", "10")), 300.0)
         top = int(self.query.get("top", "30"))
-        self._reply(_profiler().profile(seconds, top))
+        rep = _profiler().profile(seconds, top)
+        if "error" in rep:
+            # A manual start/stop session is active: same 409 contract as
+            # the sibling endpoints, not a 200 with zero frames.
+            self._error(rep["error"], status=409)
+            return
+        self._reply(rep)
 
     @route("POST", r"/debug/pprof/start")
     def handle_pprof_start(self):
@@ -574,6 +582,11 @@ class _Handler(BaseHTTPRequestHandler):
     def handle_resize_abort(self):
         self.api.resize_abort()
         self._reply({"success": True})
+
+    @route("POST", r"/cluster/coordinator")
+    def handle_set_coordinator(self):
+        body = self._json_body()
+        self._reply(self.api.set_coordinator(body.get("id", "")))
 
     @route("POST", r"/internal/cluster/message")
     def handle_post_cluster_message(self):

@@ -232,3 +232,86 @@ class TestNative:
         # Known xxh64 vectors (seed 0).
         assert xxhash64(b"") == 0xEF46DB3751D8E999
         assert xxhash64(b"xxhash") == 0x32DD38952C4BC720
+
+
+class TestRunContainers:
+    """First-class in-memory RLE containers (VERDICT r3 missing #5;
+    reference roaring.go:64-69,1940-1943)."""
+
+    def _runny(self):
+        b = Bitmap()
+        # Full run + two fragments: 0..9999 and 20000..20004 in key 0,
+        # a WHOLE container run in key 1.
+        b.add_many(np.arange(0, 10_000, dtype=np.uint64), log=False)
+        b.add_many(np.arange(20_000, 20_005, dtype=np.uint64), log=False)
+        b.add_many(np.arange(1 << 16, 2 << 16, dtype=np.uint64), log=False)
+        return b
+
+    def test_optimize_converts_and_preserves_bits(self):
+        from pilosa_tpu.roaring.bitmap import TYPE_RUN
+
+        b = self._runny()
+        before = b.to_array()
+        n = b.optimize()
+        assert n >= 2
+        assert b.container(0).typ == TYPE_RUN
+        assert b.container(1).typ == TYPE_RUN
+        np.testing.assert_array_equal(b.to_array(), before)
+        # Memory: the full-container run stores 1 run (4 bytes of u16
+        # pairs) instead of an 8 KiB bitmap.
+        assert b.container(1).data.nbytes <= 8
+
+    def test_run_ops_differential(self, rng):
+        from pilosa_tpu.roaring.bitmap import TYPE_RUN
+
+        b = self._runny()
+        b.optimize()
+        plain = Bitmap(b.to_array())
+        other = Bitmap(
+            np.unique(rng.integers(0, 2 << 16, 5000, dtype=np.uint64))
+        )
+        assert b.count() == plain.count()
+        for v in (0, 9_999, 10_000, 20_004, (1 << 16) + 7, (2 << 16) - 1):
+            assert b.contains(v) == plain.contains(v), v
+        np.testing.assert_array_equal(
+            b.intersect(other).to_array(), plain.intersect(other).to_array()
+        )
+        np.testing.assert_array_equal(
+            b.union(other).to_array(), plain.union(other).to_array()
+        )
+        np.testing.assert_array_equal(
+            b.difference(other).to_array(), plain.difference(other).to_array()
+        )
+        np.testing.assert_array_equal(
+            b.xor(other).to_array(), plain.xor(other).to_array()
+        )
+        assert b.count_range(5_000, 70_000) == plain.count_range(5_000, 70_000)
+        # Mutation through a run container stays correct.
+        assert b.add(123_456) == plain.add(123_456)
+        assert b.remove(5) == plain.remove(5)
+        np.testing.assert_array_equal(b.to_array(), plain.to_array())
+
+    def test_serialize_roundtrip_keeps_runs_in_memory(self):
+        from pilosa_tpu.roaring import deserialize, serialize
+        from pilosa_tpu.roaring.bitmap import TYPE_RUN
+
+        b = self._runny()
+        data = serialize(b)
+        back = deserialize(data)
+        # The codec writes runs; the in-memory load must KEEP them RLE
+        # (it used to inflate to array/bitmap).
+        assert back.container(1).typ == TYPE_RUN
+        np.testing.assert_array_equal(back.to_array(), b.to_array())
+        # Re-serialize is byte-identical (same encodings chosen).
+        assert serialize(back) == data
+
+    def test_fragment_pack_with_runs(self, rng):
+        from pilosa_tpu.core.fragment import Fragment
+        from pilosa_tpu.ops.blocks import pack_fragment, unpack_row
+
+        f = Fragment(None, "i", "f", "standard", 0)
+        cols = np.arange(1000, 70_000, dtype=np.uint64)
+        f.bulk_import(np.zeros(cols.size, dtype=np.uint64), cols)
+        f.storage.optimize()
+        block = pack_fragment(f)
+        np.testing.assert_array_equal(unpack_row(block[0]), cols)
