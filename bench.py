@@ -157,15 +157,19 @@ def bench_sweep_device_only(be) -> float:
     gblock, _ = be._get_block("bench", be._field("bench", "g"), tuple(range(SHARDS)))
     prog = be._pair_program()
     np.asarray(prog(fblock, gblock))  # compile + warm
-    t0 = time.perf_counter()
-    np.asarray(prog(fblock, gblock))
-    t_one = time.perf_counter() - t0
-    k = 12
-    t0 = time.perf_counter()
-    outs = [prog(fblock, gblock) for _ in range(k)]
-    np.asarray(outs[-1])  # block on the last: the k dispatches pipeline
-    t_k = time.perf_counter() - t0
-    return max(0.0, (t_k - t_one) / (k - 1))
+
+    def t_chain(k: int) -> float:
+        t0 = time.perf_counter()
+        outs = [prog(fblock, gblock) for _ in range(k)]
+        np.asarray(outs[-1])  # block on the last: the k dispatches pipeline
+        return time.perf_counter() - t0
+
+    # Slope between two pipelined chain lengths cancels the constant
+    # round-trip + readback cost; median of 3 trials rides out relay
+    # jitter (a single (t_k - t_1) delta went negative under noise).
+    k1, k2 = 4, 16
+    slopes = sorted((t_chain(k2) - t_chain(k1)) / (k2 - k1) for _ in range(3))
+    return max(0.0, slopes[1])
 
 
 def bench_tpu_single(be, queries) -> tuple[float, float]:

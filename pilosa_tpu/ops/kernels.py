@@ -121,6 +121,79 @@ def pair_stats(f_stack, g_stack, interpret: bool = False):
     )(f_stack, g_stack)
 
 
+def _pair_stats_pershard_kernel(f_ref, g_ref, pair_ref, cf_ref, cg_ref):
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _():
+        pair_ref[...] = jnp.zeros_like(pair_ref)
+        cf_ref[...] = jnp.zeros_like(cf_ref)
+        cg_ref[...] = jnp.zeros_like(cg_ref)
+
+    f = f_ref[0]  # [Rf, WT]
+    g = g_ref[0]  # [Rg, WT]
+    pc = jax.lax.population_count(f[:, None, :] & g[None, :, :]).astype(jnp.int32)
+    pair_ref[0] += jnp.sum(pc, axis=-1)
+    cf_ref[0, 0] += jnp.sum(jax.lax.population_count(f).astype(jnp.int32), axis=-1)
+    cg_ref[0, 0] += jnp.sum(jax.lax.population_count(g).astype(jnp.int32), axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pair_stats_pershard(f_stack, g_stack, interpret: bool = False):
+    """pair_stats WITHOUT the shard reduction:
+    (uint32[S, Rf, W], uint32[S, Rg, W]) ->
+    (pair int32[S, Rf, Rg], cf int32[S, 1, Rf], cg int32[S, 1, Rg]).
+
+    The per-shard table is what makes write churn cheap: the host keeps
+    it resident, totals are its int64 sum, and a write epoch that dirtied
+    D shards replaces D rows of the table from host-packed slabs
+    (tpu.py _host_slab_pair_flat) instead of re-sweeping the stacks on
+    device — the reference's incremental rank-cache maintenance
+    (cache.go:136-301) applied to the pair matrix. Per-shard counts are
+    <= 2^20 so int32 is exact for ANY shard count (the summed kernel's
+    MAX_PAIR_SHARDS bound applies only to device-side totals)."""
+    s, rf, w = f_stack.shape
+    rg = g_stack.shape[1]
+    wt = _word_tile(rf, rg, w)
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        params = pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.ARBITRARY,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            )
+        )
+    except (ImportError, AttributeError):  # pragma: no cover
+        params = None
+    return pl.pallas_call(
+        _pair_stats_pershard_kernel,
+        # Shards outermost: each shard's output blocks see their word-tile
+        # visits consecutively, so the VMEM accumulator carries across w
+        # and flushes once per shard.
+        grid=(s, w // wt),
+        in_specs=[
+            pl.BlockSpec((1, rf, wt), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, rg, wt), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, rf, rg), lambda i, j: (i, 0, 0)),
+            # cf/cg carry a singleton middle axis: Mosaic requires the
+            # block's last two dims to divide (8, 128) or equal the array
+            # dims, and a [S, R] layout's (1, R) block satisfies neither.
+            pl.BlockSpec((1, 1, rf), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, rg), lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, rf, rg), jnp.int32),
+            jax.ShapeDtypeStruct((s, 1, rf), jnp.int32),
+            jax.ShapeDtypeStruct((s, 1, rg), jnp.int32),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(f_stack, g_stack)
+
+
 def _make_nary_kernel(n_extra: int, extra_rows: tuple, filtered: bool):
     """Kernel for the N-field group tensor: 2 'pair' fields broadcast in
     VMEM + n_extra mask fields whose row combination is selected by the

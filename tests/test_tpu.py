@@ -414,6 +414,180 @@ class TestCountBatch:
         idx.field("f").set_bit(1, col)
         assert be.count_batch("i", calls, shards) == [first[0] + 1]
 
+    def test_host_slab_stats_match_pershard_kernel(self, rng):
+        """The host-update helper must agree bit-for-bit with the device
+        per-shard kernel — a host-refreshed table row sits next to
+        device-swept rows."""
+        from pilosa_tpu.exec.tpu import _host_slab_pair_flat
+        from pilosa_tpu.ops.kernels import pair_stats_pershard
+
+        S, RF, RG, W = 3, 8, 4, 512
+        f = rng.integers(0, 2**32, (S, RF, W), dtype=np.uint32)
+        g = rng.integers(0, 2**32, (S, RG, W), dtype=np.uint32)
+        pair, cf, cg = (
+            np.asarray(x) for x in pair_stats_pershard(f, g, interpret=True)
+        )
+        for i in range(S):
+            np.testing.assert_array_equal(
+                np.concatenate([pair[i].ravel(), cf[i, 0], cg[i, 0]]),
+                _host_slab_pair_flat(f[i], g[i]),
+            )
+
+    def _pair_counters(self):
+        from pilosa_tpu.utils.stats import global_stats
+
+        c = global_stats._counters
+        return (
+            c[("pair_stats_sweeps_total", ())],
+            c[("pair_stats_incremental_updates_total", ())],
+        )
+
+    def test_pair_incremental_host_update(self, holder, rng):
+        """Write epochs are absorbed by the host per-shard table: after
+        the one cold sweep, mutations cost zero device sweeps and every
+        epoch's batch stays oracle-exact (the write-churn serving path,
+        VERDICT r3 #1)."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        queries = [
+            "Intersect(Row(f=1), Row(g=9))",
+            "Union(Row(f=2), Row(g=9))",
+            "Difference(Row(f=3), Row(g=9))",
+            "Xor(Row(f=1), Row(g=9))",
+            "Row(f=2)",
+        ]
+        calls = [parse_string(q).calls[0] for q in queries]
+        shards = [0, 1]
+        be.count_batch("i", calls, shards)
+        s0, u0 = self._pair_counters()
+        cpu = Executor(holder)
+        wcol = 11  # fresh columns: every Set is a real mutation
+        set_cols = []
+        for epoch in range(4):
+            for _ in range(3):
+                fname = ("f", "g")[int(rng.integers(0, 2))]
+                row = int(rng.integers(1, 4)) if fname == "f" else 9
+                if set_cols and rng.integers(0, 3) == 0:
+                    f2, r2, c2 = set_cols.pop()
+                    idx.field(f2).clear_bit(r2, c2)
+                else:
+                    wcol += 97
+                    idx.field(fname).set_bit(row, wcol % (2 * SHARD_WIDTH))
+                    set_cols.append((fname, row, wcol % (2 * SHARD_WIDTH)))
+            got = be.count_batch("i", calls, shards)
+            want = [cpu.execute("i", f"Count({q})")[0] for q in queries]
+            assert got == want, (epoch, got, want)
+            s1, u1 = self._pair_counters()
+            assert s1 == s0, "write epoch must not re-sweep on device"
+            assert u1 == u0 + epoch + 1
+        # Repeat without writes: plain identity hit, no update, no sweep.
+        assert be.count_batch("i", calls, shards) == want
+        assert self._pair_counters() == (s0, u0 + 4)
+
+    def test_pair_incremental_same_field_pair(self, holder, rng):
+        """Singles-only batches plan as the (f, f) self-pair; the host
+        update must handle fb == fa (one slab, both sides)."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        calls = [parse_string(f"Row(f={r})").calls[0] for r in (1, 2, 3)]
+        shards = [0, 1]
+        be.count_batch("i", calls, shards)
+        s0, u0 = self._pair_counters()
+        idx.field("f").set_bit(2, 123457)
+        cpu = Executor(holder)
+        got = be.count_batch("i", calls, shards)
+        want = [cpu.execute("i", f"Count(Row(f={r}))")[0] for r in (1, 2, 3)]
+        assert got == want
+        assert self._pair_counters() == (s0, u0 + 1)
+
+    def test_pair_incremental_threshold_falls_back_to_sweep(self, holder, rng):
+        """Epochs dirtying more shards than the cutoff re-sweep instead
+        of paying per-shard host work."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        be.MAX_PAIR_HOST_UPDATE_SHARDS = 0  # force the gate shut
+        calls = [parse_string("Intersect(Row(f=1), Row(g=9))").calls[0]]
+        shards = [0, 1]
+        first = be.count_batch("i", calls, shards)
+        s0, u0 = self._pair_counters()
+        g_cols = set(Executor(holder).backend.bitmap_call_shard(
+            "i", parse_string("Row(g=9)").calls[0], 0).columns().tolist())
+        f_cols = set(Executor(holder).backend.bitmap_call_shard(
+            "i", parse_string("Row(f=1)").calls[0], 0).columns().tolist())
+        idx.field("f").set_bit(1, next(iter(g_cols - f_cols)))
+        assert be.count_batch("i", calls, shards) == [first[0] + 1]
+        s1, u1 = self._pair_counters()
+        assert (s1, u1) == (s0 + 1, u0)
+
+    def test_topn_incremental_host_update(self, holder, rng):
+        """TopN's rank vector absorbs write epochs via the per-shard
+        row-count table — no re-dispatch for a small epoch, results stay
+        oracle-exact (including Rows(), which serves from it)."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.utils.stats import global_stats
+
+        be = TPUBackend(holder)
+        ex_cpu = Executor(holder)
+        ex_tpu = Executor(holder, backend=be)
+        q = "TopN(f, n=0)"
+        assert ex_tpu.execute("i", q) == ex_cpu.execute("i", q)
+
+        def upds():
+            return global_stats._counters[("topn_incremental_updates_total", ())]
+
+        u0 = upds()
+        wcol = 5
+        for epoch in range(3):
+            wcol += 131071
+            idx.field("f").set_bit(int(rng.integers(1, 4)), wcol % (2 * SHARD_WIDTH))
+            assert ex_tpu.execute("i", q) == ex_cpu.execute("i", q)
+            assert ex_tpu.execute("i", "Rows(f)") == ex_cpu.execute("i", "Rows(f)")
+            assert upds() == u0 + epoch + 1
+
+    def test_pair_pershard_size_gate(self, holder, rng):
+        """Over the per-shard-table byte gate the sweep returns summed
+        totals (no resident table) and write epochs re-sweep — correct,
+        just without the incremental path."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        be.MAX_PAIR_PERSHARD_BYTES = 0
+        calls = [parse_string("Intersect(Row(f=1), Row(g=9))").calls[0]]
+        shards = [0, 1]
+        first = be.count_batch("i", calls, shards)
+        assert be._pair_cache[("i", "f", "g")].pershard is None
+        s0, u0 = self._pair_counters()
+        idx.field("f").set_bit(1, 3)
+        want = Executor(holder).execute("i", "Count(Intersect(Row(f=1), Row(g=9)))")
+        assert be.count_batch("i", calls, shards) == want
+        assert self._pair_counters() == (s0 + 1, u0)
+        assert first is not None
+
+    def test_topn_refresh_on_out_of_scope_write(self, holder, rng):
+        """Writes to shards OUTSIDE the queried set bump the view
+        generation but must not degrade TopN to a dispatch per query —
+        the entry re-keys with unchanged counts."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.utils.stats import global_stats
+
+        be = TPUBackend(holder)
+        want = be.topn_field("i", "f", [0], 0)
+        disp0 = global_stats._counters[("topn_cache_hits_total", ())]
+        for k in range(3):
+            # Shard 5 is far outside the queried set [0].
+            idx.field("f").set_bit(1, 5 * SHARD_WIDTH + k)
+            assert be.topn_field("i", "f", [0], 0) == want
+        # Second query after each write serves as a plain generation hit.
+        assert be.topn_field("i", "f", [0], 0) == want
+        assert global_stats._counters[("topn_cache_hits_total", ())] == disp0 + 1
+
 
 class TestGroupByDevice:
     """Device GroupBy = whole-query group-count tensor (VERDICT r2 #4);
