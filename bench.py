@@ -81,11 +81,11 @@ def build_index(h: Holder):
     idx = h.create_index("bench")
     rng = np.random.default_rng(42)
     n_bits = int(SHARD_WIDTH * DENSITY)
+    rows = np.repeat(np.arange(ROWS, dtype=np.uint64), n_bits)
     for fname in ("f", "g"):
         field = idx.create_field(fname)
         for shard in range(SHARDS):
             base = shard * SHARD_WIDTH
-            rows = np.repeat(np.arange(ROWS, dtype=np.uint64), n_bits)
             cols = rng.integers(0, SHARD_WIDTH, ROWS * n_bits, dtype=np.uint64) + base
             field.import_bits(rows, cols)
     # Small third field for the 3-field GroupBy measurement (4 rows,

@@ -81,7 +81,16 @@ class CPUBackend:
         raise QueryError(f"unknown call: {c.name}")
 
     def count_shard(self, index: str, c: Call, shard: int) -> int:
-        """Seam for device backends to fuse count without materializing."""
+        """Seam for device backends to fuse count without materializing.
+
+        The host path short-circuits Count(Intersect(a, b)) through
+        container-level intersection_count (reference
+        roaring.IntersectionCount, roaring/roaring.go:570) — counting
+        membership masks directly instead of building the result row."""
+        if c.name == "Intersect" and len(c.children) == 2 and not c.args:
+            a = self.bitmap_call_shard(index, c.children[0], shard)
+            b = self.bitmap_call_shard(index, c.children[1], shard)
+            return a.intersection_count(b)
         return self.bitmap_call_shard(index, c, shard).count()
 
     def _nary(self, index: str, c: Call, shard: int, op: str, empty_ok: bool) -> Row:

@@ -2075,12 +2075,19 @@ class TPUBackend:
             )
         else:
             s_pad = block.shape[0]
-            # Unfiltered single-device: always take [S, R] partials —
-            # the per-shard table is what absorbs later write epochs.
+            # Unfiltered single-device: take [S, R] partials — the
+            # per-shard table is what absorbs later write epochs — but
+            # only under the same retention byte gate as the pair table
+            # (a many-row field's [S, R] readback + resident copy can
+            # reach hundreds of MB; over the gate, device-sum to [R]
+            # and let write epochs re-dispatch).
+            pershard_ok = (
+                src_call is None
+                and self.mesh is None
+                and s_pad * rp * 8 <= self.MAX_PAIR_PERSHARD_BYTES
+            )
             reduce_dev = (
-                s_pad <= MAX_DEVICE_SUM_SHARDS
-                if (src_call is not None or self.mesh is not None)
-                else False
+                False if pershard_ok else s_pad <= MAX_DEVICE_SUM_SHARDS
             )
             with jax.profiler.TraceAnnotation("pilosa.topn"):
                 if src_call is None:

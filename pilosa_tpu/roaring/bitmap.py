@@ -48,6 +48,28 @@ def _runs_win(run_count: int, n: int) -> bool:
     return 4 * run_count < min(2 * n, 8 * BITMAP_N)
 
 
+def _sorted_member_mask(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Boolean mask over a: a[i] ∈ b (both sorted unique — the array-
+    container invariant). A 64 KiB bool lookup over the uint16 domain:
+    measured 18 µs vs 64 µs for vectorized binary search and 98 µs for
+    np.intersect1d (which re-SORTS the concatenation — that sort alone
+    profiled as 75% of the CPU oracle's whole query time)."""
+    if a.size == 0 or b.size == 0:
+        return np.zeros(a.size, dtype=bool)
+    table = np.zeros(CONTAINER_WIDTH, dtype=bool)
+    table[b] = True
+    return table[a]
+
+
+def _sorted_union(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of two sorted unique uint16 arrays. kind='stable' is radix
+    sort for small ints — O(n), no comparison re-sort of sorted runs."""
+    out = np.sort(np.concatenate([a, b]), kind="stable")
+    if out.size:
+        out = out[np.concatenate(([True], out[1:] != out[:-1]))]
+    return out
+
+
 def _as_bitmap_words(arr: np.ndarray) -> np.ndarray:
     """Sorted uint16 positions -> uint64[1024] bitmap words."""
     words = np.zeros(BITMAP_N, dtype=np.uint64)
@@ -303,7 +325,7 @@ class Container:
         if self.typ == TYPE_RUN:
             return self._unrun().with_many(vs)
         if self.typ == TYPE_ARRAY:
-            arr = np.union1d(self.data, vs.astype(np.uint16))
+            arr = _sorted_union(self.data, np.unique(vs.astype(np.uint16)))
             return Container.from_positions(arr)
         words = self.data.copy()
         np.bitwise_or.at(words, vs >> 6, np.uint64(1) << (vs.astype(np.uint64) & np.uint64(63)))
@@ -315,8 +337,11 @@ class Container:
         if self.typ == TYPE_RUN:
             return self._unrun().without_many(vs)
         if self.typ == TYPE_ARRAY:
-            arr = np.setdiff1d(self.data, vs.astype(np.uint16), assume_unique=False)
-            return Container(TYPE_ARRAY, arr.astype(np.uint16), int(arr.size))
+            keep = ~_sorted_member_mask(
+                self.data, np.unique(vs.astype(np.uint16))
+            )
+            arr = self.data[keep]
+            return Container(TYPE_ARRAY, arr, int(arr.size))
         mask = np.zeros(BITMAP_N, dtype=np.uint64)
         np.bitwise_or.at(mask, vs >> 6, np.uint64(1) << (vs.astype(np.uint64) & np.uint64(63)))
         return Container.from_bitmap_words(self.data & ~mask)
@@ -326,8 +351,10 @@ class Container:
     def intersect(self, other: "Container") -> "Container":
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
+            if a.data.size > b.data.size:
+                a, b = b, a  # search the smaller array in the larger
             return Container.from_positions(
-                np.intersect1d(a.data, b.data, assume_unique=True)
+                a.data[_sorted_member_mask(a.data, b.data)]
             )
         if a.typ == TYPE_ARRAY:
             a, b = b, a
@@ -339,7 +366,9 @@ class Container:
     def intersection_count(self, other: "Container") -> int:
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
-            return int(np.intersect1d(a.data, b.data, assume_unique=True).size)
+            if a.data.size > b.data.size:
+                a, b = b, a
+            return int(_sorted_member_mask(a.data, b.data).sum())
         if a.typ == TYPE_ARRAY:
             a, b = b, a
         if b.typ == TYPE_ARRAY:
@@ -350,14 +379,14 @@ class Container:
     def union(self, other: "Container") -> "Container":
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
-            return Container.from_positions(np.union1d(a.data, b.data))
+            return Container.from_positions(_sorted_union(a.data, b.data))
         return Container.from_bitmap_words(a.bitmap_words() | b.bitmap_words())
 
     def difference(self, other: "Container") -> "Container":
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY:
             if b.typ == TYPE_ARRAY:
-                out = np.setdiff1d(a.data, b.data, assume_unique=True)
+                out = a.data[~_sorted_member_mask(a.data, b.data)]
             else:
                 keep = (b.data[a.data >> 6] >> (a.data.astype(np.uint64) & np.uint64(63))) & np.uint64(1)
                 out = a.data[keep == 0]
@@ -519,12 +548,29 @@ class Bitmap:
         roaring/roaring.go:1511): pre-grouped sorted-unique lows per key
         (native.import_containers output) merge one container at a time —
         no per-value work, no comparison sort. Returns bits added.
-        Op-logging is the caller's job (it holds the positions)."""
+        Op-logging is the caller's job (it holds the positions).
+
+        OWNERSHIP: fresh containers keep zero-copy views of `lows`, so
+        the caller must hand over an owned buffer it will not reuse
+        (native.import_containers allocates one per call)."""
         changed = 0
         off = 0
         for j in range(keys.size):
             cnt = int(counts[j])
-            changed += self._merge_lows(int(keys[j]), lows[off : off + cnt])
+            key = int(keys[j])
+            chunk = lows[off : off + cnt]
+            c = self._cs.get(key)
+            if c is None:
+                if cnt <= ARRAY_MAX_SIZE:
+                    nc = Container(TYPE_ARRAY, chunk, cnt)
+                else:
+                    nc = Container(TYPE_BITMAP, _as_bitmap_words(chunk), cnt)
+                self._put(key, nc)
+                changed += cnt
+            else:
+                nc = c.with_many(chunk)
+                self._put(key, nc)
+                changed += nc.n - c.n
             off += cnt
         return changed
 
@@ -653,7 +699,32 @@ class Bitmap:
 
     def intersection_count(self, other: "Bitmap") -> int:
         keys = self._cs.keys() & other._cs.keys()
-        return sum(self._cs[k].intersection_count(other._cs[k]) for k in keys)
+        # Array-array pairs batch into ONE native sorted-merge call per
+        # row pair (reference intersectionCountArrayArray,
+        # roaring/roaring.go:570) — the per-container Python dispatch was
+        # the CPU executor's dominant cost at bench density; other type
+        # pairs take the per-container path.
+        aa_a: list[np.ndarray] = []
+        aa_b: list[np.ndarray] = []
+        total = 0
+        for k in keys:
+            ca, cb = self._cs[k], other._cs[k]
+            if ca.typ == TYPE_ARRAY and cb.typ == TYPE_ARRAY:
+                aa_a.append(ca.data)
+                aa_b.append(cb.data)
+            else:
+                total += ca.intersection_count(cb)
+        if aa_a:
+            from pilosa_tpu import native
+
+            n = native.intersection_count_many(aa_a, aa_b)
+            if n is None:
+                n = sum(
+                    int(_sorted_member_mask(a, b).sum())
+                    for a, b in zip(aa_a, aa_b)
+                )
+            total += n
+        return total
 
     def union(self, other: "Bitmap") -> "Bitmap":
         return self._binary(other, Container.union, self._cs.keys() | other._cs.keys())
