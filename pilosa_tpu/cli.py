@@ -38,7 +38,9 @@ def cmd_server(args) -> int:
         try:
             from pilosa_tpu.exec.tpu import TPUBackend
 
-            backend = TPUBackend(holder)
+            backend = TPUBackend(
+                holder, max_bytes=cfg.max_hbm_bytes or None
+            )
             log.printf("executor=tpu: device backend enabled")
         except Exception as e:  # no usable device: fall back
             log.printf("executor=tpu unavailable (%s); falling back to cpu", e)

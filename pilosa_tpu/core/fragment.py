@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import deque
 from typing import Callable, Iterable, Optional
 
 import numpy as np
@@ -150,6 +151,12 @@ class Fragment:
         # Lazily-computed per-block checksums, invalidated by row on write
         # (reference caches block checksums too, fragment.go:1762-1776).
         self._block_sums: dict[int, int] = {}
+        # Ring of recent single-bit mutations (version, row, local_col,
+        # sign) — the exact deltas the TPU backend's host stats tables
+        # apply per write epoch instead of re-deriving whole shard slabs
+        # (exec/tpu.py _pair_try_incremental). Lazy: bulk-loaded
+        # fragments that never see point writes pay nothing.
+        self.bit_ops: Optional[deque] = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -248,6 +255,37 @@ class Fragment:
                 self._row_cache.pop(r, None)
                 self._block_sums.pop(r // HASH_BLOCK_SIZE, None)
 
+    #: bit_ops ring capacity: covers any realistic point-write burst
+    #: between two stats-table refreshes; overflow just means the next
+    #: refresh re-derives the shard slab instead of applying deltas.
+    BIT_OPS_MAX = 512
+
+    def _record_bit_op(self, row_id: int, column_id: int, sign: int) -> None:
+        """Called with self.lock held, right after _mutated bumped
+        version for exactly this one-bit change."""
+        if self.bit_ops is None:
+            self.bit_ops = deque(maxlen=self.BIT_OPS_MAX)
+        self.bit_ops.append(
+            (self.version, row_id, int(column_id % SHARD_WIDTH), sign)
+        )
+
+    def bit_ops_between(self, v0: int, v1: int):
+        """The exact single-bit mutations [(version, row, local_col,
+        sign), ...] covering versions (v0, v1], or None when the window
+        is not fully explained by recorded point writes (bulk import,
+        ClearRow/Store, set_value, or ring eviction). Every mutation
+        bumps version exactly once, so coverage is checkable by count:
+        the window is covered iff the ring holds one entry per version
+        in (v0, v1]."""
+        if v1 <= v0:
+            return []
+        with self.lock:
+            ops = self.bit_ops
+            if ops is None:
+                return None
+            window = [op for op in ops if v0 < op[0] <= v1]
+        return window if len(window) == v1 - v0 else None
+
     def set_bit(self, row_id: int, column_id: int) -> bool:
         """reference fragment.go setBit :647 (+ handleMutex :670)."""
         with self.lock:
@@ -258,6 +296,7 @@ class Fragment:
                 changed = True
                 self.cache.add(row_id, self.row_count(row_id))
                 self._mutated([row_id])
+                self._record_bit_op(row_id, column_id, +1)
                 if row_id > self.max_row_id:
                     self.max_row_id = row_id
             self._increment_op_n()
@@ -268,6 +307,7 @@ class Fragment:
             if self.storage.remove(pos(row_id, column_id)):
                 self.cache.add(row_id, self.row_count(row_id))
                 self._mutated([row_id])
+                self._record_bit_op(row_id, column_id, -1)
                 self._increment_op_n()
                 return True
             return False
@@ -285,6 +325,7 @@ class Fragment:
                 self.storage.remove(row_id * SHARD_WIDTH + col)
                 self.cache.add(row_id, self.row_count(row_id))
                 self._mutated([row_id])
+                self._record_bit_op(row_id, col, -1)
                 return True
         return False
 

@@ -62,8 +62,10 @@ def _sorted_member_mask(a: np.ndarray, b: np.ndarray) -> np.ndarray:
 
 
 def _sorted_union(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """Union of two sorted unique uint16 arrays. kind='stable' is radix
-    sort for small ints — O(n), no comparison re-sort of sorted runs."""
+    """Union of two uint16 arrays (sorted-unique NOT required — the
+    stable sort + adjacent dedup handle anything; sorted inputs just
+    make the radix pass cheap). kind='stable' is radix sort for small
+    ints — O(n), no comparison re-sort of sorted runs."""
     out = np.sort(np.concatenate([a, b]), kind="stable")
     if out.size:
         out = out[np.concatenate(([True], out[1:] != out[:-1]))]
@@ -325,7 +327,9 @@ class Container:
         if self.typ == TYPE_RUN:
             return self._unrun().with_many(vs)
         if self.typ == TYPE_ARRAY:
-            arr = _sorted_union(self.data, np.unique(vs.astype(np.uint16)))
+            # _sorted_union's stable radix sort + adjacent-dedup handles
+            # unsorted/duplicated vs directly — no np.unique pre-sort.
+            arr = _sorted_union(self.data, vs.astype(np.uint16))
             return Container.from_positions(arr)
         words = self.data.copy()
         np.bitwise_or.at(words, vs >> 6, np.uint64(1) << (vs.astype(np.uint64) & np.uint64(63)))
@@ -337,9 +341,8 @@ class Container:
         if self.typ == TYPE_RUN:
             return self._unrun().without_many(vs)
         if self.typ == TYPE_ARRAY:
-            keep = ~_sorted_member_mask(
-                self.data, np.unique(vs.astype(np.uint16))
-            )
+            # The membership table is duplicate- and order-insensitive.
+            keep = ~_sorted_member_mask(self.data, vs.astype(np.uint16))
             arr = self.data[keep]
             return Container(TYPE_ARRAY, arr, int(arr.size))
         mask = np.zeros(BITMAP_N, dtype=np.uint64)

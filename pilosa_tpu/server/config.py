@@ -53,6 +53,10 @@ class Config:
     # broadcasts). The SIGSTOP/partition tests lower it so hung-peer
     # retries happen in test time (reference Cluster.stuttering timeouts).
     client_timeout: float = 30.0
+    # HBM residency budget in bytes for the TPU backend's field stacks
+    # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
+    # via row paging instead of whole-stack residency.
+    max_hbm_bytes: int = 0
 
     def _split_bind(self) -> tuple[str, int]:
         """Handles host:port, :port, bare host, [v6]:port, and bare IPv6."""
@@ -92,6 +96,7 @@ class Config:
             "long-query-time": self.long_query_time,
             "batch-window": self.batch_window,
             "preheat": self.preheat,
+            "max-hbm-bytes": self.max_hbm_bytes,
             "profile": {"port": self.profile_port},
         }
 
@@ -123,6 +128,7 @@ class Config:
             "batch-window": "batch_window",
             "preheat": "preheat",
             "client-timeout": "client_timeout",
+            "max-hbm-bytes": "max_hbm_bytes",
         }
         for k, attr in simple.items():
             if k in data:
@@ -156,6 +162,7 @@ class Config:
             pre + "PREHEAT": ("preheat", lambda v: v.lower() in ("1", "true")),
             pre + "PROFILE_PORT": ("profile_port", int),
             pre + "CLIENT_TIMEOUT": ("client_timeout", float),
+            pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
         }
         for key, (attr, conv) in mapping.items():
             if key in env:
@@ -179,6 +186,7 @@ class Config:
             f"batch-window = {c.batch_window}\n"
             f"preheat = {str(c.preheat).lower()}\n"
             f"client-timeout = {c.client_timeout}\n"
+            f"max-hbm-bytes = {c.max_hbm_bytes}\n"
             f"[profile]\nport = {c.profile_port}\n"
             "\n[anti-entropy]\n"
             f"interval = {c.anti_entropy_interval}\n"

@@ -505,13 +505,15 @@ class TestCountBatch:
         assert self._pair_counters() == (s0, u0 + 1)
 
     def test_pair_incremental_threshold_falls_back_to_sweep(self, holder, rng):
-        """Epochs dirtying more shards than the cutoff re-sweep instead
-        of paying per-shard host work."""
+        """Epochs whose slab-tier shard count exceeds the cutoff re-sweep
+        instead of paying per-shard host work. A bulk import is not
+        delta-coverable (no bit-op ring entries), so with the gate shut
+        it must go back to the device."""
         idx = self._setup(holder, rng)
         from pilosa_tpu.pql import parse_string
 
         be = TPUBackend(holder)
-        be.MAX_PAIR_HOST_UPDATE_SHARDS = 0  # force the gate shut
+        be.MAX_PAIR_HOST_UPDATE_SHARDS = 0  # force the slab gate shut
         calls = [parse_string("Intersect(Row(f=1), Row(g=9))").calls[0]]
         shards = [0, 1]
         first = be.count_batch("i", calls, shards)
@@ -520,10 +522,52 @@ class TestCountBatch:
             "i", parse_string("Row(g=9)").calls[0], 0).columns().tolist())
         f_cols = set(Executor(holder).backend.bitmap_call_shard(
             "i", parse_string("Row(f=1)").calls[0], 0).columns().tolist())
-        idx.field("f").set_bit(1, next(iter(g_cols - f_cols)))
+        col = next(iter(g_cols - f_cols))
+        idx.field("f").import_bits(
+            np.array([1], dtype=np.uint64), np.array([col], dtype=np.uint64)
+        )
         assert be.count_batch("i", calls, shards) == [first[0] + 1]
         s1, u1 = self._pair_counters()
         assert (s1, u1) == (s0 + 1, u0)
+
+    def test_pair_delta_tier_applies_point_writes(self, holder, rng):
+        """Point writes are absorbed by the delta tier (bit-op ring ->
+        cf/pair adjustments), not slab recompute: the delta-op counter
+        moves and results stay oracle-exact, including clears and writes
+        to the 'other' field of the pair."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+        from pilosa_tpu.utils.stats import global_stats
+
+        be = TPUBackend(holder)
+        queries = [
+            "Intersect(Row(f=1), Row(g=9))",
+            "Union(Row(f=2), Row(g=9))",
+            "Xor(Row(f=3), Row(g=9))",
+        ]
+        calls = [parse_string(q).calls[0] for q in queries]
+        shards = [0, 1]
+        be.count_batch("i", calls, shards)
+        cpu = Executor(holder)
+
+        def dops():
+            return global_stats._counters[("pair_stats_delta_ops_total", ())]
+
+        d0 = dops()
+        n_ops = 0
+        for k in range(6):
+            fname = ("f", "g")[k % 2]
+            row = (1 + k % 3) if fname == "f" else 9
+            col = 777_000 + k
+            idx.field(fname).set_bit(row, col)
+            n_ops += 1
+            if k == 3:  # a clear in the middle of the stream
+                idx.field(fname).clear_bit(row, col)
+                n_ops += 1
+            got = be.count_batch("i", calls, shards)
+            want = [cpu.execute("i", f"Count({q})")[0] for q in queries]
+            assert got == want, (k, got, want)
+        assert dops() == d0 + n_ops
 
     def test_topn_incremental_host_update(self, holder, rng):
         """TopN's rank vector absorbs write epochs via the per-shard
@@ -569,6 +613,58 @@ class TestCountBatch:
         assert be.count_batch("i", calls, shards) == want
         assert self._pair_counters() == (s0 + 1, u0)
         assert first is not None
+
+    def test_pair_cache_concurrent_readers_and_writers(self, holder, rng):
+        """The freshness protocol under real thread interleaving: batch
+        readers race bit writers; every observed count must correspond
+        to SOME prefix of the writes (never above the final state, never
+        below the initial — staleness is allowed, corruption is not; the
+        store rule is last-writer-wins, so per-reader monotonicity is
+        NOT promised), and after writers finish the caches converge to
+        oracle-exact."""
+        import threading
+
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        be = TPUBackend(holder)
+        calls = [parse_string("Intersect(Row(f=1), Row(g=9))").calls[0]]
+        shards = [0, 1]
+        initial = be.count_batch("i", calls, shards)[0]
+        cpu = Executor(holder)
+        g_cols = set(cpu.backend.bitmap_call_shard(
+            "i", parse_string("Row(g=9)").calls[0], 0).columns().tolist())
+        f_cols = set(cpu.backend.bitmap_call_shard(
+            "i", parse_string("Row(f=1)").calls[0], 0).columns().tolist())
+        # 24 columns in g=9 but not f=1: each Set(f=1) adds exactly +1.
+        to_set = sorted(g_cols - f_cols)[:24]
+        errors: list = []
+        stop = threading.Event()
+
+        def writer():
+            for col in to_set:
+                idx.field("f").set_bit(1, col)
+            stop.set()
+
+        def reader():
+            while not stop.is_set():
+                got = be.count_batch("i", calls, shards)[0]
+                if not (initial <= got <= initial + len(to_set)):
+                    errors.append(("count out of range", initial, got))
+                    return
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        wt = threading.Thread(target=writer)
+        for t in threads:
+            t.start()
+        wt.start()
+        wt.join()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:3]
+        want = cpu.execute("i", "Count(Intersect(Row(f=1), Row(g=9)))")
+        assert be.count_batch("i", calls, shards) == want
+        assert want[0] == initial + len(to_set)
 
     def test_topn_refresh_on_out_of_scope_write(self, holder, rng):
         """Writes to shards OUTSIDE the queried set bump the view
