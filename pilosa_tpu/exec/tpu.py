@@ -2132,21 +2132,35 @@ class TPUBackend:
         for (spec, _bk), idxs in groups.items():
             blocks = assembled[idxs[0]][0]
             n_scalars = len(assembled[idxs[0]][1])
+            s_pad = blocks[0].shape[0]
+            reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
+            if n_scalars == 0:
+                # No per-query scalars: every call in the group is the
+                # SAME program over the same blocks (e.g. Count(All())
+                # repeated) — one fused count serves them all; a scan
+                # over a zero-leaf pytree has no query axis to scan.
+                with jax.profiler.TraceAnnotation("pilosa.count_batch"):
+                    out = self._program("count", spec, reduce_dev)(blocks, ())
+                pending.append((idxs, out, True))
+                continue
             scalars = tuple(
                 np.stack(
                     [np.asarray(assembled[i][1][j], dtype=np.uint32) for i in idxs]
                 )
                 for j in range(n_scalars)
             )
-            s_pad = blocks[0].shape[0]
-            reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
             with jax.profiler.TraceAnnotation("pilosa.count_batch"):
                 out = self._program("count_batch", spec, reduce_dev)(blocks, scalars)
-            pending.append((idxs, out))
+            pending.append((idxs, out, False))
 
         def resolve() -> list[int]:
-            for idxs, out in pending:
+            for idxs, out, shared in pending:
                 arr = np.asarray(out, dtype=np.uint64)
+                if shared:
+                    val = int(arr.sum())  # scalar, or [S] partials
+                    for i in idxs:
+                        results[i] = val
+                    continue
                 if arr.ndim == 2:  # [Q, S] partials past the device-sum bound
                     arr = arr.sum(axis=1)
                 for j, i in enumerate(idxs):

@@ -36,6 +36,56 @@ def test_generated_queries_differential(holder, seed):
         assert got == want, f"seed={seed} q#{k}: {q}"
 
 
+@pytest.mark.parametrize("seed", [11, 12])
+def test_generated_queries_under_write_churn(holder, seed):
+    """The write-churn serving protocol, randomized: interleave point
+    writes, clears, and occasional bulk imports (delta-uncoverable
+    epochs) with generated queries and batched Counts — every answer
+    must stay oracle-exact through the delta/slab/sweep tiers."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(2000 + seed)
+    build_schema(holder, rng, shards=2)
+    host = Executor(holder)
+    dev = Executor(holder, backend=TPUBackend(holder))
+    gen = QueryGenerator(seed)
+    idx = holder.index("qg")
+    fields = [f for f in idx.fields if not f.startswith("_")]
+    set_cols: list = []
+    for k in range(40):
+        # 1-3 random mutations per step.
+        for _ in range(int(rng.integers(1, 4))):
+            fname = fields[int(rng.integers(0, len(fields)))]
+            fld = idx.field(fname)
+            if fld.options.type == "int":
+                fld.set_value(int(rng.integers(0, 2 * SHARD_WIDTH)),
+                              int(rng.integers(-50, 50)))
+                continue
+            row = int(rng.integers(0, 5))
+            roll = rng.integers(0, 10)
+            if roll < 6 or not set_cols:
+                col = int(rng.integers(0, 2 * SHARD_WIDTH))
+                fld.set_bit(row, col)
+                set_cols.append((fname, row, col))
+            elif roll < 9:
+                f2, r2, c2 = set_cols.pop(int(rng.integers(0, len(set_cols))))
+                idx.field(f2).clear_bit(r2, c2)
+            else:  # bulk import: not delta-coverable
+                cols = np.unique(
+                    rng.integers(0, 2 * SHARD_WIDTH, 50, dtype=np.uint64)
+                )
+                fld.import_bits(
+                    np.full(cols.size, row, dtype=np.uint64), cols
+                )
+        if k % 3 == 0:
+            q = "".join(f"Count({gen.bitmap()})" for _ in range(4))
+        else:
+            q = gen.query()
+        want = [result_to_json(r) for r in host.execute("qg", q)]
+        got = [result_to_json(r) for r in dev.execute("qg", q)]
+        assert got == want, f"seed={seed} step#{k}: {q}"
+
+
 def test_generated_multi_count_batches(holder):
     """Batched serving path: whole multi-Count requests of generated
     bitmaps must match the oracle call-for-call (exercises the pair-plan

@@ -414,6 +414,25 @@ class TestCountBatch:
         idx.field("f").set_bit(1, col)
         assert be.count_batch("i", calls, shards) == [first[0] + 1]
 
+    def test_count_batch_zero_scalar_group(self, holder, rng):
+        """Calls with no traced scalars (All()) cannot scan over a query
+        axis — they group into one shared program and fan out (found by
+        the randomized churn differential)."""
+        idx = self._setup(holder, rng)
+        from pilosa_tpu.pql import parse_string
+
+        ef = idx.existence_field()
+        cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 500, dtype=np.uint64))
+        ef.import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
+        be = TPUBackend(holder)
+        calls = [parse_string(q).calls[0]
+                 for q in ("All()", "All()", "Not(Row(f=1))")]
+        shards = [0, 1]
+        got = be.count_batch("i", calls, shards)
+        want = [be.count_shards("i", c, shards) for c in calls]
+        assert got == want
+        assert got[0] == got[1] == cols.size
+
     def test_host_slab_stats_match_pershard_kernel(self, rng):
         """The host-update helper must agree bit-for-bit with the device
         per-shard kernel — a host-refreshed table row sits next to
