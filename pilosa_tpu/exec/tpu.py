@@ -708,6 +708,13 @@ class TPUBackend:
         # and re-check.
         self._stats_updating: dict = {}
         self._pair_lock = threading.Lock()
+        # Pair-plan memo: parse-cache hits serve SHARED call trees, so a
+        # batch's plan is keyed by the calls' identities. Cached entries
+        # pin the call objects, so a key match implies the same objects
+        # (a live object's id cannot be reused). Re-planning every
+        # request cost ~12% of serving CPU.
+        self._plan_cache: dict = {}
+        self._plan_lock = threading.Lock()
         self.stats = global_stats
         # Shapes whose device fast path already logged a fallback: the
         # broad except sites must not be silent (VERDICT r3 weak #7 — a
@@ -1285,7 +1292,7 @@ class TPUBackend:
         if not calls:
             return lambda: []
         shards_t = tuple(shards)
-        plan = self._pair_batch_plan(index, calls)
+        plan = self._cached_pair_plan(index, calls)
         if plan is not None:
             try:
                 return self._pair_batch_dispatch(index, plan, shards_t)
@@ -1324,6 +1331,28 @@ class TPUBackend:
         except QueryError:
             return None  # let the fallback path raise the reference error
         return fname, v
+
+    def _cached_pair_plan(self, index: str, calls: list[Call]):
+        """Memoized _pair_batch_plan. Plans derive from call-tree
+        structure (field names, rows, verbs) plus FIELD EXISTENCE — the
+        field set is part of the key, so creating a field re-plans
+        batches whose None plan predated it (shared parse-cache trees
+        live as long as the process)."""
+        idx = self.holder.index(index)
+        fields_key = tuple(idx.fields) if idx is not None else ()
+        key = (index, fields_key, tuple(map(id, calls)))
+        with self._plan_lock:
+            hit = self._plan_cache.get(key)
+            if hit is not None:
+                self._plan_cache[key] = self._plan_cache.pop(key)  # LRU
+                return hit[0]
+        plan = self._pair_batch_plan(index, calls)
+        with self._plan_lock:
+            self._plan_cache.pop(key, None)
+            self._plan_cache[key] = (plan, tuple(calls))
+            while len(self._plan_cache) > 512:
+                self._plan_cache.pop(next(iter(self._plan_cache)))
+        return plan
 
     def _pair_batch_plan(self, index: str, calls: list[Call]):
         """Plan (entries, fa, fb) when the whole batch derives from the

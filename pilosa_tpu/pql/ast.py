@@ -76,10 +76,14 @@ class Call:
         self.children = children if children is not None else []
 
     def copy(self) -> "Call":
-        """Structural copy: executors mutate args during key translation,
-        so parse-cache hits must hand out fresh trees. Conditions are
-        immutable post-parse (ops/values never rewritten) and shared;
-        nested Calls in args (GroupBy filter=) are copied."""
+        """Structural copy for paths that MUST mutate (e.g. TopN pass-2
+        pins candidate ids). Parsed trees are otherwise immutable and
+        SHARED — parse-cache hits return the same objects to concurrent
+        requests, and key translation is copy-on-write
+        (executor._translate_call) — so never mutate a parsed Call
+        without cloning it first. Conditions are immutable post-parse
+        (ops/values never rewritten) and shared; nested Calls in args
+        (GroupBy filter=) are copied."""
         args = {
             k: (v.copy() if isinstance(v, Call) else v)
             for k, v in self.args.items()

@@ -481,8 +481,10 @@ def parse_string(text: str) -> Query:
     Parses are cached by query text (LRU): serving workloads repeat a
     small set of query strings, and the backtracking parser costs ~400 us
     per call tree — ~6.5 ms of a 16-Count request before caching. Hits
-    return a structural copy because executors mutate call args during
-    key translation."""
+    return the SHARED tree: parsed Calls are immutable by contract —
+    key translation is copy-on-write (executor._translate_call) and
+    mutating paths clone first (e.g. TopN pass 2) — so no per-request
+    structural copy is needed."""
     cacheable = len(text) <= _PARSE_CACHE_MAX_LEN
     if cacheable:
         with _parse_lock:
@@ -490,7 +492,7 @@ def parse_string(text: str) -> Query:
             if q is not None:
                 _parse_cache[text] = _parse_cache.pop(text)  # LRU touch
         if q is not None:
-            return q.copy()  # outside the lock: copies run concurrently
+            return q
     q = Parser(text).parse()
     if cacheable:
         with _parse_lock:
@@ -498,5 +500,4 @@ def parse_string(text: str) -> Query:
             _parse_cache[text] = q
             while len(_parse_cache) > _PARSE_CACHE_MAX:
                 _parse_cache.pop(next(iter(_parse_cache)))
-        return q.copy()
     return q
