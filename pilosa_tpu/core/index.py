@@ -176,38 +176,35 @@ class Index:
 
                 shutil.rmtree(f.path)
 
-    def available_shards(self) -> Bitmap:
-        """Union of all fields' shard sets (reference index.go:292).
-        Cached against the fields' structure versions — the executor
-        resolves the shard list on every query."""
+    def _shards_entry(self) -> tuple:
+        """The (key, bitmap, list) available-shards cache entry, rebuilt
+        when any field's structure version moved. Caller must hold no
+        assumption of ownership: the bitmap/list are shared."""
         with self.lock:
             key = tuple(
                 (name, f.structure_version) for name, f in self.fields.items()
             )
             cached = self._shards_cache
             if cached is not None and cached[0] == key:
-                return cached[1].clone()
+                return cached
             out = Bitmap()
             for f in self.fields.values():
                 out.union_in_place(f.available_shards())
             self._shards_cache = (key, out, out.to_array().tolist())
-        return out.clone()
+            return self._shards_cache
+
+    def available_shards(self) -> Bitmap:
+        """Union of all fields' shard sets (reference index.go:292).
+        Cached against the fields' structure versions — the executor
+        resolves the shard list on every query."""
+        return self._shards_entry()[1].clone()
 
     def available_shards_list(self) -> list:
         """The available-shards set as a READ-ONLY int list — the form
         the executor needs on every query. Shares the structure-version
-        cache above, so the hot path is one tuple compare instead of a
-        bitmap clone + to_array per query. Callers must not mutate."""
-        with self.lock:
-            key = tuple(
-                (name, f.structure_version) for name, f in self.fields.items()
-            )
-            cached = self._shards_cache
-            if cached is not None and cached[0] == key:
-                return cached[2]
-        self.available_shards()  # rebuild the cache
-        with self.lock:
-            return self._shards_cache[2]
+        cache, so the hot path is one tuple compare instead of a bitmap
+        clone + to_array per query. Callers must not mutate."""
+        return self._shards_entry()[2]
 
     def __repr__(self) -> str:
         return f"Index({self.name}, fields={sorted(self.fields)})"

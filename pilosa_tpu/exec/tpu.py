@@ -391,9 +391,10 @@ class _PairEntry:
     """One field pair's cached sufficient statistics.
 
     stats: in-flight device array right after a sweep (per-shard
-    [S, D] single-device, psum'd totals [D] under a mesh), replaced by
-    the int64 host totals on first resolve. pershard: the resident
-    int32[S, D] table (single-device only) that makes write epochs cheap
+    [S, D] — sharded over the mesh axis when meshed — or summed totals
+    [D] past the retention gate), replaced by the int64 host totals on
+    first resolve. pershard: the resident int32[S, D] table that makes
+    write epochs cheap on one chip or many
     — see _pair_try_incremental. gen_*: the views' O(1) data generations
     at derivation time — the fast freshness gate (unchanged generation
     means no write anywhere under the view, so hits skip the O(shards)
@@ -1338,6 +1339,11 @@ class TPUBackend:
         field set is part of the key, so creating a field re-plans
         batches whose None plan predated it (shared parse-cache trees
         live as long as the process)."""
+        if not all(c.cached for c in calls):
+            # Fresh trees (key-translated rewrites, programmatic calls):
+            # ids are per-request, so memoizing would never hit — it
+            # would only pin throwaway trees and evict useful entries.
+            return self._pair_batch_plan(index, calls)
         idx = self.holder.index(index)
         fields_key = tuple(idx.fields) if idx is not None else ()
         key = (index, fields_key, tuple(map(id, calls)))
@@ -1398,43 +1404,54 @@ class TPUBackend:
         return entries, fa, fb
 
     def _pair_program(self, pershard: bool = True):
-        """Compiled pair_stats sweep (+ shard_map/psum under a mesh).
+        """Compiled pair_stats sweep (+ shard_map under a mesh).
 
-        Single device, pershard=True (the default): per-shard stats
+        pershard=True (the default): per-shard stats
         [S, rf*rg + rf + rg] in ONE output (row i =
         [pair_i.ravel() | cf_i | cg_i]) — one readback (~300 KiB at the
         954-shard bench shape, still a single relay round trip) buys the
         host table that absorbs write epochs without re-sweeping
-        (_pair_try_incremental). pershard=False: device-summed totals
-        [D] — used when the per-shard table would be too large to read
-        back and retain (see MAX_PAIR_PERSHARD_BYTES). Mesh: psum'd
-        totals flattened into one [D] vector."""
+        (_pair_try_incremental). Under a mesh the kernel runs on each
+        device's local shard chunk and the output stays sharded
+        (out_specs P(axis)); the readback gathers it so multi-chip
+        serving gets the same host-maintained tables. pershard=False:
+        device-summed (psum'd under mesh) totals [D] — used when the
+        per-shard table would be too large to read back and retain
+        (see MAX_PAIR_PERSHARD_BYTES)."""
         key = ("pair2", pershard)
         with self._fns_lock:
             fn = self._fns.get(key)
         if fn is not None:
             return fn
         interpret = jax.default_backend() != "tpu"
+
+        def flat(fb, gb):
+            pair, cf, cg = pair_stats_pershard(fb, gb, interpret=interpret)
+            s = pair.shape[0]
+            return jnp.concatenate(
+                [pair.reshape(s, -1), cf.reshape(s, -1), cg.reshape(s, -1)],
+                axis=1,
+            )
+
         if self.mesh is None:
-            if pershard:
+            if not pershard:
 
-                def flat(fb, gb):
-                    pair, cf, cg = pair_stats_pershard(
-                        fb, gb, interpret=interpret
-                    )
-                    s = pair.shape[0]
-                    return jnp.concatenate(
-                        [pair.reshape(s, -1), cf.reshape(s, -1),
-                         cg.reshape(s, -1)],
-                        axis=1,
-                    )
-            else:
-
-                def flat(fb, gb):
+                def flat(fb, gb):  # noqa: F811 — summed variant
                     pair, cf, cg = pair_stats(fb, gb, interpret=interpret)
                     return jnp.concatenate([pair.ravel(), cf, cg])
 
             fn = jax.jit(flat)
+        elif pershard:
+            mesh = self.mesh
+            fn = jax.jit(
+                shard_map(
+                    flat,
+                    mesh=mesh.mesh,
+                    in_specs=(P(mesh.axis), P(mesh.axis)),
+                    out_specs=P(mesh.axis),
+                    check_vma=False,
+                )
+            )
         else:
             mesh = self.mesh
 
@@ -1561,11 +1578,6 @@ class TPUBackend:
             gblock, _, bvers_g = self._get_block_with_versions(
                 index, g_obj, shards_t
             )
-        if self.mesh is not None and fblock.shape[0] > MAX_PAIR_SHARDS:
-            # Mesh totals accumulate on device in int32; the single-device
-            # per-shard program is exact for any shard count (per-shard
-            # counts are <= 2^20), so only the mesh path keeps the bound.
-            raise _Unsupported("pair sweep exceeds int32 shard bound")
         rf, rg = fblock.shape[1], gblock.shape[1]
         if rf * rg > (1 << 16):
             raise _Unsupported("pair matrix too large")
@@ -1581,16 +1593,12 @@ class TPUBackend:
         # (those epochs then re-sweep, the pre-table behavior).
         d_stats = rf * rg + rf + rg
         pershard_ok = (
-            self.mesh is None
-            and fblock.shape[0] * d_stats * 4 <= self.MAX_PAIR_PERSHARD_BYTES
+            fblock.shape[0] * d_stats * 4 <= self.MAX_PAIR_PERSHARD_BYTES
         )
-        if (
-            self.mesh is None
-            and not pershard_ok
-            and fblock.shape[0] > MAX_PAIR_SHARDS
-        ):
-            # Summed totals accumulate on device in int32: with the
-            # per-shard table gated off, tall sweeps can't stay exact.
+        if not pershard_ok and fblock.shape[0] > MAX_PAIR_SHARDS:
+            # Summed totals accumulate on device in int32 (psum'd under
+            # a mesh): with the per-shard table gated off, tall sweeps
+            # can't stay exact.
             raise _Unsupported("pair sweep exceeds int32 shard bound")
         # The in-flight device array is cached right away — pipelined
         # batches and the single-flight waiters share this one sweep
@@ -1626,13 +1634,15 @@ class TPUBackend:
         (cache.go:136-301), so a Set costs O(1 shard) host work instead
         of a full stack sweep + relay round trip. Returns the updated
         _PairEntry (already resolved — its resolver never touches the
-        device), or None when a real sweep is needed (cold pair, mesh,
-        row growth past the table height, shard-set change, or too many
-        dirty shards). Runs WITHOUT _pair_lock (slab packing is the slow
-        part); the caller re-validates on store."""
+        device), or None when a real sweep is needed (cold pair, row
+        growth past the table height, shard-set change, or too many
+        dirty shards). Host tables are mesh-agnostic — multi-chip
+        serving absorbs churn the same way (the sweep's per-shard
+        output is gathered over ICI once, cold). Runs WITHOUT
+        _pair_lock (slab packing is the slow part); the single-flight
+        updater role makes store-time re-validation unnecessary."""
         if (
-            self.mesh is not None
-            or hit is None
+            hit is None
             or hit.shards != shards_t
             or hit.pershard is None
             or hit.vers_f is None
@@ -1793,10 +1803,10 @@ class TPUBackend:
         stats = ent.stats
         if not isinstance(stats, np.ndarray):
             raw = np.asarray(stats)  # ONE readback for all stats
-            if raw.ndim == 2:  # single-device per-shard [S, D]
+            if raw.ndim == 2:  # per-shard [S, D] (gathered when meshed)
                 pershard = raw
                 totals = pershard.sum(axis=0, dtype=np.int64)
-            else:  # mesh psum'd totals [D]
+            else:  # summed totals [D] (retention gate; psum'd on mesh)
                 pershard = None
                 totals = raw.astype(np.int64)
             with self._pair_lock:
@@ -2309,7 +2319,6 @@ class TPUBackend:
             # and let write epochs re-dispatch).
             pershard_ok = (
                 not src_call
-                and self.mesh is None
                 and s_pad * rp * 8 <= self.MAX_PAIR_PERSHARD_BYTES
             )
             reduce_dev = (
@@ -2342,12 +2351,11 @@ class TPUBackend:
         delta-apply ring-covered point writes, slab-rederive the rest
         (no device work at all — same discipline as
         _pair_try_incremental). Returns (int64[S, R] table, recorded
-        versions), or None when a dispatch is needed (cold field, mesh,
-        row growth past the table height, shard-set change, too many
-        slab shards)."""
+        versions), or None when a dispatch is needed (cold field, row
+        growth past the table height, shard-set change, too many slab
+        shards)."""
         if (
-            self.mesh is not None
-            or hit is None
+            hit is None
             or len(hit) < 4
             or hit[2] is None
             or hit[3] is None

@@ -63,7 +63,7 @@ def is_reserved_arg(name: str) -> bool:
 class Call:
     """One function call in the AST (reference pql/ast.go:263)."""
 
-    __slots__ = ("name", "args", "children")
+    __slots__ = ("name", "args", "children", "cached")
 
     def __init__(
         self,
@@ -74,6 +74,11 @@ class Call:
         self.name = name
         self.args = args if args is not None else {}
         self.children = children if children is not None else []
+        # True only on trees owned by the parse cache (set at cache
+        # insertion): such objects are pinned and identity-stable, which
+        # is what makes id-keyed memoization (pair-plan cache) sound.
+        # Copies and translated rewrites are always False.
+        self.cached = False
 
     def copy(self) -> "Call":
         """Structural copy for paths that MUST mutate (e.g. TopN pass-2

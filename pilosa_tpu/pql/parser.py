@@ -495,6 +495,15 @@ def parse_string(text: str) -> Query:
             return q
     q = Parser(text).parse()
     if cacheable:
+        def mark(c):
+            c.cached = True
+            for ch in c.children:
+                mark(ch)
+            for v in c.args.values():
+                if isinstance(v, Call):
+                    mark(v)
+        for c in q.calls:
+            mark(c)
         with _parse_lock:
             _parse_cache.pop(text, None)
             _parse_cache[text] = q
