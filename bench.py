@@ -380,8 +380,12 @@ def main():
     sweep_dev_s = bench_sweep_device_only(be)
     p50, p99 = bench_tpu_single(be, queries)
     topn_p50 = bench_topn(be)
-    qps_at_rate, achieved_rate, http_p50 = bench_http(h, be, queries)
+    # GroupBy BEFORE the churn legs: its cold figure is the h-stack
+    # pack + upload + tri-program compile — measured after churn it
+    # also absorbed a full f-stack rebuild (hundreds of dirtied shards)
+    # and read as 3x worse than a real cold start.
     groupby_cold_s, groupby_warm_s = bench_group_by(h, be)
+    qps_at_rate, achieved_rate, http_p50 = bench_http(h, be, queries)
     http_qps = qps_at_rate.get("0", next(iter(qps_at_rate.values())))
 
     # Roofline: logical bytes each query's AND+popcount would touch in a

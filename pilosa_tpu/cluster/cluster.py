@@ -35,8 +35,6 @@ from pilosa_tpu.cluster.topology import (
     Node,
     STATE_DEGRADED,
     STATE_NORMAL,
-    STATE_RESIZING,
-    STATE_STARTING,
     Topology,
 )
 from pilosa_tpu.core.cache import Pair
@@ -45,7 +43,6 @@ from pilosa_tpu.exec.result import (
     FieldRow,
     GroupCount,
     PairField,
-    PairsField,
     RowIDs,
     ValCount,
 )

@@ -33,7 +33,6 @@ from __future__ import annotations
 import json
 
 from pilosa_tpu.server.wire import (
-    _decode_varint,
     _encode_bool,
     _encode_bytes,
     _encode_packed_uint64,
@@ -43,7 +42,6 @@ from pilosa_tpu.server.wire import (
     _field_str,
     _iter_fields,
     _repeated_uint64,
-    _signed,
 )
 
 WIRE_VERSION = 1

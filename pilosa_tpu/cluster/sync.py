@@ -25,7 +25,6 @@ Three loops the reference runs as background monitors:
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
 from pilosa_tpu.cluster.client import ClientError

@@ -15,7 +15,7 @@ import json
 import os
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Iterable, Optional
+from typing import Iterable
 
 THRESHOLD_FACTOR = 1.1  # reference cache.go:30
 

@@ -11,8 +11,6 @@ from __future__ import annotations
 import datetime as dt
 from typing import Optional
 
-from pilosa_tpu.core.field import FIELD_TYPE_TIME
-from pilosa_tpu.core.fragment import BSI_EXISTS_BIT
 from pilosa_tpu.core.index import EXISTENCE_FIELD_NAME
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.timequantum import parse_time, views_by_time_range

@@ -12,14 +12,12 @@ is delegated to a backend (CPU oracle or the TPU device backend).
 from __future__ import annotations
 
 import datetime as dt
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional, Union
 
-import numpy as np
 
 from pilosa_tpu.core.cache import Pair, add_pairs, top_n_pairs
 from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_TIME
-from pilosa_tpu.core.index import EXISTENCE_FIELD_NAME
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.timequantum import parse_time, views_by_time_range
 from pilosa_tpu.core.view import VIEW_STANDARD

@@ -18,7 +18,7 @@ from typing import Optional
 
 import numpy as np
 
-from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 WORDS_PER_SHARD = SHARD_WIDTH // 32
 _CONTAINERS_PER_ROW = SHARD_WIDTH >> 16

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import re
 import threading
-from typing import Any, Optional
+from typing import Any
 
 from pilosa_tpu.pql.ast import (
     BETWEEN,
