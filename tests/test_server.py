@@ -372,7 +372,12 @@ class TestPprof:
         t.start()
         assert p.start()
         assert not p.start()  # second session refused
-        time.sleep(0.1)
+        # Deadline-based wait: a fixed 0.1 s sleep flaked on this 1-core
+        # host when the whole suite starved the sampler thread below 10
+        # samples; wait for the samples themselves instead.
+        deadline = time.time() + 10
+        while p._samples < 10 and time.time() < deadline:
+            time.sleep(0.02)
         rep = p.stop(top=10)
         stop.set()
         t.join()

@@ -1,0 +1,165 @@
+"""Soak harness: sustained mixed read/write load against a live server.
+
+Boots a server subprocess (or targets --addr), seeds an index, then runs
+N reader threads of batched Counts against a writer issuing Set/Clear at
+a fixed rate, sampling the server's RSS each period. Fails loudly on any
+non-200, and on RSS growth past --rss-slack once warm (leak detector —
+the serving caches are all bounded: pair/TopN/agg tables, plan memo,
+parse cache, bit-op rings, update latches).
+
+Usage:
+    python tools/soak.py --minutes 5 --readers 6 --write-rate 50
+"""
+
+import argparse
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_mb(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) // 1024
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=3.0)
+    ap.add_argument("--readers", type=int, default=6)
+    ap.add_argument("--write-rate", type=float, default=50.0)
+    ap.add_argument("--port", type=int, default=10207)
+    ap.add_argument("--data-dir", default="/tmp/pilosa-tpu-soak")
+    ap.add_argument("--executor", default="tpu")
+    ap.add_argument("--rss-slack", type=float, default=0.15,
+                    help="allowed RSS growth fraction after warmup")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli", "server",
+         "-d", args.data_dir, "--bind", f"localhost:{args.port}",
+         "--executor", args.executor],
+    )
+    try:
+        conn = None
+        for _ in range(120):
+            try:
+                conn = http.client.HTTPConnection("localhost", args.port, timeout=60)
+                conn.request("GET", "/status")
+                conn.getresponse().read()
+                break
+            except OSError:
+                time.sleep(0.5)
+
+        def post(c, body):
+            c.request("POST", "/index/soak/query", body)
+            r = c.getresponse()
+            b = r.read().decode()
+            if r.status != 200:  # not assert: must survive python -O
+                raise RuntimeError(f"HTTP {r.status}: {b[:200]}")
+            return json.loads(b)["results"]
+
+        def ddl(path):
+            conn.request("POST", path, "")
+            r = conn.getresponse()
+            b = r.read().decode()
+            if r.status != 200:
+                raise RuntimeError(f"{path}: HTTP {r.status}: {b[:200]}")
+
+        ddl("/index/soak")
+        ddl("/index/soak/field/f")
+        ddl("/index/soak/field/g")
+        # Seed BOTH queried fields, batched (500 Sets per request — one
+        # Set per POST would take minutes of pure seeding round trips).
+        sets = [f"Set({col}, f={col % 8})" for col in range(0, 60000, 3)]
+        sets += [f"Set({col}, g={col % 8})" for col in range(0, 60000, 5)]
+        for i in range(0, len(sets), 500):
+            post(conn, "".join(sets[i : i + 500]))
+
+        stop = threading.Event()
+        errors: list = []
+        nq = [0]
+        nw = [0]
+
+        def reader(_k):
+            c = http.client.HTTPConnection("localhost", args.port, timeout=60)
+            body = "".join(
+                f"Count(Intersect(Row(f={r}), Row(g=2)))" for r in range(8)
+            )
+            try:
+                while not stop.is_set():
+                    post(c, body)
+                    nq[0] += 8
+            except Exception as e:  # noqa: BLE001 — recorded and failed below
+                if not stop.is_set():
+                    errors.append(("reader", repr(e)))
+
+        def writer():
+            c = http.client.HTTPConnection("localhost", args.port, timeout=60)
+            rng = np.random.default_rng(3)
+            period = 1.0 / args.write_rate
+            try:
+                while not stop.is_set():
+                    col = int(rng.integers(0, 200000))
+                    row = int(rng.integers(0, 8))
+                    fld = ("f", "g")[int(rng.integers(0, 2))]
+                    verb = "Clear" if rng.integers(0, 5) == 0 else "Set"
+                    post(c, f"{verb}({col}, {fld}={row})")
+                    nw[0] += 1
+                    time.sleep(period)
+            except Exception as e:  # noqa: BLE001
+                if not stop.is_set():
+                    errors.append(("writer", repr(e)))
+
+        threads = [
+            threading.Thread(target=reader, args=(k,))
+            for k in range(args.readers)
+        ] + [threading.Thread(target=writer)]
+        for t in threads:
+            t.start()
+        samples = []
+        n_samples = max(3, int(args.minutes * 3))
+        for m in range(n_samples):
+            time.sleep(args.minutes * 60 / n_samples)
+            samples.append(rss_mb(srv.pid))
+            print(
+                f"t={int((m + 1) * args.minutes * 60 / n_samples)}s "
+                f"rss={samples[-1]}MB q={nq[0]} w={nw[0]} err={len(errors)}",
+                flush=True,
+            )
+            if errors:
+                break
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            print("FAIL:", errors[:3])
+            return 1
+        warm = samples[min(2, len(samples) - 1)]
+        if samples[-1] > warm * (1 + args.rss_slack) + 50:
+            print(f"FAIL: rss grew {warm} -> {samples[-1]} MB:", samples)
+            return 1
+        print(f"SOAK OK: {nq[0]} queries, {nw[0]} writes, "
+              f"rss {samples[0]}->{samples[-1]}MB")
+        return 0
+    finally:
+        srv.terminate()
+        try:
+            srv.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            srv.kill()
+            srv.wait()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
