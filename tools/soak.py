@@ -1,6 +1,6 @@
 """Soak harness: sustained mixed read/write load against a live server.
 
-Boots a server subprocess (or targets --addr), seeds an index, then runs
+Boots a server subprocess on a fresh data dir, seeds an index, then runs
 N reader threads of batched Counts against a writer issuing Set/Clear at
 a fixed rate, sampling the server's RSS each period. Fails loudly on any
 non-200, and on RSS growth past --rss-slack once warm (leak detector —
@@ -37,22 +37,35 @@ def main() -> int:
     ap.add_argument("--readers", type=int, default=6)
     ap.add_argument("--write-rate", type=float, default=50.0)
     ap.add_argument("--port", type=int, default=10207)
-    ap.add_argument("--data-dir", default="/tmp/pilosa-tpu-soak")
+    ap.add_argument("--data-dir", default=None,
+                    help="default: a fresh temp dir (a reused dir would "
+                         "409 on index creation)")
     ap.add_argument("--executor", default="tpu")
     ap.add_argument("--rss-slack", type=float, default=0.15,
                     help="allowed RSS growth fraction after warmup")
     args = ap.parse_args()
 
+    import tempfile
+
     import numpy as np
 
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data_dir = args.data_dir or tempfile.mkdtemp(prefix="pilosa-tpu-soak-")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
     srv = subprocess.Popen(
         [sys.executable, "-m", "pilosa_tpu.cli", "server",
-         "-d", args.data_dir, "--bind", f"localhost:{args.port}",
+         "-d", data_dir, "--bind", f"localhost:{args.port}",
          "--executor", args.executor],
+        env=env,
     )
     try:
         conn = None
         for _ in range(120):
+            if srv.poll() is not None:
+                raise RuntimeError(f"server exited rc={srv.returncode}")
             try:
                 conn = http.client.HTTPConnection("localhost", args.port, timeout=60)
                 conn.request("GET", "/status")
@@ -60,6 +73,8 @@ def main() -> int:
                 break
             except OSError:
                 time.sleep(0.5)
+        else:
+            raise RuntimeError("server did not come up in 60s")
 
         def post(c, body):
             c.request("POST", "/index/soak/query", body)
@@ -108,15 +123,22 @@ def main() -> int:
             c = http.client.HTTPConnection("localhost", args.port, timeout=60)
             rng = np.random.default_rng(3)
             period = 1.0 / args.write_rate
+            nxt = time.perf_counter()
             try:
                 while not stop.is_set():
+                    # Deadline pacing: sleep-after-POST would fall below
+                    # the requested rate by the request latency.
+                    now = time.perf_counter()
+                    if now < nxt:
+                        time.sleep(min(period, nxt - now))
+                        continue
+                    nxt += period
                     col = int(rng.integers(0, 200000))
                     row = int(rng.integers(0, 8))
                     fld = ("f", "g")[int(rng.integers(0, 2))]
                     verb = "Clear" if rng.integers(0, 5) == 0 else "Set"
                     post(c, f"{verb}({col}, {fld}={row})")
                     nw[0] += 1
-                    time.sleep(period)
             except Exception as e:  # noqa: BLE001
                 if not stop.is_set():
                     errors.append(("writer", repr(e)))
