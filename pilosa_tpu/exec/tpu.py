@@ -2009,8 +2009,6 @@ class TPUBackend:
         executor.go:3063 — but exact counts in one sweep instead of a
         per-shard bitmap recursion). Returns None when not lowerable so
         the executor falls back to the host path."""
-        from pilosa_tpu.exec.result import FieldRow, GroupCount
-
         children = c.children
         n = len(children)
         if n == 0:
@@ -2026,6 +2024,18 @@ class TPUBackend:
             fields.append((fname, f_obj))
             prev, has_prev = child.uint64_arg("previous")
             starts.append(prev + 1 if has_prev else 0)
+        # Unfiltered 1-/2-field groups ARE the maintained host tables:
+        # the TopN rank vector and the pair-count matrix — both
+        # refreshed incrementally under write churn, so these GroupBys
+        # stay sub-ms warm instead of re-dispatching per epoch. No
+        # stack fetch, no tensor cache.
+        if filter_call is None and n <= 2:
+            served = self._group_from_tables(index, fields, shards_t, n)
+            if served is not None:
+                stats_np, rs = served
+                return self._group_enumerate(
+                    fields, starts, child_rows, rs, stats_np, n
+                )
         # Group-tensor cache (unfiltered): the stats do not depend on
         # candidate restrictions (limit/column/previous filter only the
         # host enumeration), so the write epoch of the child views keys
@@ -2089,6 +2099,64 @@ class TPUBackend:
                     self._agg_cache[ckey] = (cfp, stats_np)
                     while len(self._agg_cache) > MAX_PAIR_CACHE_ENTRIES:
                         self._agg_cache.pop(next(iter(self._agg_cache)))
+        return self._group_enumerate(fields, starts, child_rows, rs, stats_np, n)
+
+    def _group_from_tables(self, index, fields, shards_t, n):
+        """(stats, rs) for an unfiltered 1-/2-field GroupBy from the
+        incrementally-maintained host tables, or None when a table
+        can't serve (budget/bounds) and the tensor/host path should
+        run. Row counts stay under the tensor path's 2^16 bound so
+        tall fields keep falling through to the container-walking host
+        iterator instead of a huge Python enumeration."""
+        if n == 1:
+            f_obj = fields[0][1]
+            v = f_obj.view(VIEW_STANDARD)
+            if v is not None:
+                # Bound-check BEFORE computing the rank vector: a tall
+                # field would otherwise pay a full paged device sweep
+                # just to discover the result gets discarded here.
+                max_row = max(
+                    (fr.max_row_id for fr in (v.fragment(s) for s in shards_t)
+                     if fr is not None),
+                    default=0,
+                )
+                if max_row + 1 > (1 << 16):
+                    return None
+            counts = self._topn_counts(index, f_obj, fields[0][0], shards_t)
+            if counts.size > (1 << 16):
+                return None
+            return counts.astype(np.int64), [counts.size]
+        pm = self._pair_matrix(index, fields[0][0], fields[1][0], shards_t)
+        if pm is None:
+            return None
+        matrix, rf, rg = pm
+        return matrix, [rf, rg]
+
+    def _pair_matrix(self, index, fa, fb, shards_t):
+        """The pair-count matrix [rf, rg] through the same single-flight
+        + incremental machinery as count batches. None when the pair
+        path can't serve (HBM budget, size bounds, eviction race)."""
+        try:
+            resolver = self._pair_batch_dispatch(index, ([], fa, fb), shards_t)
+        except _Unsupported:
+            return None
+        resolver()  # force readback so the entry's stats are host np
+        with self._pair_lock:
+            ent = self._pair_cache.get((index, fa, fb))
+        if (
+            ent is None
+            or ent.shards != shards_t
+            or not isinstance(ent.stats, np.ndarray)
+        ):
+            return None
+        rf, rg = ent.rf, ent.rg
+        return ent.stats[: rf * rg].reshape(rf, rg), rf, rg
+
+    def _group_enumerate(self, fields, starts, child_rows, rs, stats_np, n):
+        """Candidate enumeration over the group stats (tensor or table),
+        matching the reference groupByIterator's ordering."""
+        from pilosa_tpu.exec.result import FieldRow, GroupCount
+
         cand = []
         for i in range(n):
             if child_rows[i] is not None:
@@ -2235,66 +2303,62 @@ class TPUBackend:
                 spec, blocks, scalars = self._assemble(index, src_call, shards_t)
             except _Unsupported:
                 return None
-        # Host rank-vector cache for the unfiltered case (the reference's
-        # rank cache, cache.go:136): the view generation is the write
-        # epoch, so repeat TopN serves from the host counts vector
-        # without a dispatch — and a SMALL epoch refreshes the resident
-        # per-shard table on the host (same incremental maintenance as
-        # the pair cache) instead of re-dispatching.
         if src_call is None:
-            # Single-flight admission (same discipline as the pair path:
-            # one refresher per field, waiters re-check).
-            v = f.view(VIEW_STANDARD)
-            ckey = (index, field_name)
-            ukey = ("topn", index, field_name)
-            while True:
-                cfp = (shards_t, v.generation if v is not None else -1)
-                with self._pair_lock:
-                    hit = self._topn_cache.get(ckey)
-                    if hit is not None and hit[0] == cfp:
-                        self.stats.count("topn_cache_hits_total")
-                        fresh = hit[1]
-                        break
-                    latch = self._stats_updating.get(ukey)
-                    if latch is None:
-                        self._stats_updating[ukey] = threading.Event()
-                        fresh = None
-                        break
-                latch.wait(timeout=60)
-            if fresh is not None:
-                # Sort/build OUTSIDE the lock: count_batch resolvers
-                # share it for the pair-stats cache.
-                return self._topn_pairs(fresh, n)
-            try:
-                # Generation moved: try the host table update against
-                # LIVE fragment versions — no stack fetch, no device
-                # round trip.
-                live_vers = self._live_versions(f, shards_t)
-                upd = self._topn_try_incremental(
-                    f, hit, shards_t, live_vers
-                )
-                if upd is not None:
-                    pershard, vers_rec = upd
-                    counts = pershard.sum(axis=0).astype(np.uint64)
-                    with self._pair_lock:
-                        self._topn_cache[ckey] = (
-                            cfp, counts, pershard, vers_rec
-                        )
-                    return self._topn_pairs(counts, n)
-                return self._topn_dispatch(
-                    index, f, shards_t, n, None, ckey, cfp, live_vers
-                )
-            finally:
-                with self._pair_lock:
-                    ev = self._stats_updating.pop(ukey, None)
-                if ev is not None:
-                    ev.set()
-        return self._topn_dispatch(
-            index, f, shards_t, n, (spec, blocks, scalars), None, None, None
+            counts = self._topn_counts(index, f, field_name, shards_t)
+            return self._topn_pairs(counts, n)
+        return self._topn_pairs(
+            self._topn_dispatch(
+                index, f, shards_t, (spec, blocks, scalars), None, None, None
+            ),
+            n,
         )
 
-    def _topn_dispatch(self, index, f, shards_t, n, src, ckey, cfp,
-                       live_vers):
+    def _topn_counts(self, index, f, field_name, shards_t) -> np.ndarray:
+        """The unfiltered per-row counts vector — the host rank-vector
+        table (the reference's rank cache, cache.go:136): the view
+        generation is the write epoch, so repeats serve without a
+        dispatch; a SMALL epoch refreshes the resident per-shard table
+        on the host (same incremental maintenance as the pair cache).
+        Single-flight admission: one refresher per field, waiters
+        re-check. Serves TopN, unfiltered Rows, and 1-field GroupBy
+        (which wants the raw vector — no sort, no Pair objects)."""
+        ckey = (index, field_name)
+        ukey = ("topn", index, field_name)
+        v = f.view(VIEW_STANDARD)
+        while True:
+            cfp = (shards_t, v.generation if v is not None else -1)
+            with self._pair_lock:
+                hit = self._topn_cache.get(ckey)
+                if hit is not None and hit[0] == cfp:
+                    self.stats.count("topn_cache_hits_total")
+                    return hit[1]
+                latch = self._stats_updating.get(ukey)
+                if latch is None:
+                    self._stats_updating[ukey] = threading.Event()
+                    break
+            latch.wait(timeout=60)
+        try:
+            # Generation moved: try the host table update against LIVE
+            # fragment versions — no stack fetch, no device round trip.
+            live_vers = self._live_versions(f, shards_t)
+            upd = self._topn_try_incremental(f, hit, shards_t, live_vers)
+            if upd is not None:
+                pershard, vers_rec = upd
+                counts = pershard.sum(axis=0).astype(np.uint64)
+                with self._pair_lock:
+                    self._topn_cache[ckey] = (cfp, counts, pershard, vers_rec)
+                return counts
+            return self._topn_dispatch(
+                index, f, shards_t, None, ckey, cfp, live_vers
+            )
+        finally:
+            with self._pair_lock:
+                ev = self._stats_updating.pop(ukey, None)
+            if ev is not None:
+                ev.set()
+
+    def _topn_dispatch(self, index, f, shards_t, src, ckey, cfp,
+                       live_vers) -> np.ndarray:
         src_call = src is not None
         block, rp, vers = self.blocks.get_with_versions(index, f, shards_t)
         if vers is None:
@@ -2344,7 +2408,7 @@ class TPUBackend:
                 self._topn_cache[ckey] = (cfp, counts, pershard, vers)
                 while len(self._topn_cache) > MAX_PAIR_CACHE_ENTRIES:
                     self._topn_cache.pop(next(iter(self._topn_cache)))
-        return self._topn_pairs(counts, n)
+        return counts
 
     def _topn_try_incremental(self, f, hit, shards_t, vers):
         """Host-side epoch update of the TopN per-shard row-count table:

@@ -181,6 +181,11 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             srv.kill()
             srv.wait()
+        if args.data_dir is None:
+            # Default dirs are per-run temp dirs: don't leak them.
+            import shutil
+
+            shutil.rmtree(data_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":
