@@ -157,6 +157,12 @@ class Fragment:
         # (exec/tpu.py _pair_try_incremental). Lazy: bulk-loaded
         # fragments that never see point writes pay nothing.
         self.bit_ops: Optional[deque] = None
+        # BSI twin: recent value mutations (version, old_present,
+        # old_value, new_present, new_value) in base-relative space —
+        # lets the unfiltered Sum cache apply set/clear_value epochs as
+        # sum/count deltas instead of re-dispatching the plane sweep
+        # (exec/tpu.py bsi_sum).
+        self.value_ops: Optional[deque] = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -286,6 +292,27 @@ class Fragment:
             window = [op for op in ops if v0 < op[0] <= v1]
         return window if len(window) == v1 - v0 else None
 
+    def _record_value_op(self, old_ok, old_v, new_ok, new_v) -> None:
+        """Called with self.lock held, right after _mutated bumped
+        version for exactly this one value change."""
+        if self.value_ops is None:
+            self.value_ops = deque(maxlen=self.BIT_OPS_MAX)
+        self.value_ops.append((self.version, old_ok, old_v, new_ok, new_v))
+
+    def value_ops_between(self, v0: int, v1: int):
+        """The exact value mutations covering versions (v0, v1], or None
+        when the window isn't fully explained by recorded point value
+        writes (bulk import_value, ring eviction, mixed mutations) —
+        same contract as bit_ops_between."""
+        if v1 <= v0:
+            return []
+        with self.lock:
+            ops = self.value_ops
+            if ops is None:
+                return None
+            window = [op for op in ops if v0 < op[0] <= v1]
+        return window if len(window) == v1 - v0 else None
+
     def set_bit(self, row_id: int, column_id: int) -> bool:
         """reference fragment.go setBit :647 (+ handleMutex :670)."""
         with self.lock:
@@ -406,26 +433,38 @@ class Fragment:
     # -- BSI ops (reference fragment.go:932-1537) --------------------------
 
     def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
-        """Sign-magnitude BSI write (reference setValueBase :988)."""
+        """Sign-magnitude BSI write (reference setValueBase :988).
+
+        The OLD value (for the Sum delta ring) falls out of the plane
+        writes for free: each add/remove returns whether the bit
+        changed, so old_bit = new_bit XOR changed — no pre-read."""
         with self.lock:
             uvalue = -value if value < 0 else value
             changed = False
+            old_u = 0
             col = column_id % SHARD_WIDTH
             for i in range(bit_depth):
                 p = (BSI_OFFSET_BIT + i) * SHARD_WIDTH + col
-                if (uvalue >> i) & 1:
-                    changed = self.storage.add(p) or changed
-                else:
-                    changed = self.storage.remove(p) or changed
+                nb = (uvalue >> i) & 1
+                ch = self.storage.add(p) if nb else self.storage.remove(p)
+                changed = ch or changed
+                old_u |= (nb ^ ch) << i
             p = BSI_EXISTS_BIT * SHARD_WIDTH + col
-            changed = self.storage.add(p) or changed
+            ch = self.storage.add(p)
+            changed = ch or changed
+            old_ok = not ch  # the add changed it -> wasn't present
             p = BSI_SIGN_BIT * SHARD_WIDTH + col
             if value < 0:
-                changed = self.storage.add(p) or changed
+                ch = self.storage.add(p)
+                old_sign = 1 ^ ch
             else:
-                changed = self.storage.remove(p) or changed
+                ch = self.storage.remove(p)
+                old_sign = 0 ^ ch
+            changed = ch or changed
             if changed:
                 self._mutated()
+                old_v = -old_u if old_sign else old_u
+                self._record_value_op(old_ok, old_v if old_ok else 0, True, value)
                 top = BSI_OFFSET_BIT + bit_depth - 1
                 if top > self.max_row_id:
                     self.max_row_id = top
@@ -436,10 +475,23 @@ class Fragment:
         with self.lock:
             col = column_id % SHARD_WIDTH
             changed = False
+            old_u = 0
+            old_sign = 0
+            old_ok = False
             for r in range(BSI_OFFSET_BIT + bit_depth):
-                changed = self.storage.remove(r * SHARD_WIDTH + col) or changed
+                ch = self.storage.remove(r * SHARD_WIDTH + col)
+                changed = ch or changed
+                if ch:  # removed -> the old bit was set
+                    if r == BSI_EXISTS_BIT:
+                        old_ok = True
+                    elif r == BSI_SIGN_BIT:
+                        old_sign = 1
+                    else:
+                        old_u |= 1 << (r - BSI_OFFSET_BIT)
             if changed:
                 self._mutated()
+                old_v = -old_u if old_sign else old_u
+                self._record_value_op(old_ok, old_v if old_ok else 0, False, 0)
             self._increment_op_n()
             return changed
 

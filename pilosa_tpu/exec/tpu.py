@@ -767,14 +767,15 @@ class TPUBackend:
             raise NotFoundError(f"field not found: {name}")
         return f
 
-    def _confirm_vers(self, field_obj, shards_t, recorded):
+    def _confirm_vers(self, field_obj, shards_t, recorded,
+                      view_name=VIEW_STANDARD):
         """Post-capture version confirmation: any shard whose live
         (uid, version) moved past the recorded capture version gets
         _VERS_STALE, so the next epoch slab-rederives it instead of
         delta-replaying ops onto content that may already include them
         (sweeps/stack builds read fragment content after reading
         versions; the window is small but real under churn)."""
-        live = self._live_versions(field_obj, shards_t)
+        live = self._live_versions(field_obj, shards_t, view_name)
         if live == recorded:
             return recorded
         return tuple(
@@ -2572,12 +2573,29 @@ class TPUBackend:
     def bsi_sum(self, index, field_name, shards, filter_call=None):
         """Distributed Sum(field): per-plane popcounts fused on device
         (+psum over ICI with a mesh), exact host weighting. Returns
-        (sum, count) or None when not lowerable."""
+        (sum, count) or None when not lowerable.
+
+        Unfiltered sums absorb point-value churn on the host: set/clear
+        value ops are recorded per BSI fragment (fragment.value_ops),
+        and an epoch fully explained by them updates the cached raw
+        total/count as exact deltas — no plane re-sweep."""
         # Fingerprint BEFORE the data snapshot: a write racing this query
         # must produce a never-matching cache entry, never a stale serve.
         hit = self._agg_lookup("sum", index, field_name, shards, filter_call)
         if hit is not None and hit[1] is not None:
             return hit[1]
+        if hit is not None:
+            upd = self._sum_try_incremental(index, field_name, shards, hit[0])
+            if upd is not None:
+                return upd
+        pre_vers = None
+        if hit is not None:
+            idx0 = self.holder.index(index)
+            f0 = idx0.field(field_name) if idx0 else None
+            if f0 is not None:
+                pre_vers = self._live_versions(
+                    f0, tuple(shards), bsi_view_name(field_name)
+                )
         try:
             f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
                 index, field_name, shards, filter_call
@@ -2597,7 +2615,62 @@ class TPUBackend:
         count = int(cnt)
         result = (total + opts.base * count, count)
         if hit is not None:
-            self._agg_store("sum", index, field_name, hit[0], result)
+            extra = None
+            if pre_vers is not None:
+                # Pre-read versions confirmed post-sweep (moved shards
+                # get _VERS_STALE): recorded versions never describe
+                # older content than swept — the delta tier requires it.
+                vers = self._confirm_vers(
+                    f, tuple(shards), pre_vers, bsi_view_name(field_name)
+                )
+                extra = (total, count, vers)
+            self._agg_store("sum", index, field_name, hit[0], result, extra)
+        return result
+
+    def _sum_try_incremental(self, index, field_name, shards, cfp_now):
+        """Apply a value-write epoch to the cached unfiltered Sum as
+        exact deltas from the BSI fragments' value-op rings. Returns the
+        fresh (sum, count) (already re-cached), or None when the epoch
+        isn't delta-coverable (bulk import_value, ring eviction, shard
+        set change, no prior entry with version info)."""
+        shards_t = tuple(shards)
+        with self._pair_lock:
+            ent = self._agg_cache.get(("sum", index, field_name))
+        if ent is None or len(ent) < 3 or ent[2] is None:
+            return None
+        raw_total, count, vers_old = ent[2]
+        if ent[0][0] != shards_t:
+            return None
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx else None
+        if f is None:
+            return None
+        vn = bsi_view_name(field_name)
+        v = f.view(vn)
+        vers_new = self._live_versions(f, shards_t, vn)
+        d_sum = 0
+        d_cnt = 0
+        for i, s in enumerate(shards_t):
+            ov, nv = vers_old[i], vers_new[i]
+            if ov == nv:
+                continue
+            fr = v.fragment(s) if v is not None else None
+            if fr is None or ov is None or nv is None or ov[0] != nv[0]:
+                return None
+            ops = fr.value_ops_between(ov[1], nv[1])
+            if ops is None:
+                return None
+            for _, ook, ovv, nok, nvv in ops:
+                d_sum += (nvv if nok else 0) - (ovv if ook else 0)
+                d_cnt += (1 if nok else 0) - (1 if ook else 0)
+        raw_total += d_sum
+        count += d_cnt
+        result = (raw_total + f.bsi_group().base * count, count)
+        self._agg_store(
+            "sum", index, field_name, cfp_now, result,
+            (raw_total, count, vers_new),
+        )
+        self.stats.count("sum_incremental_updates_total")
         return result
 
     def _agg_fingerprint(self, index, field_name, shards):
@@ -2628,9 +2701,12 @@ class TPUBackend:
             return hit
         return (cfp, None)
 
-    def _agg_store(self, kind, index, field_name, cfp, result):
+    def _agg_store(self, kind, index, field_name, cfp, result, extra=None):
+        """extra: Sum's (raw_total, count, per-shard versions) for the
+        value-delta tier; None for Min/Max (not delta-maintainable —
+        removing the extremum needs a re-scan)."""
         with self._pair_lock:
-            self._agg_cache[(kind, index, field_name)] = (cfp, result)
+            self._agg_cache[(kind, index, field_name)] = (cfp, result, extra)
             while len(self._agg_cache) > MAX_PAIR_CACHE_ENTRIES:
                 self._agg_cache.pop(next(iter(self._agg_cache)))
 

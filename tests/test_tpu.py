@@ -836,6 +836,57 @@ class TestAggCache:
         assert be.bsi_max("i", "v", [0]) == (want_max.val, want_max.count)
         assert (mn, mx) != (None, None)
 
+    def test_sum_value_delta_tier(self, holder, rng):
+        """Point value writes (set/clear/overwrite, any sign) update the
+        cached Sum as exact host deltas — no plane re-sweep; bulk
+        import_value is not delta-coverable and re-dispatches."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        idx = holder.create_index("i")
+        idx.create_field("v", options_for_int(-100, 100))
+        cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 500, dtype=np.uint64))
+        idx.field("v").import_value(cols, rng.integers(-100, 101, cols.size))
+        be = TPUBackend(holder)
+        ex_cpu = Executor(holder)
+        shards = [0, 1]
+        assert be.bsi_sum("i", "v", shards) is not None
+
+        def upds():
+            return global_stats._counters[("sum_incremental_updates_total", ())]
+
+        u0 = upds()
+        taken = set(cols.tolist())
+        free = next(c for c in range(SHARD_WIDTH) if c not in taken)
+        free1 = next(
+            c for c in range(SHARD_WIDTH, 2 * SHARD_WIDTH)
+            if c not in taken and c != free
+        )
+        ops = [
+            ("set", free, 37),        # new column
+            ("set", free, -14),       # overwrite, sign flip
+            ("set", int(cols[0]), 9),  # overwrite existing
+            ("clear", free, None),    # removal
+            ("set", free1, 50),       # the other queried shard
+        ]
+        for k, (verb, col, val) in enumerate(ops):
+            f = idx.field("v")
+            if verb == "set":
+                f.set_value(col, val)
+            else:
+                frag = f.view(f"bsig_v").fragment(col // SHARD_WIDTH)
+                frag.clear_value(col, f.bsi_group().bit_depth)
+            got = be.bsi_sum("i", "v", shards)
+            want = ex_cpu.execute("i", "Sum(field=v)")[0]
+            assert got == (want.val, want.count), (k, got, want)
+            assert upds() == u0 + k + 1
+        # Bulk path: not coverable, must re-dispatch yet stay exact.
+        more = np.array([free + 5, free + 6], dtype=np.uint64)
+        idx.field("v").import_value(more, np.array([1, 2]))
+        got = be.bsi_sum("i", "v", shards)
+        want = ex_cpu.execute("i", "Sum(field=v)")[0]
+        assert got == (want.val, want.count)
+        assert upds() == u0 + len(ops)
+
 
 class TestRowPaging:
     """HBM row paging (VERDICT r2 #8): a field too tall for the byte
