@@ -358,6 +358,15 @@ class _StackedBlocks:
             with self._lock:
                 self._building.pop(key).set()
 
+    def peek(self, index: str, field_name: str,
+             view_name: str = VIEW_STANDARD):
+        """The resident stack for a key, or None — never builds (preheat
+        program warming must not trigger uploads/evictions of its own,
+        especially after stopping on a full budget)."""
+        with self._lock:
+            ent = self._entries.get((index, field_name, view_name))
+            return ent[1] if ent is not None else None
+
     def make_room(self, nbytes: int) -> None:
         """LRU-evict cached stacks until `nbytes` fits under the budget —
         used by streaming page sweeps so transient page uploads stay
@@ -1994,12 +2003,15 @@ class TPUBackend:
                         )
                         if self.blocks.evictions > ev_before:
                             # Budget full: later uploads would only evict
-                            # earlier preheated stacks — stop here.
+                            # earlier preheated stacks — stop here, but
+                            # still compile the serving programs for
+                            # whatever IS resident.
                             if logger is not None:
                                 logger.printf(
                                     "preheat: HBM budget reached at %s/%s",
                                     iname, fname,
                                 )
+                            self._preheat_programs(iname, idx, shards, logger)
                             return n
                         if block is not None:
                             n += 1
@@ -2029,12 +2041,11 @@ class TPUBackend:
         std_blocks = []
         for fname in list(idx.fields):
             try:
-                f = idx.field(fname)
-                if f is None or f.view(VIEW_STANDARD) is None:
-                    continue
-                cached = self.blocks.get(iname, f, shards, VIEW_STANDARD)
-                if cached[0] is not None:
-                    std_blocks.append(cached[0])
+                # peek, never build: warming must not trigger uploads or
+                # evictions (the budget path stops packing deliberately).
+                b = self.blocks.peek(iname, fname, VIEW_STANDARD)
+                if b is not None:
+                    std_blocks.append(b)
             except Exception as e:  # noqa: BLE001
                 _log(f"block {fname}", e)
         shapes_done = set()
@@ -2043,12 +2054,7 @@ class TPUBackend:
                 continue
             shapes_done.add(b.shape)
             try:
-                s_pad, rp = b.shape[0], b.shape[1]
-                # Mirror _topn_dispatch's variant choice.
-                pershard_ok = s_pad * rp * 8 <= self.MAX_PAIR_PERSHARD_BYTES
-                reduce_dev = (
-                    False if pershard_ok else s_pad <= MAX_DEVICE_SUM_SHARDS
-                )
+                reduce_dev = self._topn_gates(b.shape[0], b.shape[1], False)[1]
                 self._program("topn_plain", None, reduce_dev)(b)
             except Exception as e:  # noqa: BLE001
                 _log("topn program", e)
@@ -2450,19 +2456,7 @@ class TPUBackend:
             counts = self._topn_paged_counts(index, f, shards_t, src)
         else:
             s_pad = block.shape[0]
-            # Unfiltered single-device: take [S, R] partials — the
-            # per-shard table is what absorbs later write epochs — but
-            # only under the same retention byte gate as the pair table
-            # (a many-row field's [S, R] readback + resident copy can
-            # reach hundreds of MB; over the gate, device-sum to [R]
-            # and let write epochs re-dispatch).
-            pershard_ok = (
-                not src_call
-                and s_pad * rp * 8 <= self.MAX_PAIR_PERSHARD_BYTES
-            )
-            reduce_dev = (
-                False if pershard_ok else s_pad <= MAX_DEVICE_SUM_SHARDS
-            )
+            _, reduce_dev = self._topn_gates(s_pad, rp, src_call)
             with jax.profiler.TraceAnnotation("pilosa.topn"):
                 if not src_call:
                     counts = self._program("topn_plain", None, reduce_dev)(block)
@@ -2484,6 +2478,24 @@ class TPUBackend:
                 while len(self._topn_cache) > MAX_PAIR_CACHE_ENTRIES:
                     self._topn_cache.pop(next(iter(self._topn_cache)))
         return counts
+
+    def _topn_gates(self, s_pad, rp, src_call):
+        """(pershard_ok, reduce_dev) for a TopN dispatch — shared with
+        preheat's program warming so the copies can't drift (same
+        discipline as _pair_gates). Unfiltered dispatches take [S, R]
+        partials — the per-shard table is what absorbs later write
+        epochs — but only under the same retention byte gate as the
+        pair table (a many-row field's [S, R] readback + resident copy
+        can reach hundreds of MB; over the gate, device-sum to [R] and
+        let write epochs re-dispatch)."""
+        pershard_ok = (
+            not src_call
+            and s_pad * rp * 8 <= self.MAX_PAIR_PERSHARD_BYTES
+        )
+        reduce_dev = (
+            False if pershard_ok else s_pad <= MAX_DEVICE_SUM_SHARDS
+        )
+        return pershard_ok, reduce_dev
 
     def _topn_try_incremental(self, f, hit, shards_t, vers):
         """Host-side epoch update of the TopN per-shard row-count table:

@@ -63,7 +63,7 @@ def is_reserved_arg(name: str) -> bool:
 class Call:
     """One function call in the AST (reference pql/ast.go:263)."""
 
-    __slots__ = ("name", "args", "children", "cached")
+    __slots__ = ("name", "args", "children", "cached", "has_str_args")
 
     def __init__(
         self,
@@ -79,6 +79,12 @@ class Call:
         # is what makes id-keyed memoization (pair-plan cache) sound.
         # Copies and translated rewrites are always False.
         self.cached = False
+        # Whether this subtree carries any str/bool arg — the only
+        # values key translation can rewrite or reject. Defaults True
+        # (conservative: always translate); the parser computes it
+        # precisely at cache insertion so pure-integer trees skip the
+        # per-request translation walk entirely on keyless indexes.
+        self.has_str_args = True
 
     def copy(self) -> "Call":
         """Structural copy for paths that MUST mutate (e.g. TopN pass-2

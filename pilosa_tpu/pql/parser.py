@@ -495,13 +495,16 @@ def parse_string(text: str) -> Query:
             return q
     q = Parser(text).parse()
     if cacheable:
-        def mark(c):
+        def mark(c) -> bool:
             c.cached = True
+            has = any(isinstance(v, (str, bool)) for v in c.args.values())
             for ch in c.children:
-                mark(ch)
+                has = mark(ch) or has
             for v in c.args.values():
                 if isinstance(v, Call):
-                    mark(v)
+                    has = mark(v) or has
+            c.has_str_args = has
+            return has
         for c in q.calls:
             mark(c)
         with _parse_lock:
