@@ -2,6 +2,7 @@
 mirroring the reference's http/handler_test.go + api_test.go coverage."""
 
 import json
+import socket
 import urllib.error
 import urllib.request
 
@@ -350,6 +351,104 @@ class TestRuntimeMonitor:
         assert out["platform"]["python"]
         assert out["rss_bytes"] > 0
         assert "uptime_seconds" in out
+
+
+class TestRequestParsing:
+    """The hand-rolled HTTP/1.x request parser (server/http.py
+    parse_request replaced the stdlib's email.feedparser path) must
+    mirror stdlib semantics on the adversarial edges."""
+
+    def _raw(self, server, payload: bytes) -> bytes:
+        s = socket.create_connection(("localhost", server.port), timeout=10)
+        try:
+            s.sendall(payload)
+            s.shutdown(socket.SHUT_WR)
+            out = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                out += chunk
+            return out
+        finally:
+            s.close()
+
+    def test_status_ok(self, server):
+        out = self._raw(server, b"GET /status HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert out.startswith(b"HTTP/1.1 200")
+
+    def test_bad_request_line(self, server):
+        out = self._raw(server, b"GARBAGE\r\n\r\n")
+        assert b" 400 " in out.split(b"\r\n", 1)[0]
+
+    def test_bad_version(self, server):
+        out = self._raw(server, b"GET /status HTTQ/1.1\r\n\r\n")
+        assert b" 400 " in out.split(b"\r\n", 1)[0]
+
+    def test_http2_rejected_505(self, server):
+        out = self._raw(server, b"GET /status HTTP/2.0\r\n\r\n")
+        assert b" 505 " in out.split(b"\r\n", 1)[0]
+
+    def test_oversized_header_line_431(self, server):
+        big = b"X-Big: " + b"a" * 70000
+        out = self._raw(server, b"GET /status HTTP/1.1\r\n" + big + b"\r\n\r\n")
+        assert b" 431 " in out.split(b"\r\n", 1)[0]
+
+    def test_too_many_headers_431(self, server):
+        headers = b"".join(b"X-H%d: v\r\n" % i for i in range(150))
+        out = self._raw(server, b"GET /status HTTP/1.1\r\n" + headers + b"\r\n")
+        assert b" 431 " in out.split(b"\r\n", 1)[0]
+
+    def test_duplicate_content_length_uses_first(self, server):
+        # DIFFERING values: first-wins reads b"{}" (200); last-wins
+        # would read b"{}xx" and fail JSON decode — so a regression to
+        # overwrite semantics actually fails this test.
+        payload = (
+            b"POST /index/dup HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\nContent-Length: 4\r\n\r\n" + b"{}xx"
+        )
+        out = self._raw(server, payload)
+        assert out.startswith(b"HTTP/1.1 200"), out[:200]
+
+    def test_header_case_insensitive(self, server):
+        payload = (
+            b"POST /index/ci HTTP/1.1\r\nHost: x\r\n"
+            b"cOnTeNt-LeNgTh: 2\r\n\r\n{}"
+        )
+        out = self._raw(server, payload)
+        assert out.startswith(b"HTTP/1.1 200"), out[:200]
+
+    def test_http10_keepalive_honored(self, server):
+        s = socket.create_connection(("localhost", server.port), timeout=10)
+        try:
+            s.sendall(b"GET /status HTTP/1.0\r\nConnection: keep-alive\r\n\r\n")
+            first = b""
+            while b"}\n" not in first:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                first += chunk
+            assert first.startswith(b"HTTP/1.1 200")
+            # The connection must still be open for a second request.
+            s.sendall(b"GET /status HTTP/1.0\r\nConnection: keep-alive\r\n\r\n")
+            second = s.recv(65536)
+            assert second.startswith(b"HTTP/1.1 200")
+        finally:
+            s.close()
+
+    def test_connection_close_honored(self, server):
+        s = socket.create_connection(("localhost", server.port), timeout=10)
+        try:
+            s.sendall(b"GET /status HTTP/1.1\r\nConnection: close\r\n\r\n")
+            out = b""
+            while True:  # server must close after the response
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                out += chunk
+            assert out.startswith(b"HTTP/1.1 200")
+        finally:
+            s.close()
 
 
 class TestPprof:
