@@ -143,6 +143,22 @@ long long pilosa_intersection_count_many(const uint16_t* a, const long long* aof
     return total;
 }
 
+// One 8 KiB container bitset -> sorted uint16 positions appended at
+// `out`; returns the count. Shared by all three dedupe paths so the
+// ctz pop loop has exactly one copy to maintain.
+static inline size_t extract_bitset(const uint64_t* bs, uint16_t* out) {
+    size_t wrote = 0;
+    for (uint32_t w = 0; w < 1024; w++) {
+        uint64_t word = bs[w];
+        while (word) {
+            uint32_t tz = (uint32_t)__builtin_ctzll(word);
+            out[wrote++] = (uint16_t)((w << 6) | tz);
+            word &= word - 1;
+        }
+    }
+    return wrote;
+}
+
 // Container-granular bulk import (the ImportRoaringBits shape,
 // reference roaring/roaring.go:1511 — bits group by container key and
 // merge at container level instead of value-at-a-time): from one
@@ -180,6 +196,50 @@ long long pilosa_import_containers(const uint64_t* rows, const uint64_t* cols,
         cursor_cap = cursor ? key_cap : 0;
         if (!cursor_cap) return -2;
     }
+    // Single-pass fast path: scatter bits directly into per-KEY
+    // bitsets, zeroing the slab region lazily as the max key grows —
+    // the 16 B/item input streams through ONCE instead of the
+    // count-then-scatter double read (the input load was the measured
+    // bound). Falls through to the two-pass paths when the key range
+    // exceeds the slab cap (tall imports) or on alloc failure; the
+    // cursor table is untouched here, so the invariant holds.
+    const size_t kMaxSlabSlots = 512;
+    if (key_cap >= kMaxSlabSlots) {
+        if (slab_cap < kMaxSlabSlots * 1024) {
+            free(slabs);
+            slabs = (uint64_t*)malloc(kMaxSlabSlots * 1024 * sizeof(uint64_t));
+            slab_cap = slabs ? kMaxSlabSlots * 1024 : 0;
+        }
+        if (slab_cap) {
+            uint64_t zeroed = 0;  // slab slots [0, zeroed) are zero
+            int tall = 0;
+            for (size_t i = 0; i < n; i++) {
+                uint64_t local = cols[i] & col_mask;
+                uint64_t key = (rows[i] << key_shift) + (local >> 16);
+                if (key >= kMaxSlabSlots) { tall = 1; break; }
+                if (key >= zeroed) {
+                    memset(slabs + (zeroed << 10), 0,
+                           (size_t)(key + 1 - zeroed) * 8192);
+                    zeroed = key + 1;
+                }
+                slabs[(key << 10) | ((local & 0xFFFFu) >> 6)] |=
+                    1ULL << (local & 63u);
+            }
+            if (!tall) {
+                size_t nk = 0, lo = 0;
+                for (uint64_t k = 0; k < zeroed; k++) {
+                    size_t wrote = extract_bitset(slabs + (k << 10), out_lows + lo);
+                    lo += wrote;
+                    if (wrote) {
+                        out_keys[nk] = (uint32_t)k;
+                        out_counts[nk] = (uint32_t)wrote;
+                        nk++;
+                    }
+                }
+                return (long long)nk;
+            }
+        }
+    }
     // Pass 1: count per container key (kept store-free: key/low are
     // recomputed in pass 2 — rescanning 16 B/item beats materializing
     // and re-reading 6 B/item of key+low temporaries on this host).
@@ -204,11 +264,9 @@ long long pilosa_import_containers(const uint64_t* rows, const uint64_t* cols,
     for (size_t k = 0; k <= maxk; k++) {
         if (cursor[k]) out_keys[nk++] = (uint32_t)k;
     }
-    // Direct-bitset dedupe: one 8 KiB bitset PER container, scattered
-    // into straight from (rows, cols) — no intermediate bucket arrays,
-    // no separate fill pass. Capped so the slab buffer stays ~4 MiB;
-    // taller imports take the bucket path below.
-    const size_t kMaxSlabSlots = 512;
+    // Two-pass direct-bitset dedupe (keys beyond the single-pass range
+    // but few DISTINCT containers): one 8 KiB bitset per container via
+    // a compacted key->slot map. Taller imports take the bucket path.
     if (nk <= kMaxSlabSlots) {
         if (slab_cap < nk * 1024) {
             free(slabs);
@@ -232,17 +290,8 @@ long long pilosa_import_containers(const uint64_t* rows, const uint64_t* cols,
         }
         size_t lo = 0;
         for (size_t j = 0; j < nk; j++) {
-            const uint64_t* bs = slabs + (j << 10);
-            size_t wrote = 0;
-            for (uint32_t w = 0; w < 1024; w++) {
-                uint64_t word = bs[w];
-                while (word) {
-                    uint32_t tz = (uint32_t)__builtin_ctzll(word);
-                    out_lows[lo++] = (uint16_t)((w << 6) | tz);
-                    wrote++;
-                    word &= word - 1;
-                }
-            }
+            size_t wrote = extract_bitset(slabs + (j << 10), out_lows + lo);
+            lo += wrote;
             out_counts[j] = (uint32_t)wrote;
         }
         for (size_t j = 0; j < nk; j++) cursor[out_keys[j]] = 0;
@@ -282,16 +331,8 @@ long long pilosa_import_containers(const uint64_t* rows, const uint64_t* cols,
             uint16_t p = bucket[i];
             bits[p >> 6] |= 1ULL << (p & 63u);
         }
-        size_t wrote = 0;
-        for (uint32_t w = 0; w < 1024; w++) {
-            uint64_t word = bits[w];
-            while (word) {
-                uint32_t tz = (uint32_t)__builtin_ctzll(word);
-                out_lows[lo++] = (uint16_t)((w << 6) | tz);
-                wrote++;
-                word &= word - 1;
-            }
-        }
+        size_t wrote = extract_bitset(bits, out_lows + lo);
+        lo += wrote;
         out_counts[j] = (uint32_t)wrote;
         start = end;
     }

@@ -105,16 +105,24 @@ class _Headers:
     — for features (obs-fold continuations, MIME structure) HTTP/1.1
     requests don't need."""
 
-    __slots__ = ("_d",)
+    __slots__ = ("_d", "conflicting_length")
 
     def __init__(self):
         self._d: dict[str, str] = {}
+        self.conflicting_length = False
 
     def add(self, k: str, v: str) -> None:
         # Repeated headers keep the FIRST value, matching what
         # email.Message.get returned (comma-joining would e.g. make a
-        # duplicated Content-Length unparseable downstream).
-        self._d.setdefault(k.lower(), v)
+        # duplicated Content-Length unparseable downstream). DIFFERING
+        # repeated Content-Length values are flagged so parse_request
+        # can reject the request (RFC 7230 §3.3.2 — the classic CL.CL
+        # request-smuggling vector when proxy and server disagree on
+        # which value wins).
+        lk = k.lower()
+        prev = self._d.setdefault(lk, v)
+        if lk == "content-length" and prev != v:
+            self.conflicting_length = True
 
     def get(self, k: str, default=None):
         return self._d.get(k.lower(), default)
@@ -182,6 +190,9 @@ class _Handler(BaseHTTPRequestHandler):
             if sep:
                 headers.add(k.strip(), v.strip())
         self.headers = headers
+        if headers.conflicting_length:
+            self.send_error(400, "Conflicting Content-Length headers")
+            return False
         conntype = (headers.get("Connection") or "").lower()
         if conntype == "close":
             self.close_connection = True

@@ -399,13 +399,21 @@ class TestRequestParsing:
         out = self._raw(server, b"GET /status HTTP/1.1\r\n" + headers + b"\r\n")
         assert b" 431 " in out.split(b"\r\n", 1)[0]
 
-    def test_duplicate_content_length_uses_first(self, server):
-        # DIFFERING values: first-wins reads b"{}" (200); last-wins
-        # would read b"{}xx" and fail JSON decode — so a regression to
-        # overwrite semantics actually fails this test.
+    def test_conflicting_content_length_rejected(self, server):
+        # RFC 7230 §3.3.2: differing repeated Content-Length must be
+        # rejected — accepting either value desyncs front proxies that
+        # pick the other one (CL.CL request smuggling).
         payload = (
             b"POST /index/dup HTTP/1.1\r\nHost: x\r\n"
             b"Content-Length: 2\r\nContent-Length: 4\r\n\r\n" + b"{}xx"
+        )
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_identical_duplicate_content_length_ok(self, server):
+        payload = (
+            b"POST /index/dup2 HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\nContent-Length: 2\r\n\r\n" + b"{}"
         )
         out = self._raw(server, payload)
         assert out.startswith(b"HTTP/1.1 200"), out[:200]
