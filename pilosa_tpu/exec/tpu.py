@@ -468,11 +468,21 @@ def _pack_confirmed(fr, n_rows: int):
     """Pack a fragment slab with its (uid, version) CONFIRMED unchanged
     across the pack — a mid-pack write re-packs, so the returned version
     describes exactly the returned content (the delta tier replays ops
-    on top of it and must not double-apply)."""
+    on top of it and must not double-apply).
+
+    The recheck holds fr.lock: writers mutate storage BEFORE bumping
+    version inside their fr.lock critical section (fragment.py set_bit),
+    so an unlocked recheck could observe the pre-write version for
+    content the pack already saw. Acquiring the lock serializes with
+    the writer — a mid-pack write has bumped version by the time the
+    locked recheck runs, forcing the retry."""
     while True:
-        v = (fr.uid, fr.version)
+        with fr.lock:
+            v = (fr.uid, fr.version)
         slab = pack_fragment(fr, n_rows=n_rows)
-        if (fr.uid, fr.version) == v:
+        with fr.lock:
+            confirmed = (fr.uid, fr.version) == v
+        if confirmed:
             return slab, v
 
 
@@ -798,14 +808,26 @@ class TPUBackend:
         Reading the LIVE versions (not the resident stack's) is what lets
         pair/TopN batches resolve entirely on the host under write churn:
         the device stack can stay stale until a query actually needs it
-        (every stack consumer re-checks its own fingerprint)."""
+        (every stack consumer re-checks its own fingerprint).
+
+        Each read holds fr.lock: writers mutate storage before bumping
+        version inside their critical section, so an unlocked read can
+        return a pre-write version for post-write content. Locked reads
+        serialize with the writer, which makes _confirm_vers (built on
+        this) a true post-capture barrier — a capture that raced a write
+        is always seen as moved and recorded _VERS_STALE."""
         v = field_obj.view(view_name)
         if v is None:
             return tuple(None for _ in shards_t)
-        return tuple(
-            (fr.uid, fr.version) if fr is not None else None
-            for fr in (v.fragment(s) for s in shards_t)
-        )
+        out = []
+        for s in shards_t:
+            fr = v.fragment(s)
+            if fr is None:
+                out.append(None)
+            else:
+                with fr.lock:
+                    out.append((fr.uid, fr.version))
+        return tuple(out)
 
     def _build(self, index: str, c: Call, shards: tuple[int, ...],
                blocks: list, scalars: list):
@@ -1785,8 +1807,12 @@ class TPUBackend:
         if other is None:
             if other_vers is not None:
                 return None  # fragment vanished since the walk
-        elif other_vers is None or (other.uid, other.version) != other_vers:
-            return None
+        else:
+            with other.lock:  # serialize with a mid-write bump (see _pack_confirmed)
+                moved = other_vers is None or \
+                    (other.uid, other.version) != other_vers
+            if moved:
+                return None
         row_flat = pershard[i]
         sw = SHARD_WIDTH
         for _, r, c, sign in ops:
@@ -1808,9 +1834,12 @@ class TPUBackend:
                     for a in range(rf):
                         if st.contains(a * sw + c):
                             row_flat[a * rg + r] += sign
-        if other is not None and (other.uid, other.version) != other_vers:
-            row_flat[:] = hit.pershard[i]
-            return None
+        if other is not None:
+            with other.lock:  # post-probe confirm must see any racing writer
+                moved = (other.uid, other.version) != other_vers
+            if moved:
+                row_flat[:] = hit.pershard[i]
+                return None
         return len(ops)
 
     def _pair_fetch(self, entries, ent, rf, rg) -> list[int]:

@@ -428,12 +428,21 @@ class Bitmap:
     equivalent with the same O(log n) seek / O(1) hit behavior).
     """
 
-    __slots__ = ("_cs", "_keys", "_keys_dirty", "op_writer", "op_n", "flags")
+    __slots__ = ("_cs", "_keys", "_keys_gen", "_keys_built", "op_writer",
+                 "op_n", "flags")
 
     def __init__(self, values: Optional[Iterable[int]] = None):
         self._cs: dict[int, Container] = {}
         self._keys: list[int] = []
-        self._keys_dirty = False
+        # Key-list freshness is a GENERATION pair, not a dirty bool: a
+        # locked writer racing an UNLOCKED reader's lazy rebuild (stack
+        # pack under churn) could otherwise lose its dirty mark — reader
+        # sorts, writer inserts + sets dirty, reader stores its stale
+        # sort AND clears the flag — and the missing container would
+        # survive every _pack_confirmed retry (exec/tpu.py), silently
+        # breaking the host tables' exactness invariant.
+        self._keys_gen = 0     # bumped by every container insert/delete
+        self._keys_built = 0   # generation the cached sort was built at
         # Durability hook: fragment storage attaches a WAL writer here
         # (reference fragment.go:455 attaches the op writer; ops appended at
         # roaring/roaring.go:1612). None means no-op.
@@ -448,9 +457,15 @@ class Bitmap:
     # -- key bookkeeping -------------------------------------------------
 
     def keys(self) -> list[int]:
-        if self._keys_dirty:
-            self._keys = sorted(self._cs.keys())
-            self._keys_dirty = False
+        if self._keys_gen != self._keys_built:
+            # Read the generation BEFORE snapshotting: a writer landing
+            # mid-sort bumps _keys_gen past `g`, so the cache stays
+            # marked stale and the next call re-sorts. sorted(dict) is
+            # a single GIL-atomic C snapshot for int keys (no Python
+            # callbacks), so the sort itself cannot tear.
+            g = self._keys_gen
+            self._keys = sorted(self._cs)
+            self._keys_built = g
         return self._keys
 
     def container(self, key: int) -> Optional[Container]:
@@ -462,11 +477,17 @@ class Bitmap:
         if c.n == 0:
             if key in self._cs:
                 del self._cs[key]
-                self._keys_dirty = True
+                self._keys_gen += 1
             return
-        if key not in self._cs:
-            self._keys_dirty = True
+        is_new = key not in self._cs
         self._cs[key] = c
+        if is_new:
+            # Mutate-then-bump, matching the delete path above: bumping
+            # BEFORE the insert would let an unlocked keys() rebuild
+            # capture the post-bump generation with a pre-insert
+            # snapshot and mark it fresh — the lost-staleness race the
+            # generation counter exists to prevent.
+            self._keys_gen += 1
 
     def put_container(self, key: int, c: Container) -> None:
         self._put(key, c)
@@ -795,7 +816,7 @@ class Bitmap:
     def clone(self) -> "Bitmap":
         out = Bitmap()
         out._cs = dict(self._cs)
-        out._keys_dirty = True
+        out._keys_gen = 1  # fresh instance: built==0 != gen -> re-sort
         return out
 
     # -- import (bulk union/clear from serialized roaring) ----------------

@@ -27,6 +27,11 @@ from pilosa_tpu.server.wire import (
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = []
 
+#: RFC 7230 §3.2.6 token — the only charset a header field-name may use.
+#: Validated with fullmatch so embedded whitespace, bare CR, or any other
+#: separator/control char in the name is a 400, not a silent normalize.
+_TOKEN_RE = re.compile(r"[!#$%&'*+\-.^_`|~0-9A-Za-z]+")
+
 _PPROF = None
 _PPROF_LOCK = threading.Lock()
 
@@ -186,12 +191,52 @@ class _Handler(BaseHTTPRequestHandler):
             if n > 100:
                 self.send_error(431, "Too many headers")
                 return False
-            k, sep, v = line.decode("iso-8859-1").partition(":")
-            if sep:
-                headers.add(k.strip(), v.strip())
+            decoded = line.decode("iso-8859-1")
+            # Strip ONLY the line terminator: an embedded bare CR must
+            # stay visible so it fails validation below (a proxy that
+            # treats it as a terminator would see different headers).
+            if decoded.endswith("\r\n"):
+                decoded = decoded[:-2]
+            elif decoded.endswith("\n"):
+                decoded = decoded[:-1]
+            if decoded[:1] in (" ", "\t"):
+                # Obs-fold continuation (RFC 7230 §3.2.4: reject or
+                # normalize). Silently dropping it would let a folding
+                # front proxy see a different header set than this
+                # server — the same proxy-disagreement class as CL.CL.
+                self.send_error(400, "Obsolete header folding not supported")
+                return False
+            if "\r" in decoded:
+                self.send_error(400, "Bare CR in header line")
+                return False
+            k, sep, v = decoded.partition(":")
+            if not sep or not _TOKEN_RE.fullmatch(k):
+                # No colon, empty name, or any non-token char in the
+                # field-name (whitespace before the colon included) —
+                # RFC 7230 §3.2.4 requires 400, not a drop-or-normalize.
+                self.send_error(400, "Malformed header line")
+                return False
+            headers.add(k, v.strip())
         self.headers = headers
         if headers.conflicting_length:
             self.send_error(400, "Conflicting Content-Length headers")
+            return False
+        cl = headers.get("Content-Length")
+        if cl is not None and not re.fullmatch(r"[0-9]+", cl.strip()):
+            # RFC 7230 §3.3.2: 1*DIGIT only. Letting "abc" or "-5"
+            # through to int()/read() in _body() re-opens the keep-alive
+            # desync this parser rejects for CL.CL/TE.CL (the later 500
+            # would NOT close the connection, so the unread body would
+            # be parsed as the next request).
+            self.send_error(400, "Invalid Content-Length")
+            return False
+        if headers.get("Transfer-Encoding") is not None:
+            # This server never implements chunked decoding; treating a
+            # chunked body as Content-Length 0 would leave it in rfile
+            # to be parsed as the NEXT request on the keep-alive
+            # connection (TE.CL desync behind a front proxy). RFC 7230
+            # §3.3.1: respond 501 and close.
+            self.send_error(501, "Transfer-Encoding not supported")
             return False
         conntype = (headers.get("Connection") or "").lower()
         if conntype == "close":

@@ -315,3 +315,28 @@ class TestRunContainers:
         f.storage.optimize()
         block = pack_fragment(f)
         np.testing.assert_array_equal(unpack_row(block[0]), cols)
+
+
+class TestKeysGenerationCounter:
+    """keys()'s lazy sorted-key rebuild must never lose a concurrent
+    writer's staleness mark (code review r5): a bool dirty flag could be
+    cleared by a reader that sorted BEFORE the write landed, leaving the
+    missing container invisible to every later pack retry."""
+
+    def test_writer_during_rebuild_stays_stale(self):
+        b = Bitmap([1])
+        assert b.keys() == [0]
+        # Simulate the interleaving: reader captured gen, then a writer
+        # inserts a new container before the reader stores its result.
+        g = b._keys_gen
+        stale_sort = sorted(b._cs)
+        b.add(5 << 16)  # new container -> gen bump
+        b._keys = stale_sort
+        b._keys_built = g  # reader's store of a pre-write snapshot
+        # The cache must be considered stale: next keys() re-sorts.
+        assert b.keys() == [0, 5]
+
+    def test_clone_starts_stale(self):
+        b = Bitmap([1, 1 << 16])
+        c = b.clone()
+        assert c.keys() == [0, 1]

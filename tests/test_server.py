@@ -444,6 +444,71 @@ class TestRequestParsing:
         finally:
             s.close()
 
+    def test_transfer_encoding_rejected_501(self, server):
+        # ADVICE r4: a chunked request treated as Content-Length 0 would
+        # leave its body in rfile to be parsed as the NEXT request on
+        # the keep-alive connection (TE.CL desync behind a front proxy).
+        # The server never implements chunked: 501 + close.
+        payload = (
+            b"POST /index/te HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"2\r\n{}\r\n0\r\n\r\n"
+            b"GET /smuggled HTTP/1.1\r\nHost: x\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert b" 501 " in out.split(b"\r\n", 1)[0], out[:200]
+        # One response only — the connection closed; the trailing bytes
+        # were never parsed as a second request.
+        assert out.count(b"HTTP/1.1 ") == 1
+
+    def test_obs_fold_continuation_rejected_400(self, server):
+        # RFC 7230 §3.2.4: a server must reject or normalize obs-fold;
+        # silently dropping "  continued" diverges from folding proxies.
+        payload = (
+            b"GET /status HTTP/1.1\r\nHost: x\r\n"
+            b"X-Folded: part1\r\n  part2\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_header_without_colon_rejected_400(self, server):
+        payload = b"GET /status HTTP/1.1\r\nHost: x\r\nnocolonhere\r\n\r\n"
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_malformed_content_length_rejected_400(self, server):
+        # "abc" (or unicode digits, or "-5") must die at parse time: a
+        # later 500 would not close the connection and the unread body
+        # would desync the keep-alive stream (code review r5 finding).
+        for bad in (b"abc", b"-5", b"\xb2", b"1.5"):
+            payload = (
+                b"POST /index/cl HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: " + bad + b"\r\n\r\nxx"
+            )
+            out = self._raw(server, payload)
+            assert b" 400 " in out.split(b"\r\n", 1)[0], (bad, out[:200])
+
+    def test_embedded_bare_cr_in_header_rejected_400(self, server):
+        # readline splits on \n only; "X-Bad\r: v" would otherwise be
+        # silently normalized to "X-Bad" while a CR-terminating proxy
+        # sees a different header set (code review r5 finding).
+        payload = b"GET /status HTTP/1.1\r\nHost: x\r\nX-Bad\r: v\r\n\r\n"
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_whitespace_inside_header_name_rejected_400(self, server):
+        payload = b"GET /status HTTP/1.1\r\nX Y: v\r\n\r\n"
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_space_before_colon_rejected_400(self, server):
+        # "Host : x" — RFC 7230 §3.2.4 explicitly requires 400 for
+        # whitespace between field-name and colon (proxies disagree on
+        # whether the name is "Host" or "Host ").
+        payload = b"GET /status HTTP/1.1\r\nHost : x\r\n\r\n"
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
     def test_connection_close_honored(self, server):
         s = socket.create_connection(("localhost", server.port), timeout=10)
         try:

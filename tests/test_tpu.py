@@ -1202,3 +1202,86 @@ class TestRowsDevice:
         assert Executor(holder, backend=be).execute("i", "Rows(f)") == Executor(
             holder
         ).execute("i", "Rows(f)")
+
+
+class TestVersionCaptureRace:
+    """ADVICE r4 (high): writers mutate storage BEFORE bumping version,
+    both inside fr.lock (fragment.py set_bit). A version capture that
+    does not serialize with that critical section can record a
+    pre-write version for post-write content, and the non-idempotent
+    delta replay then double-applies the op. These tests pin the fix:
+    every capture/confirm read of (uid, version) holds fr.lock."""
+
+    def _mid_write(self, fr, row, col):
+        """Start a writer parked inside its critical section: storage
+        mutated, version NOT yet bumped. Returns (thread, release)."""
+        import threading
+
+        from pilosa_tpu.core.fragment import pos
+
+        entered = threading.Event()
+        release = threading.Event()
+
+        def writer():
+            with fr.lock:
+                fr.storage.add(pos(row, col))  # content lands first...
+                entered.set()
+                release.wait(5)
+                fr.version += 1  # ...version bumps before unlock
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        assert entered.wait(5)
+        return t, release
+
+    def test_pack_confirmed_blocks_on_mid_write(self):
+        import threading
+
+        from pilosa_tpu.exec.tpu import _pack_confirmed
+
+        fr = Fragment(None, "i", "f", "standard", 0)
+        fr.set_bit(0, 1)
+        t, release = self._mid_write(fr, 1, 5)
+        done = {}
+
+        def packer():
+            done["res"] = _pack_confirmed(fr, 2)
+
+        p = threading.Thread(target=packer, daemon=True)
+        p.start()
+        p.join(0.3)
+        # Must be parked on fr.lock — capturing now would pair the
+        # pre-write version with who-knows-which content.
+        assert "res" not in done
+        release.set()
+        t.join(5)
+        p.join(5)
+        slab, v = done["res"]
+        # The recorded version describes exactly the returned content:
+        # the mid-flight write is in BOTH the slab and the version.
+        assert v == (fr.uid, fr.version)
+        assert slab[1][0] & (1 << 5)
+
+    def test_live_versions_serialize_with_writer(self, holder):
+        import threading
+
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        f.set_bit(0, 1)
+        be = TPUBackend(holder)
+        fr = f.view("standard").fragment(0)
+        v_before = fr.version
+        t, release = self._mid_write(fr, 1, 5)
+        got = {}
+
+        def reader():
+            got["v"] = be._live_versions(f, (0,))
+
+        r = threading.Thread(target=reader, daemon=True)
+        r.start()
+        r.join(0.3)
+        assert "v" not in got  # parked on fr.lock, not reading mid-write
+        release.set()
+        t.join(5)
+        r.join(5)
+        assert got["v"][0] == (fr.uid, v_before + 1)
