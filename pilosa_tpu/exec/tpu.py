@@ -70,6 +70,11 @@ from pilosa_tpu.ops.kernels import (
     pair_stats,
     pair_stats_pershard,
 )
+from pilosa_tpu.ops.sparse import (
+    MIN_CHUNKED_WORDS,
+    ChunkedStackBuilder,
+    warm_chunk_programs,
+)
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
 from pilosa_tpu.shardwidth import SHARD_WIDTH
@@ -193,23 +198,49 @@ class _StackedBlocks:
                 # blowing HBM. Not cached (None entries are cheap to
                 # recompute and must not evict real stacks).
                 return None, rows_p, vers
-            host = np.zeros((s_pad, rows_p, WORDS_PER_SHARD), dtype=np.uint32)
-            for i, s in enumerate(shards):
-                fr = frags[s]
-                if fr is not None:
-                    host[i] = pack_fragment(fr, n_rows=rows_p)
-            arr = self._put(host)
+            shape = (s_pad, rows_p, WORDS_PER_SHARD)
+            if self.mesh is None and (nbytes // 4) >= MIN_CHUNKED_WORDS:
+                # Streaming packed upload (VERDICT r4 #1): shard slabs
+                # compress and ship as they pack, so the wire rides
+                # under the host pack instead of after it, and sparse
+                # stacks (time-quantum views, short fields) ship a
+                # fraction of their dense bytes. ops/sparse.py for the
+                # wire format and the fixed-shape program design.
+                builder = ChunkedStackBuilder(self.device, shape)
+                zero_slab = np.zeros(rows_p * WORDS_PER_SHARD, dtype=np.uint32)
+                for s in shards:
+                    fr = frags[s]
+                    if fr is not None:
+                        builder.feed(
+                            pack_fragment(fr, n_rows=rows_p).reshape(-1)
+                        )
+                    else:
+                        builder.feed(zero_slab)
+                for _ in range(s_pad - len(shards)):
+                    builder.feed(zero_slab)
+                arr = builder.finish()
+            else:
+                host = np.zeros(shape, dtype=np.uint32)
+                for i, s in enumerate(shards):
+                    fr = frags[s]
+                    if fr is not None:
+                        host[i] = pack_fragment(fr, n_rows=rows_p)
+                arr = self._put(host)
             if self.mesh is None and nbytes >= (64 << 20):
                 # Identity-splice warmup: compile the epoch-update scatter
                 # NOW, while the build already costs seconds — the first
                 # write of a serving window must not stall on XLA compile
-                # (it wedged a whole churn window before this).
+                # (it wedged a whole churn window before this). Zero
+                # payloads: only the SHAPES matter for the compile.
                 ix = np.minimum(
                     np.arange(self.UPDATE_CHUNK, dtype=np.int32), s_pad - 1
                 )
-                self._warm_update_fn(host.shape)(
+                slabs0 = np.zeros(
+                    (self.UPDATE_CHUNK, rows_p, WORDS_PER_SHARD), np.uint32
+                )
+                self._warm_update_fn(shape)(
                     arr,
-                    jax.device_put(host[ix], self.device),
+                    jax.device_put(slabs0, self.device),
                     jax.device_put(ix, self.device),
                 )
             return arr, rows_p, vers
@@ -742,6 +773,11 @@ class TPUBackend:
         # must also not log once per query.
         self._fallback_logged: set = set()
         self.logger = None
+        if self.mesh is None:
+            # Background-compile the fixed-shape sparse-upload programs
+            # so a cold stack build never pays their XLA compile on its
+            # critical path (ops/sparse.py; idempotent per device).
+            warm_chunk_programs(self.blocks.device)
 
     def _count_device_fallback(self, path: str, shape, err) -> None:
         """Count (and log once per shape) a device-fast-path fallback so
@@ -2163,6 +2199,21 @@ class TPUBackend:
                     for _, fo in fields
                 ),
             )
+        prewarm = None
+        if n >= 3:
+            # Compile the nary sweep CONCURRENTLY with the stack fetch:
+            # XLA compiles in C++ (GIL released), so the ~25 s compile
+            # rides under the host pack + upload of a cold stack instead
+            # of serializing after it (the r4 cold path paid them
+            # back-to-back). Joined before dispatch so the cache hit is
+            # guaranteed (two threads would otherwise both compile).
+            prewarm = threading.Thread(
+                target=lambda: self._nary_program(
+                    n - 2, filter_call is not None
+                ),
+                daemon=True, name="nary-prewarm",
+            )
+            prewarm.start()
         try:
             stacks = [self._get_block(index, fo, shards_t)[0] for _, fo in fields]
             filt = None
@@ -2170,7 +2221,9 @@ class TPUBackend:
                 spec, blocks, scalars = self._assemble(index, filter_call, shards_t)
                 filt = self._program("vec", spec, False)(blocks, scalars)
         except _Unsupported:
-            return None
+            return None  # prewarm daemon finishes in the background;
+            # fallback paths must not stall behind a compile they never
+            # dispatch (code review r5).
         if stacks[0].shape[0] > MAX_PAIR_SHARDS:
             return None  # int32 accumulator bound (ops/kernels.py)
         rs = [s.shape[1] for s in stacks]
@@ -2189,6 +2242,11 @@ class TPUBackend:
         if hit is None:
             with jax.profiler.TraceAnnotation("pilosa.group_by"):
                 if n >= 3:
+                    # Joined ONLY on the dispatch path: _groupn_stats
+                    # would otherwise race the prewarm into a duplicate
+                    # compile of the same program.
+                    if prewarm is not None:
+                        prewarm.join()
                     try:
                         stats_np = self._groupn_stats(stacks, filt)
                     except Exception as e:  # noqa: BLE001 — Mosaic VMEM/

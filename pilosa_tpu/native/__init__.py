@@ -84,6 +84,13 @@ def _load() -> ctypes.CDLL | None:
                     ctypes.c_void_p,
                     ctypes.c_void_p,
                 ]
+                lib.pilosa_compress_words.restype = ctypes.c_longlong
+                lib.pilosa_compress_words.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_size_t,
+                    ctypes.c_void_p,
+                    ctypes.c_void_p,
+                ]
                 _lib = lib
                 return _lib
             except Exception:
@@ -214,6 +221,23 @@ def intersection_count_many(a_list, b_list):
         lib.pilosa_intersection_count_many(
             a.ctypes.data, aoff.ctypes.data, b.ctypes.data, boff.ctypes.data,
             len(a_list),
+        )
+    )
+
+
+def compress_words(chunk, mask_out, vals_out):
+    """Zero-word compression of one uint32 word chunk (ops/sparse.py wire
+    format): writes the occupancy mask (bit b of mask_out[j] covers
+    chunk[j*32+b]) and packs nonzero words into vals_out. Returns nnz,
+    or None when the native lib is unavailable (caller uses its numpy
+    fallback). chunk size must be a multiple of 32."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(
+        lib.pilosa_compress_words(
+            chunk.ctypes.data, chunk.size, mask_out.ctypes.data,
+            vals_out.ctypes.data,
         )
     )
 

@@ -340,4 +340,27 @@ long long pilosa_import_containers(const uint64_t* rows, const uint64_t* cols,
     return (long long)nk;
 }
 
+// Zero-word compression for the sparse stack wire format
+// (ops/sparse.py): mask_out gets one occupancy bit per input word
+// (bit b of mask_out[j] covers in[j*32+b]), vals_out the nonzero words
+// in order. Returns nnz. n_words must be a multiple of 32 (callers pad
+// their chunk staging buffer). ~1 GB/s scalar; the numpy fallback's
+// reshape/reduce pipeline measured ~9 s/GB on this host.
+long long pilosa_compress_words(const uint32_t* in, size_t n_words,
+                                uint32_t* mask_out, uint32_t* vals_out) {
+    size_t nnz = 0;
+    for (size_t w = 0; w < n_words; w += 32) {
+        uint32_t m = 0;
+        for (int b = 0; b < 32; ++b) {
+            uint32_t v = in[w + b];
+            if (v) {
+                m |= (1u << b);
+                vals_out[nnz++] = v;
+            }
+        }
+        mask_out[w >> 5] = m;
+    }
+    return (long long)nnz;
+}
+
 }  // extern "C"

@@ -216,6 +216,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # RFC 7230 §3.2.4 requires 400, not a drop-or-normalize.
                 self.send_error(400, "Malformed header line")
                 return False
+            if any(c < " " and c != "\t" or c == "\x7f" for c in v):
+                # RFC 7230 §3.2 field-content excludes CTLs; proxies
+                # disagree on NUL/VT handling (reject vs truncate) — the
+                # same disagreement class as the name checks above.
+                self.send_error(400, "Control character in header value")
+                return False
             headers.add(k, v.strip())
         self.headers = headers
         if headers.conflicting_length:

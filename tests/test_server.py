@@ -501,6 +501,20 @@ class TestRequestParsing:
         out = self._raw(server, payload)
         assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
 
+    def test_ctl_in_header_value_rejected_400(self, server):
+        for bad in (b"a\x00b", b"a\x0bb", b"a\x7fb"):
+            payload = (
+                b"GET /status HTTP/1.1\r\nHost: x\r\nX-Meta: " + bad
+                + b"\r\n\r\n"
+            )
+            out = self._raw(server, payload)
+            assert b" 400 " in out.split(b"\r\n", 1)[0], (bad, out[:200])
+        # HTAB in a value is legal field-content
+        out = self._raw(
+            server, b"GET /status HTTP/1.1\r\nHost: x\r\nX-Meta: a\tb\r\n\r\n"
+        )
+        assert out.startswith(b"HTTP/1.1 200"), out[:200]
+
     def test_space_before_colon_rejected_400(self, server):
         # "Host : x" — RFC 7230 §3.2.4 explicitly requires 400 for
         # whitespace between field-name and colon (proxies disagree on

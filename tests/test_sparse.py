@@ -1,0 +1,187 @@
+"""Packed stack-upload wire format (ops/sparse.py, VERDICT r4 #1):
+host compress vs the numpy fallback, device decompress round-trips, the
+chunked streaming builder against a plain dense put, and the
+_StackedBlocks integration differential (sparse-built stacks must serve
+bit-identical query results)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.ops import sparse
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def small_chunks(monkeypatch):
+    """Shrink the chunk geometry so tests exercise multi-chunk stacks in
+    milliseconds. Program caches are keyed by CHUNK_WORDS, so shrunken
+    programs never collide with full-size ones. Compiles (blocking) the
+    small decompress programs so the builders' warm-gate passes and the
+    sparse path is actually exercised."""
+    monkeypatch.setattr(sparse, "CHUNK_WORDS", 1 << 12)
+    monkeypatch.setattr(
+        sparse, "BUCKETS",
+        ((1 << 12) // 32, (1 << 12) // 16, (1 << 12) // 8, (1 << 12) // 4),
+    )
+    monkeypatch.setattr(sparse, "MIN_CHUNKED_WORDS", 2 * (1 << 12))
+    for b in sparse.BUCKETS:
+        sparse._chunk_prog(None, b)
+
+
+class TestCompressChunk:
+    def _chunk(self, rng, density, n=1 << 12):
+        chunk = np.zeros(n, dtype=np.uint32)
+        nnz = int(n * density)
+        if nnz:
+            pos = rng.choice(n, size=nnz, replace=False)
+            chunk[pos] = rng.integers(1, 2**32, size=nnz, dtype=np.uint32)
+        return chunk
+
+    def test_native_matches_fallback(self, rng, small_chunks):
+        from pilosa_tpu import native
+
+        orig = native.compress_words
+        for density in (0.0, 0.01, 0.2, 0.9):
+            chunk = self._chunk(rng, density)
+            m1, v1, n1 = sparse.compress_chunk(chunk)
+            try:
+                native.compress_words = lambda *a: None
+                m2, v2, n2 = sparse.compress_chunk(chunk)
+            finally:
+                native.compress_words = orig
+            np.testing.assert_array_equal(m1, m2)
+            np.testing.assert_array_equal(v1[:n1], v2[:n2])
+            assert n1 == n2 == int((chunk != 0).sum())
+
+    def test_mask_bit_order(self, small_chunks):
+        chunk = np.zeros(1 << 12, dtype=np.uint32)
+        chunk[0] = 7       # word 0 -> bit 0 of mask[0]
+        chunk[33] = 9      # word 33 -> bit 1 of mask[1]
+        mask, vals, nnz = sparse.compress_chunk(chunk)
+        assert nnz == 2
+        assert mask[0] == 1 and mask[1] == 2
+        np.testing.assert_array_equal(vals[:2], [7, 9])
+
+    def test_device_roundtrip(self, rng, small_chunks):
+        dev = None
+        for density in (0.005, 0.1, 0.24):
+            chunk = self._chunk(rng, density)
+            mask, vals, nnz = sparse.compress_chunk(chunk)
+            bucket = sparse.pick_bucket(nnz)
+            assert bucket is not None
+            pv = np.zeros(bucket, dtype=np.uint32)
+            pv[:nnz] = vals[:nnz]
+            out = sparse._chunk_prog(dev, bucket)(
+                jax.device_put(mask, dev), jax.device_put(pv, dev)
+            )
+            np.testing.assert_array_equal(np.asarray(out), chunk)
+
+    def test_pick_bucket_menu(self, small_chunks):
+        c = sparse.CHUNK_WORDS
+        assert sparse.pick_bucket(0) == c // 32
+        assert sparse.pick_bucket(c // 32) == c // 32
+        assert sparse.pick_bucket(c // 32 + 1) == c // 16
+        assert sparse.pick_bucket(c // 4) == c // 4
+        assert sparse.pick_bucket(c // 4 + 1) is None  # dense fallback
+
+
+class TestChunkedStackBuilder:
+    def _roundtrip(self, host):
+        b = sparse.ChunkedStackBuilder(None, host.shape)
+        flat = host.reshape(-1)
+        # ragged feeds: the builder must handle arbitrary slab sizes
+        step = max(1, flat.size // 7)
+        for i in range(0, flat.size, step):
+            b.feed(flat[i : i + step])
+        out = b.finish()
+        assert out.shape == host.shape
+        np.testing.assert_array_equal(np.asarray(out), host)
+
+    def test_sparse_stack(self, rng, small_chunks):
+        host = np.zeros((4, 8, 512), dtype=np.uint32)
+        pos = rng.choice(host.size, size=host.size // 20, replace=False)
+        host.reshape(-1)[pos] = 1 + pos.astype(np.uint32)
+        b = sparse.ChunkedStackBuilder(None, host.shape)
+        b.feed(host.reshape(-1))
+        out = b.finish()
+        np.testing.assert_array_equal(np.asarray(out), host)
+        # The warm-gate was open (fixture compiled the programs), so the
+        # wire really was packed: mask + smallest-bucket values per
+        # chunk, well under the dense bytes.
+        assert 0 < b._wire_bytes < b._dense_bytes // 2
+
+    def test_dense_stack_falls_back_per_chunk(self, rng, small_chunks):
+        host = rng.integers(0, 2**32, size=(3, 8, 512), dtype=np.uint32)
+        self._roundtrip(host)
+
+    def test_all_zero_stack_ships_nothing(self, small_chunks):
+        host = np.zeros((4, 8, 512), dtype=np.uint32)
+        b = sparse.ChunkedStackBuilder(None, host.shape)
+        b.feed(host.reshape(-1))
+        out = b.finish()
+        assert b._wire_bytes == 0
+        np.testing.assert_array_equal(np.asarray(out), host)
+
+    def test_partial_tail_chunk(self, rng, small_chunks):
+        # 4*8*512 = 16384 words = 4 chunks exactly; (5, 8, 400) is not
+        # chunk-aligned -> exercises the padded tail.
+        host = np.zeros((5, 8, 400), dtype=np.uint32)
+        host[4, 7, 399] = 0xDEADBEEF
+        host[0, 0, 0] = 3
+        self._roundtrip(host)
+
+    def test_mixed_density_chunks(self, rng, small_chunks):
+        # one dense region, one sparse, one empty -> per-chunk decisions
+        host = np.zeros((6, 8, 512), dtype=np.uint32)
+        host[0] = rng.integers(0, 2**32, size=(8, 512), dtype=np.uint32)
+        host[3, 2, 17] = 42
+        self._roundtrip(host)
+
+
+class TestStackedBlocksSparseBuild:
+    def test_query_differential_through_chunked_build(self, rng, small_chunks,
+                                                      monkeypatch, tmp_path):
+        """A backend whose stacks went through the chunked sparse path
+        must answer bit-identically to the CPU oracle."""
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.exec import tpu as tpu_mod
+        from pilosa_tpu.exec.tpu import TPUBackend
+        from pilosa_tpu.utils.stats import global_stats
+
+        monkeypatch.setattr(tpu_mod, "MIN_CHUNKED_WORDS",
+                            sparse.MIN_CHUNKED_WORDS)
+        h = Holder(str(tmp_path / "d")).open()
+        try:
+            idx = h.create_index("i")
+            for fn in ("f", "g"):
+                f = idx.create_field(fn)
+                for s in range(3):
+                    cols = np.unique(
+                        rng.integers(0, SHARD_WIDTH, 2000, dtype=np.uint64)
+                    ) + s * SHARD_WIDTH
+                    f.import_bits(
+                        rng.integers(0, 4, cols.size, dtype=np.uint64), cols
+                    )
+            n0 = global_stats._counters.get(
+                ("stack_sparse_uploads_total", ()), 0
+            )
+            dev = Executor(h, backend=TPUBackend(h))
+            host = Executor(h)
+            queries = [
+                "Count(Row(f=1))",
+                "Count(Intersect(Row(f=0), Row(g=2)))",
+                "Count(Union(Row(f=3), Row(g=1)))",
+                "TopN(f, n=4)",
+                "GroupBy(Rows(f), Rows(g))",
+            ]
+            for q in queries:
+                assert dev.execute("i", q) == host.execute("i", q), q
+            # The stacks really went through the chunked path.
+            assert global_stats._counters.get(
+                ("stack_sparse_uploads_total", ()), 0
+            ) > n0
+        finally:
+            h.close()

@@ -1,0 +1,36 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax
+from pilosa_tpu.ops import sparse
+
+dev = None  # default device
+shape = (954, 8, 32768)
+n = int(np.prod(shape))
+rng = np.random.default_rng(0)
+flat = np.zeros(n, dtype=np.uint32)
+nnz = n // 6
+pos = rng.choice(n, size=nnz, replace=False)
+flat[pos] = rng.integers(1, 2**32, size=nnz, dtype=np.uint32)
+print("synthetic h-like stack: 1GB, nnz 16.7%", flush=True)
+
+t0 = time.time()
+ts = sparse.warm_chunk_programs(jax.devices()[0])
+ts.join()
+print(f"chunk program warm (4 buckets) {time.time()-t0:.1f}s", flush=True)
+
+b = sparse.ChunkedStackBuilder(None, shape)
+t0 = time.time()
+step = sparse.CHUNK_WORDS
+for i in range(0, n, step):
+    b.feed(flat[i:i+step])
+t_feed = time.time() - t0
+print(f"feed (compress+device_put) {t_feed:.1f}s", flush=True)
+t0 = time.time()
+out = b.finish()
+t_fin = time.time() - t0
+print(f"finish (decomp+place chain) {t_fin:.1f}s", flush=True)
+t0 = time.time()
+s = int(np.asarray(out[0, 0, :4]).sum())
+print(f"readback probe {time.time()-t0:.1f}s", flush=True)
+np.testing.assert_array_equal(np.asarray(out).reshape(-1)[:1<<20], flat[:1<<20])
+print("prefix bit-exact", flush=True)

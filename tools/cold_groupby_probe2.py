@@ -1,0 +1,39 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.tpu import TPUBackend
+from pilosa_tpu.pql import parse_string
+from pilosa_tpu.utils.stats import global_stats
+import bench
+
+t0 = time.time()
+h = Holder(None).open()
+bench.build_index(h)
+print(f"build {time.time()-t0:.1f}s", flush=True)
+be = TPUBackend(h)
+
+class L:
+    def printf(self, fmt, *a): print("LOG:", fmt % a, flush=True)
+be.logger = L()
+
+shards = list(range(bench.SHARDS))
+calls = [parse_string(f"Count(Intersect(Row(f={i%8}), Row(g={(i+1)%8})))").calls[0].children[0] for i in range(8)]
+t0 = time.time()
+be.count_batch("bench", calls, shards)
+print(f"f/g warm {time.time()-t0:.1f}s", flush=True)
+
+ex = Executor(h, backend=be)
+t0 = time.time()
+res = ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
+cold = time.time() - t0
+print(f"cold {cold:.2f}s  results {len(res)}", flush=True)
+print("fallbacks:", {k: v for k, v in global_stats._counters.items() if "fallback" in k[0]}, flush=True)
+w = global_stats._counters.get(("stack_sparse_wire_bytes_total", ()), 0)
+d = global_stats._counters.get(("stack_sparse_dense_bytes_total", ()), 0)
+print(f"sparse wire {int(w)>>20}MB of {int(d)>>20}MB", flush=True)
+be._agg_cache.clear()
+t0 = time.time()
+ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
+print(f"warm_ms {(time.time()-t0)*1e3:.0f}", flush=True)

@@ -1,0 +1,98 @@
+"""One-off experiment (VERDICT r4 #1): choose the device-side decompress
+strategy for packed stack uploads on the REAL chip.
+
+Candidates for rebuilding dense uint32[N] from packed nonzero words:
+  A. dense device_put (baseline — what r4 ships)
+  B. scatter: upload (positions i32[nnz], values u32[nnz]),
+     out = zeros.at[pos].set(vals, unique_indices)
+  C. mask+gather: upload (mask u32[N/32], values u32[nnz]),
+     bits = unpack(mask); out = where(bits, vals[cumsum_exclusive(bits)], 0)
+
+block_until_ready is NOT a trustworthy barrier over the axon relay (it
+returned 0.000s for 250M-element programs), so every timing here ends
+with a small device-reduction READBACK — int(sum(slice)) — which cannot
+complete before the producing computation has run.
+
+Run SOLO on the bench host (single real TPU via relay):
+    PYTHONPATH=/root/repo python tools/sparse_upload_exp.py
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N = 250_000_000  # ~1 GB of uint32 — the bench h-stack scale
+NNZ_FRAC = 0.17
+
+rng = np.random.default_rng(0)
+flat = np.zeros(N, dtype=np.uint32)
+nnz = int(N * NNZ_FRAC)
+pos = np.sort(rng.choice(N, size=nnz, replace=False)).astype(np.int32)
+flat[pos] = rng.integers(1, 2**32, size=nnz, dtype=np.uint32)
+vals = flat[pos]
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+
+_probe = jax.jit(lambda x: jnp.sum(x[:1024].astype(jnp.uint64)))
+
+
+def barrier(arrs):
+    """Real completion barrier: readback of a reduction over each array."""
+    tot = 0
+    for a in (arrs if isinstance(arrs, (tuple, list)) else (arrs,)):
+        tot += int(_probe(a.reshape(-1)))
+    return tot
+
+
+def timed(label, fn, n=4):
+    ts = []
+    out = None
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        barrier(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: med {sorted(ts)[len(ts)//2]:.3f}s "
+          f"(runs: {[round(t, 3) for t in ts]})", flush=True)
+    return out
+
+
+@jax.jit
+def scatter_build(p, v):
+    return jnp.zeros(N, jnp.uint32).at[p].set(v, unique_indices=True,
+                                              mode="promise_in_bounds")
+
+
+@jax.jit
+def mask_build(mw, v):
+    bits = ((mw[:, None] >> jnp.arange(32, dtype=jnp.uint32)[None, :]) & 1)
+    bits = bits.reshape(-1).astype(jnp.int32)
+    prefix = jnp.cumsum(bits) - bits  # exclusive
+    return jnp.where(bits != 0, v[prefix], 0).astype(jnp.uint32)
+
+
+mask_words = np.bitwise_or.reduce(
+    ((flat.reshape(-1, 32) != 0).astype(np.uint32)
+     << np.arange(32, dtype=np.uint32)[None, :]), axis=1)
+
+# warm everything once (compiles + first transfers) before any timing
+pos_d = (jax.device_put(pos, dev), jax.device_put(vals, dev))
+md = (jax.device_put(mask_words, dev), jax.device_put(vals, dev))
+barrier(scatter_build(*pos_d))
+barrier(mask_build(*md))
+print("warmup done", flush=True)
+
+a = timed("A dense upload 1000MB ", lambda: jax.device_put(flat, dev))
+pos_d = timed(f"B upload pos+vals {(pos.nbytes + vals.nbytes) >> 20}MB ",
+              lambda: (jax.device_put(pos, dev), jax.device_put(vals, dev)))
+b = timed("B scatter device      ", lambda: scatter_build(*pos_d))
+md = timed(f"C upload mask+vals {(mask_words.nbytes + vals.nbytes) >> 20}MB ",
+           lambda: (jax.device_put(mask_words, dev), jax.device_put(vals, dev)))
+c = timed("C mask+gather device  ", lambda: mask_build(*md))
+
+np.testing.assert_array_equal(np.asarray(b), flat)
+np.testing.assert_array_equal(np.asarray(c), flat)
+print("both decompressors bit-exact", flush=True)
