@@ -96,6 +96,15 @@ def build_index(h: Holder):
         rows = np.repeat(np.arange(4, dtype=np.uint64), n_bits // 4)
         cols = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64) + base
         field.import_bits(rows, cols)
+    # Small BSI field for the Min/Max churn-absorption leg (values in
+    # every shard so any write epoch has an incumbent to test against).
+    from pilosa_tpu.core.field import options_for_int
+
+    field = idx.create_field("v", options_for_int(-10000, 10000))
+    for shard in range(SHARDS):
+        base = shard * SHARD_WIDTH
+        cols = np.unique(rng.integers(0, SHARD_WIDTH, 50, dtype=np.uint64)) + base
+        field.import_value(cols, rng.integers(-9000, 9001, cols.size))
     return idx
 
 
@@ -331,12 +340,66 @@ def bench_group_by(holder, be) -> tuple[float, float]:
     cold = time.perf_counter() - t0
     assert res and len(res[0]) > 0
     # Warm = re-dispatch with resident stacks + compiled programs; drop
-    # the tensor cache so this measures the sweep, not a dict hit.
+    # the tensor caches (summed + maintained per-shard) so this measures
+    # the sweep, not a dict hit.
     be._agg_cache.clear()
+    be._groupn_cache.clear()
     t0 = time.perf_counter()
     ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
     warm = time.perf_counter() - t0
     return cold, warm
+
+
+def bench_minmax_churn(holder, be) -> tuple[float, float, float]:
+    """Min/Max churn absorption (VERDICT r4 #7): serve a Min/Max/Sum mix
+    while a writer issues point SetValues at ~100/s. The per-shard
+    extremum tables absorb each epoch on the host (O(1) monotone, one
+    fragment re-scan when an incumbent clears), so QPS under churn must
+    hold near the read-only rate. Returns (qps_read_only, qps_churn,
+    achieved write rate)."""
+    ex = Executor(holder, backend=be)
+    queries = ["Min(field=v)", "Max(field=v)", "Sum(field=v)"]
+    for q in queries:
+        ex.execute("bench", q)  # warm: table dispatch + program compile
+
+    def window(write_rate: float, seconds: float) -> tuple[float, float]:
+        stop = threading.Event()
+        wrote = [0]
+
+        def writer():
+            rng = np.random.default_rng(3)
+            period = 1.0 / write_rate
+            nxt = time.perf_counter()
+            while not stop.is_set():
+                now = time.perf_counter()
+                if now < nxt:
+                    time.sleep(min(period, nxt - now))
+                    continue
+                nxt += period
+                col = int(rng.integers(0, SHARDS)) * SHARD_WIDTH + int(
+                    rng.integers(0, SHARD_WIDTH)
+                )
+                ex.execute("bench", f"Set({col}, v={int(rng.integers(-9000, 9001))})")
+                wrote[0] += 1
+
+        wt = None
+        if write_rate > 0:
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            ex.execute("bench", queries[n % 3])
+            n += 1
+        dt = time.perf_counter() - t0
+        stop.set()
+        if wt is not None:
+            wt.join(timeout=5)
+        return n / dt, wrote[0] / dt
+
+    qps_ro, _ = window(0, 4.0)
+    qps_churn, wrate = window(100.0, CHURN_SECONDS)
+    return qps_ro, qps_churn, wrate
 
 
 def bench_cpu(holder, parsed_queries) -> float:
@@ -389,6 +452,7 @@ def main():
     # also absorbed a full f-stack rebuild (hundreds of dirtied shards)
     # and read as 3x worse than a real cold start.
     groupby_cold_s, groupby_warm_s = bench_group_by(h, be)
+    mm_ro, mm_churn, mm_wrate = bench_minmax_churn(h, be)
     qps_at_rate, achieved_rate, http_p50 = bench_http(h, be, queries)
     http_qps = qps_at_rate.get("0", next(iter(qps_at_rate.values())))
 
@@ -425,6 +489,12 @@ def main():
                 "topn_p50_ms": round(topn_p50 * 1e3, 2),
                 "groupby_3field_cold_s": round(groupby_cold_s, 2),
                 "groupby_3field_warm_ms": round(groupby_warm_s * 1e3, 1),
+                "minmax_qps_read_only": round(mm_ro, 1),
+                "minmax_qps_at_write_100": round(mm_churn, 1),
+                "minmax_churn_qps_ratio": round(mm_churn / mm_ro, 3)
+                if mm_ro
+                else None,
+                "minmax_write_rate_achieved": round(mm_wrate, 1),
                 "bytes_touched_per_query_logical": bytes_per_query,
                 "bytes_touched_per_query_physical": sweep_bytes // BATCH,
                 "build_seconds": round(t_build, 1),

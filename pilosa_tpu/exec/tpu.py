@@ -67,6 +67,7 @@ from pilosa_tpu.ops.blocks import (
 from pilosa_tpu.ops.kernels import (
     MAX_PAIR_SHARDS,
     nary_stats,
+    nary_stats_pershard,
     pair_stats,
     pair_stats_pershard,
 )
@@ -459,6 +460,23 @@ class _PairEntry:
         self.vers_g = vers_g
 
 
+class _GroupNEntry:
+    """One N>=3 field tuple's cached group tensor: totals int64[K,rf,rg]
+    served to queries, the per-shard int32[S, K*rf*rg] table that
+    absorbs write epochs, the per-field per-shard (uid, version) tuples
+    the table was derived from, and the row counts (padded stack
+    heights) fixing the tensor geometry."""
+
+    __slots__ = ("cfp", "stats", "pershard", "rs", "vers")
+
+    def __init__(self, cfp, stats, pershard, rs, vers):
+        self.cfp = cfp
+        self.stats = stats
+        self.pershard = pershard
+        self.rs = rs
+        self.vers = vers
+
+
 def _host_slab_pair_flat(fslab: np.ndarray, gslab: np.ndarray) -> np.ndarray:
     """One shard's pair-stats row [rf*rg + rf + rg] from host-packed
     slabs — must agree bit-for-bit with ops.kernels.pair_stats_pershard
@@ -484,6 +502,37 @@ def _host_slab_row_counts(slab: np.ndarray) -> np.ndarray:
     """Per-row popcounts of one packed shard slab (the TopN rank-vector
     contribution of that shard)."""
     return np.bitwise_count(slab).sum(axis=-1, dtype=np.int64)
+
+
+def _host_slab_groupn(slabs: list, rs: list) -> np.ndarray:
+    """One shard's N-field group tensor row, flat int32[K*rf*rg] — must
+    agree bit-for-bit with ops.kernels.nary_stats_pershard on the same
+    slabs (differentially tested in test_tpu.py) because a host-updated
+    table row sits next to device-swept rows. Same k decomposition as
+    the kernel: odometer over extras, LAST field fastest."""
+    rf, rg = rs[0], rs[1]
+    extra_rs = rs[2:]
+    k_total = 1
+    for rh in extra_rs:
+        k_total *= rh
+    fslab, gslab = slabs[0], slabs[1]
+    w = fslab.shape[1]
+    out = np.empty((k_total, rf, rg), dtype=np.int64)
+    chunk = max(1, (64 << 20) // max(1, rf * rg * 4))
+    for k in range(k_total):
+        m = None
+        rem = k
+        for t in range(len(extra_rs) - 1, -1, -1):
+            row = slabs[2 + t][rem % extra_rs[t]]
+            rem //= extra_rs[t]
+            m = row if m is None else (m & row)
+        fm = fslab & m[None, :]
+        pair = np.zeros((rf, rg), dtype=np.int64)
+        for c0 in range(0, w, chunk):
+            blk = fm[:, None, c0 : c0 + chunk] & gslab[None, :, c0 : c0 + chunk]
+            pair += np.bitwise_count(blk).sum(axis=-1, dtype=np.int64)
+        out[k] = pair
+    return out.reshape(-1).astype(np.int32)
 
 
 #: Recorded-version sentinel: never equal to any live (uid, version), so
@@ -751,6 +800,12 @@ class TPUBackend:
         # cached per (kind, index, field) against the BSI view's write
         # epoch — same invalidation discipline as the pair/TopN caches.
         self._agg_cache: dict = {}
+        # Maintained N>=3 group tensors (VERDICT r4 #1b): per-shard
+        # [S, K*Rf*Rg] tables + per-field versions, so a write epoch
+        # splices the affected shard rows on the host instead of
+        # re-dispatching the nary sweep — same two-tier (delta/slab)
+        # design as the pair table. _GroupNEntry values.
+        self._groupn_cache: dict = {}
         # Single-flight latches for stats refreshes (pair + TopN keys):
         # under write churn, 16 serving threads missing the same epoch
         # would each redo the same host update on this one-core host (a
@@ -2182,6 +2237,17 @@ class TPUBackend:
                 return self._group_enumerate(
                     fields, starts, child_rows, rs, stats_np, n
                 )
+        # Unfiltered N>=3: the maintained per-shard group tensor
+        # (VERDICT r4 #1b) — write epochs splice dirty shard rows on the
+        # host instead of re-dispatching the nary sweep. On a cold miss
+        # it AOT-compiles the sweep concurrently with the stack fetch.
+        if filter_call is None and n >= 3:
+            served = self._groupn_tensor(index, fields, shards_t)
+            if served is not None:
+                stats_np, rs = served
+                return self._group_enumerate(
+                    fields, starts, child_rows, rs, stats_np, n
+                )
         # Group-tensor cache (unfiltered): the stats do not depend on
         # candidate restrictions (limit/column/previous filter only the
         # host enumeration), so the write epoch of the child views keys
@@ -2199,21 +2265,6 @@ class TPUBackend:
                     for _, fo in fields
                 ),
             )
-        prewarm = None
-        if n >= 3:
-            # Compile the nary sweep CONCURRENTLY with the stack fetch:
-            # XLA compiles in C++ (GIL released), so the ~25 s compile
-            # rides under the host pack + upload of a cold stack instead
-            # of serializing after it (the r4 cold path paid them
-            # back-to-back). Joined before dispatch so the cache hit is
-            # guaranteed (two threads would otherwise both compile).
-            prewarm = threading.Thread(
-                target=lambda: self._nary_program(
-                    n - 2, filter_call is not None
-                ),
-                daemon=True, name="nary-prewarm",
-            )
-            prewarm.start()
         try:
             stacks = [self._get_block(index, fo, shards_t)[0] for _, fo in fields]
             filt = None
@@ -2242,11 +2293,6 @@ class TPUBackend:
         if hit is None:
             with jax.profiler.TraceAnnotation("pilosa.group_by"):
                 if n >= 3:
-                    # Joined ONLY on the dispatch path: _groupn_stats
-                    # would otherwise race the prewarm into a duplicate
-                    # compile of the same program.
-                    if prewarm is not None:
-                        prewarm.join()
                     try:
                         stats_np = self._groupn_stats(stacks, filt)
                     except Exception as e:  # noqa: BLE001 — Mosaic VMEM/
@@ -2319,6 +2365,335 @@ class TPUBackend:
             return None
         rf, rg = ent.rf, ent.rg
         return ent.stats[: rf * rg].reshape(rf, rg), rf, rg
+
+    #: Slab-tier budget for host groupN re-derives: words ANDed per
+    #: epoch (K*rf*rg*W per shard). Past this a device re-dispatch is
+    #: cheaper than the numpy sweep on this one-core host.
+    MAX_GROUPN_HOST_SLAB_WORDS = 1 << 29
+
+    def _groupn_predicted_shapes(self, fobjs, views, shards_t):
+        """The stack shapes a dispatch for these fields WILL use —
+        computable from fragment heights without packing anything, so
+        the sweep program can AOT-compile while the stacks build."""
+        s = len(shards_t)
+        shapes = []
+        for v in views:
+            n_rows = 1
+            if v is not None:
+                n_rows = max(
+                    [
+                        fr.max_row_id + 1
+                        for fr in (v.fragment(sh) for sh in shards_t)
+                        if fr is not None
+                    ]
+                    + [1]
+                )
+            shapes.append((s, _padded_rows(n_rows), WORDS_PER_SHARD))
+        return tuple(shapes)
+
+    def _groupn_tensor(self, index, fields, shards_t):
+        """(stats int64[K,rf,rg], rs) for an unfiltered N>=3 GroupBy from
+        the maintained per-shard table (VERDICT r4 #1b), or None when
+        this path can't serve (mesh, repeated field, bounds) and the
+        generic tensor path should run. Write epochs resolve on the
+        host: point writes delta-apply against probes of the other
+        fields, anything else re-derives just the dirty shards' rows —
+        no stack fetch, no device round trip, same two-tier design and
+        exactness discipline as the pair table."""
+        if self.mesh is not None:
+            return None
+        fobjs = [fo for _, fo in fields]
+        if len({id(f) for f in fobjs}) != len(fobjs):
+            return None  # repeated field: delta ordering is ambiguous
+        fnames = tuple(fn for fn, _ in fields)
+        ckey = ("groupn", index, fnames)
+        views = [f.view(VIEW_STANDARD) for f in fobjs]
+        while True:
+            gens = tuple(v.generation if v is not None else -1 for v in views)
+            cfp = (shards_t, gens)
+            with self._pair_lock:
+                hit = self._groupn_cache.get(ckey)
+                if hit is not None and hit.cfp == cfp:
+                    self.stats.count("groupn_cache_hits_total")
+                    return hit.stats, hit.rs
+                latch = self._stats_updating.get(ckey)
+                if latch is None:
+                    self._stats_updating[ckey] = threading.Event()
+                    break
+            latch.wait(timeout=60)
+        try:
+            # Fingerprint missed: a dispatch MAY be coming — start the
+            # sweep's AOT compile now (predicted shapes, background
+            # thread) so it overlaps the stack fetch on a cold path.
+            # Costs one cheap fragment-height walk; if the incremental
+            # tier absorbs the epoch the thread just warms the cache.
+            prewarm = None
+            shapes = self._groupn_predicted_shapes(fobjs, views, shards_t)
+            with self._fns_lock:
+                compiled = ("groupn_pershard", shapes) in self._fns
+            if not compiled:
+                prewarm = threading.Thread(
+                    target=lambda: self._groupn_pershard_program(shapes),
+                    daemon=True, name="groupn-prewarm",
+                )
+                prewarm.start()
+            live = [self._live_versions(f, shards_t) for f in fobjs]
+            upd = self._groupn_try_incremental(hit, fobjs, views, shards_t, live)
+            if upd is not None:
+                pershard, vers_rec, rs, totals = upd
+                if totals is None:
+                    k_total = pershard.shape[1] // (rs[0] * rs[1])
+                    totals = (
+                        pershard.sum(axis=0, dtype=np.int64)
+                        .reshape(k_total, rs[0], rs[1])
+                    )
+                ent = _GroupNEntry(cfp, totals, pershard, rs, vers_rec)
+                with self._pair_lock:
+                    self._groupn_cache[ckey] = ent
+                return totals, rs
+            return self._groupn_dispatch(
+                index, fobjs, shards_t, ckey, cfp, live, prewarm
+            )
+        finally:
+            with self._pair_lock:
+                ev = self._stats_updating.pop(ckey, None)
+            if ev is not None:
+                ev.set()
+
+    def _groupn_pershard_program(self, shapes: tuple):
+        """AOT-compiled per-shard nary sweep for exact stack shapes.
+        AOT (.lower().compile()), not lazy jit: the cold-path prewarm
+        thread must actually COMPILE concurrently with the stack fetch —
+        a lazy jit wrapper would defer the whole XLA compile to the
+        dispatch call it was meant to overlap (code review r5)."""
+        key = ("groupn_pershard", shapes)
+        with self._fns_lock:
+            fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        interpret = jax.default_backend() != "tpu"
+
+        def flat(fb, gb, *extras):
+            return nary_stats_pershard(fb, gb, extras, interpret=interpret)
+
+        fn = (
+            jax.jit(flat)
+            .lower(*[jax.ShapeDtypeStruct(s, jnp.uint32) for s in shapes])
+            .compile()
+        )
+        with self._fns_lock:
+            fn = self._fns.setdefault(key, fn)
+        return fn
+
+    def _groupn_dispatch(self, index, fobjs, shards_t, ckey, cfp, live,
+                         prewarm=None):
+        stacks = []
+        verss = []
+        try:
+            for i, f in enumerate(fobjs):
+                block, rp, vers = self.blocks.get_with_versions(
+                    index, f, shards_t
+                )
+                if block is None:
+                    return None  # over HBM budget: generic path decides
+                stacks.append(block)
+                verss.append(vers if vers is not None else live[i])
+        except _Unsupported:
+            return None
+        rs = [int(s.shape[1]) for s in stacks]
+        k_total = 1
+        for rh in rs[2:]:
+            k_total *= rh
+        d_stats = k_total * rs[0] * rs[1]
+        s_pad = stacks[0].shape[0]
+        if s_pad > MAX_PAIR_SHARDS or d_stats > (1 << 16):
+            return None
+        if s_pad * d_stats * 4 > self.MAX_PAIR_PERSHARD_BYTES:
+            return None  # table too big to retain: generic path sweeps
+        if prewarm is not None:
+            # Joined ONLY here, on the dispatch path: calling the
+            # program while the prewarm still compiles it would race
+            # into a duplicate compile.
+            prewarm.join()
+        try:
+            with jax.profiler.TraceAnnotation("pilosa.groupn"):
+                out = np.asarray(
+                    self._groupn_pershard_program(
+                        tuple(s.shape for s in stacks)
+                    )(*stacks)
+                )
+        except Exception as e:  # noqa: BLE001 — Mosaic/VMEM limits only
+            # real hardware hits; the generic path answers instead.
+            self._count_device_fallback("groupn_pershard", tuple(rs), e)
+            return None
+        # [K, S, rf, rg] -> [S_real, K*rf*rg], dropping all-zero padded
+        # shards so rows align with shards_t/versions.
+        pershard = np.ascontiguousarray(
+            out.transpose(1, 0, 2, 3).reshape(s_pad, d_stats)[: len(shards_t)]
+        )
+        totals = (
+            pershard.sum(axis=0, dtype=np.int64).reshape(k_total, rs[0], rs[1])
+        )
+        # The sweep read stack content packed at-or-after the recorded
+        # versions: stale out any shard that moved (see _confirm_vers).
+        vers_rec = tuple(
+            self._confirm_vers(f, shards_t, verss[i])
+            for i, f in enumerate(fobjs)
+        )
+        ent = _GroupNEntry(cfp, totals, pershard, rs, vers_rec)
+        with self._pair_lock:
+            self._groupn_cache[ckey] = ent
+            while len(self._groupn_cache) > MAX_PAIR_CACHE_ENTRIES:
+                self._groupn_cache.pop(next(iter(self._groupn_cache)))
+        return totals, rs
+
+    def _groupn_try_incremental(self, hit, fobjs, views, shards_t, live):
+        """Host-side epoch update of the per-shard group tensor table.
+        Returns (pershard int32[S, D], per-field recorded versions, rs,
+        totals-or-None — the cached totals when nothing in the queried
+        shard set actually changed) or None when a dispatch is needed.
+        Exactness discipline: delta
+        shards record the walk versions their op windows end at (probes
+        of the other fields confirm pre AND post under the fragment
+        lock); slab shards are _pack_confirmed; anything ambiguous
+        re-dispatches."""
+        n = len(fobjs)
+        if (
+            hit is None
+            or hit.pershard is None
+            or hit.cfp[0] != shards_t
+        ):
+            return None
+        rs = hit.rs
+        rf, rg = rs[0], rs[1]
+        k_total = 1
+        for rh in rs[2:]:
+            k_total *= rh
+        dirty = [
+            i for i in range(len(shards_t))
+            if any(hit.vers[t][i] != live[t][i] for t in range(n))
+        ]
+        if not dirty:
+            # Writes outside the queried shard set bumped a generation:
+            # counts unchanged — re-key with the CACHED totals instead
+            # of re-summing the whole table per query (code review r5).
+            return hit.pershard, tuple(live), rs, hit.stats
+        pershard = hit.pershard.copy()
+        vers_rec = [list(lv) for lv in live]
+        slab_dirty: list[int] = []
+        n_delta_ops = 0
+        for i in dirty:
+            ops_applied = self._groupn_shard_delta(
+                hit, i, shards_t[i], fobjs, views, live, pershard, rs, k_total
+            )
+            if ops_applied is None:
+                slab_dirty.append(i)
+            else:
+                n_delta_ops += ops_applied
+        if len(slab_dirty) > self.MAX_PAIR_HOST_UPDATE_SHARDS:
+            return None
+        slab_cost = len(slab_dirty) * k_total * rf * rg * WORDS_PER_SHARD
+        if slab_cost > self.MAX_GROUPN_HOST_SLAB_WORDS:
+            return None
+        for i in slab_dirty:
+            slabs = []
+            for t, f in enumerate(fobjs):
+                fr = views[t].fragment(shards_t[i]) if views[t] is not None else None
+                if fr is None:
+                    slabs.append(
+                        np.zeros((rs[t], WORDS_PER_SHARD), dtype=np.uint32)
+                    )
+                    vers_rec[t][i] = None
+                else:
+                    slab, vers_rec[t][i] = _pack_confirmed(fr, rs[t])
+                    if fr.max_row_id >= rs[t]:
+                        return None  # row grew past the tensor: re-dispatch
+                    slabs.append(slab[: rs[t]])
+            pershard[i] = _host_slab_groupn(slabs, rs)
+        self.stats.count("groupn_incremental_updates_total")
+        self.stats.count("groupn_incremental_shards_total", len(dirty))
+        if n_delta_ops:
+            self.stats.count("groupn_delta_ops_total", n_delta_ops)
+        return pershard, tuple(tuple(v) for v in vers_rec), rs, None
+
+    def _groupn_shard_delta(self, hit, i, shard, fobjs, views, live,
+                            pershard, rs, k_total):
+        """Apply one dirty shard's epoch as exact point-write deltas to
+        pershard[i], or None for the slab tier: more than one field
+        changed (probe ordering against changing peers is ambiguous),
+        no delta history, row growth, or a probe-version conflict."""
+        n = len(fobjs)
+        changed = [
+            t for t in range(n) if hit.vers[t][i] != live[t][i]
+        ]
+        if len(changed) != 1:
+            return None
+        t = changed[0]
+        ov, nv = hit.vers[t][i], live[t][i]
+        frag = views[t].fragment(shard) if views[t] is not None else None
+        if frag is None or ov is None or nv is None or ov[0] != nv[0]:
+            return None
+        ops = frag.bit_ops_between(ov[1], nv[1])
+        if ops is None:
+            return None
+        # The probes below read the OTHER fields' live storage, recorded
+        # at their walk versions (live[u][i]): confirm each matches
+        # before AND after (under its lock — a mid-write bump must be
+        # seen; see _pack_confirmed). On any conflict, revert the row.
+        others = []
+        for u in range(n):
+            if u == t:
+                continue
+            fru = views[u].fragment(shard) if views[u] is not None else None
+            if fru is None:
+                if live[u][i] is not None:
+                    return None  # vanished since the walk
+            else:
+                with fru.lock:
+                    moved = live[u][i] is None or \
+                        (fru.uid, fru.version) != live[u][i]
+                if moved:
+                    return None
+            others.append((u, fru))
+        import itertools
+
+        sw = SHARD_WIDTH
+        row_flat = pershard[i]
+        extra_rs = rs[2:]
+        for _, r, c, sign in ops:
+            if r >= rs[t]:
+                row_flat[:] = hit.pershard[i]
+                return None  # tensor height exceeded mid-window
+            row_sets = [None] * n
+            row_sets[t] = (r,)
+            empty = False
+            for u, fru in others:
+                if fru is None:
+                    empty = True
+                    break
+                st = fru.storage
+                rows_u = tuple(
+                    b for b in range(rs[u]) if st.contains(b * sw + c)
+                )
+                if not rows_u:
+                    empty = True
+                    break
+                row_sets[u] = rows_u
+            if empty:
+                continue  # some field has no bit at c: no cell changes
+            for combo in itertools.product(*row_sets):
+                k = 0
+                for tt in range(2, n):
+                    k = k * extra_rs[tt - 2] + combo[tt]
+                row_flat[(k * rs[0] + combo[0]) * rs[1] + combo[1]] += sign
+        for u, fru in others:
+            if fru is not None:
+                with fru.lock:
+                    moved = (fru.uid, fru.version) != live[u][i]
+                if moved:
+                    row_flat[:] = hit.pershard[i]
+                    return None
+        return len(ops)
 
     def _group_enumerate(self, fields, starts, child_rows, rs, stats_np, n):
         """Candidate enumeration over the group stats (tensor or table),
@@ -2875,9 +3250,10 @@ class TPUBackend:
         return (cfp, None)
 
     def _agg_store(self, kind, index, field_name, cfp, result, extra=None):
-        """extra: Sum's (raw_total, count, per-shard versions) for the
-        value-delta tier; None for Min/Max (not delta-maintainable —
-        removing the extremum needs a re-scan)."""
+        """extra: the kind's churn-absorption state — Sum's (raw_total,
+        count, per-shard versions) for the value-delta tier, Min/Max's
+        (per-shard (val, cnt) table, per-shard versions) for the
+        monotone-delta/re-derive tiers. None when unavailable."""
         with self._pair_lock:
             self._agg_cache[(kind, index, field_name)] = (cfp, result, extra)
             while len(self._agg_cache) > MAX_PAIR_CACHE_ENTRIES:
@@ -2892,11 +3268,34 @@ class TPUBackend:
     def _bsi_minmax(self, kind, index, field_name, shards, filter_call):
         """Per-shard Min/Max via plane narrowing with on-device selects (no
         host sync inside the scan), host reduce across shards with the
-        executor's tie semantics. Returns (val, count) or None."""
+        executor's tie semantics. Returns (val, count) or None.
+
+        Unfiltered Min/Max absorb churn on the host (VERDICT r4 #7):
+        the per-shard (val, cnt) extremum table updates in O(1) for
+        monotone value writes (a write that doesn't beat or clear the
+        incumbent changes nothing; a better value replaces it), and
+        only a shard whose incumbent was cleared re-derives — via the
+        fragment's own host plane-narrowing (Fragment.min/max), no
+        device dispatch at all. The reference recomputes per query
+        (fragment.go:1147-1191)."""
         # Fingerprint BEFORE the data snapshot (see bsi_sum).
         hit = self._agg_lookup(kind, index, field_name, shards, filter_call)
         if hit is not None and hit[1] is not None:
             return hit[1]
+        if hit is not None:
+            upd = self._minmax_try_incremental(
+                kind, index, field_name, shards, hit[0]
+            )
+            if upd is not None:
+                return upd
+        pre_vers = None
+        if hit is not None:
+            idx0 = self.holder.index(index)
+            f0 = idx0.field(field_name) if idx0 else None
+            if f0 is not None:
+                pre_vers = self._live_versions(
+                    f0, tuple(shards), bsi_view_name(field_name)
+                )
         try:
             f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
                 index, field_name, shards, filter_call
@@ -2920,9 +3319,10 @@ class TPUBackend:
         def assemble_min(bits) -> int:  # minUnsigned: bit set when plane forced 1
             return sum(1 << i for i in range(depth) if bits[i])
 
-        best_val, best_cnt = 0, 0
+        pershard: list[tuple[int, int]] = []
         for s in range(len(shards)):
             if not consider_any[s]:
+                pershard.append((0, 0))
                 continue
             if kind == "bsi_min":
                 if branch_any[s]:  # negatives exist: min = -maxUnsigned(neg)
@@ -2934,7 +3334,25 @@ class TPUBackend:
                     val, cnt = assemble_max(bits_a[s]), int(cnt_a[s])
                 else:  # all negative: max = -minUnsigned(consider)
                     val, cnt = -assemble_min(bits_b[s]), int(cnt_b[s])
-            val += opts.base
+            pershard.append((val + opts.base, cnt) if cnt else (0, 0))
+        result = self._minmax_reduce(kind, pershard)
+        if hit is not None:
+            extra = None
+            if pre_vers is not None:
+                vers = self._confirm_vers(
+                    f, tuple(shards), pre_vers, bsi_view_name(field_name)
+                )
+                extra = (tuple(pershard), vers)
+            self._agg_store(kind, index, field_name, hit[0], result, extra)
+        return result
+
+    @staticmethod
+    def _minmax_reduce(kind, pershard) -> tuple[int, int]:
+        """Cross-shard reduce with the executor's tie semantics (equal
+        extrema accumulate counts) — shared by the dispatch and the
+        incremental tier so they cannot drift."""
+        best_val, best_cnt = 0, 0
+        for val, cnt in pershard:
             if cnt == 0:
                 continue
             if best_cnt == 0:
@@ -2945,6 +3363,98 @@ class TPUBackend:
                 best_val, best_cnt = val, cnt
             elif val == best_val:
                 best_cnt += cnt
-        if hit is not None:
-            self._agg_store(kind, index, field_name, hit[0], (best_val, best_cnt))
         return best_val, best_cnt
+
+    def _minmax_try_incremental(self, kind, index, field_name, shards,
+                                cfp_now):
+        """Apply a value-write epoch to the cached per-shard extremum
+        table: O(1) monotone updates; a shard whose incumbent was
+        cleared (or whose op window isn't ring-covered) re-derives via
+        the fragment's HOST plane narrowing under its lock — exact, no
+        device work. Returns the fresh (val, count) (already re-cached)
+        or None when the whole entry must re-dispatch."""
+        shards_t = tuple(shards)
+        with self._pair_lock:
+            ent = self._agg_cache.get((kind, index, field_name))
+        if ent is None or len(ent) < 3 or ent[2] is None:
+            return None
+        pershard_old, vers_old = ent[2]
+        if ent[0][0] != shards_t:
+            return None
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx else None
+        if f is None or f.options.type != FIELD_TYPE_INT:
+            return None
+        bg = f.bsi_group()
+        base, depth = bg.base, bg.bit_depth
+        vn = bsi_view_name(field_name)
+        v = f.view(vn)
+        vers_new = self._live_versions(f, shards_t, vn)
+        better = (
+            (lambda a, b: a < b) if kind == "bsi_min" else (lambda a, b: a > b)
+        )
+        pershard = list(pershard_old)
+        vers_rec = list(vers_new)
+        n_rederived = 0
+        for i, s in enumerate(shards_t):
+            ov, nv = vers_old[i], vers_new[i]
+            if ov == nv:
+                vers_rec[i] = ov
+                continue
+            fr = v.fragment(s) if v is not None else None
+            if fr is None:
+                pershard[i] = (0, 0)
+                vers_rec[i] = None
+                continue
+            ops = None
+            if ov is not None and nv is not None and ov[0] == nv[0]:
+                ops = fr.value_ops_between(ov[1], nv[1])
+            rederive = ops is None
+            if not rederive:
+                val, cnt = pershard[i]
+                for _, ook, ovv, nok, nvv in ops:
+                    if ook:
+                        o = ovv + base
+                        if cnt <= 0 or better(o, val):
+                            rederive = True  # table inconsistent: rescan
+                            break
+                        if o == val:
+                            cnt -= 1
+                            if cnt == 0:
+                                # Incumbent cleared: the next extremum
+                                # is unknowable from deltas.
+                                rederive = True
+                                break
+                    if nok:
+                        nn = nvv + base
+                        if cnt <= 0:
+                            val, cnt = nn, 1
+                        elif nn == val:
+                            cnt += 1
+                        elif better(nn, val):
+                            val, cnt = nn, 1
+                if not rederive:
+                    pershard[i] = (val, cnt)
+            if rederive:
+                # Version captured under the SAME lock as the scan so it
+                # describes exactly the scanned content (fr.min/max take
+                # fr.lock; RLock makes this atomic).
+                with fr.lock:
+                    vv = (fr.uid, fr.version)
+                    raw = (
+                        fr.min(None, depth)
+                        if kind == "bsi_min"
+                        else fr.max(None, depth)
+                    )
+                pershard[i] = (raw[0] + base, raw[1]) if raw[1] else (0, 0)
+                vers_rec[i] = vv
+                n_rederived += 1
+        result = self._minmax_reduce(kind, pershard)
+        self._agg_store(
+            kind, index, field_name, cfp_now, result,
+            (tuple(pershard), tuple(vers_rec)),
+        )
+        self.stats.count("minmax_incremental_updates_total")
+        if n_rederived:
+            self.stats.count("minmax_shard_rederives_total", n_rederived)
+        return result

@@ -312,6 +312,89 @@ def nary_stats(f_stack, g_stack, extras, filt=None, interpret: bool = False):
     )(*operands)
 
 
+def _make_nary_pershard_kernel(n_extra: int, extra_rows: tuple):
+    """nary kernel without the shard reduction: the [1, 1, rf, rg]
+    output block is indexed by (k, shard) and accumulates only over
+    word tiles. Unfiltered by design — the per-shard table exists to
+    absorb write churn for the UNFILTERED group tensor (a filter
+    changes per query, so its sweeps are not maintainable)."""
+
+    def kernel(f_ref, g_ref, *rest):
+        h_refs = rest[:n_extra]
+        pair_ref = rest[-1]
+        w = pl.program_id(2)
+
+        @pl.when(w == 0)
+        def _():
+            pair_ref[...] = jnp.zeros_like(pair_ref)
+
+        m = None
+        rem = pl.program_id(0)
+        for t in range(n_extra - 1, -1, -1):
+            rh = extra_rows[t]
+            row = h_refs[t][0, rem % rh]  # [WT]
+            rem = rem // rh
+            m = row if m is None else (m & row)
+        f = f_ref[0] & m[None, :]
+        g = g_ref[0]
+        pc = jax.lax.population_count(
+            f[:, None, :] & g[None, :, :]
+        ).astype(jnp.int32)
+        pair_ref[0, 0] += jnp.sum(pc, axis=-1)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def nary_stats_pershard(f_stack, g_stack, extras, interpret: bool = False):
+    """nary_stats WITHOUT the shard reduction:
+    -> int32[K, S, Rf, Rg] (k odometer over extras, last fastest).
+
+    The per-shard group tensor is what lets N>=3 GroupBy absorb write
+    churn on the host (exec/tpu.py _groupn_try_incremental): totals are
+    its int64 sum over shards, and a write epoch that dirtied D shards
+    replaces D rows instead of re-sweeping the stacks — the same design
+    as pair_stats_pershard for the 2-field case."""
+    s, rf, w = f_stack.shape
+    rg = g_stack.shape[1]
+    extra_rows = tuple(h.shape[1] for h in extras)
+    k_total = 1
+    for rh in extra_rows:
+        k_total *= rh
+    wt = w
+    while (rf * rg + sum(extra_rows)) * wt * 4 > _VMEM_TILE_BYTES and wt % 2 == 0:
+        wt //= 2
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        params = pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.ARBITRARY,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            )
+        )
+    except (ImportError, AttributeError):  # pragma: no cover
+        params = None
+    in_specs = [
+        pl.BlockSpec((1, rf, wt), lambda k, i, j: (i, 0, j)),
+        pl.BlockSpec((1, rg, wt), lambda k, i, j: (i, 0, j)),
+    ] + [
+        pl.BlockSpec((1, rh, wt), lambda k, i, j: (i, 0, j))
+        for rh in extra_rows
+    ]
+    kernel = _make_nary_pershard_kernel(len(extras), extra_rows)
+    return pl.pallas_call(
+        kernel,
+        grid=(k_total, s, w // wt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rf, rg), lambda k, i, j: (k, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k_total, s, rf, rg), jnp.int32),
+        compiler_params=params,
+        interpret=interpret,
+    )(f_stack, g_stack, *extras)
+
+
 def pair_stats_xla(f_stack, g_stack):
     """Fused-XLA reference formulation of pair_stats (same results; used
     as the differential oracle for the Pallas kernel and as the fallback

@@ -202,29 +202,39 @@ def _final_prog(device, n_pad: int, shape: tuple):
 
 
 _warmed: set = set()
+_warm_inflight: set = set()
 
 
 def warm_chunk_programs(device) -> threading.Thread:
     """Background-compile the fixed-shape chunk programs so a cold stack
     build never pays their XLA compile on its critical path (the
     placement/zeros/final programs are per-stack-shape and compile in
-    ~1 s; the chunk programs are the expensive ones). Idempotent."""
+    ~1 s; the chunk programs are the expensive ones). Idempotent while
+    a warm is in flight or succeeded; a FAILED warm retries on the next
+    call — latching the failure would silently pin the dense path for
+    the process lifetime (code review r5)."""
     key = _dev_key(device)
 
     def run():
         try:
             for b in BUCKETS:
                 _chunk_prog(device, b)
-        except Exception:  # noqa: BLE001 — warm is best-effort; a failed
-            # compile resurfaces (with its real error) on first use.
-            pass
+            with _progs_lock:
+                _warmed.add(key)
+        except Exception:  # noqa: BLE001 — best-effort: the builder's
+            # warm-gate keeps shipping dense chunks; counted so the
+            # silent-dense regression is visible on /metrics.
+            global_stats.count("stack_sparse_warm_failures_total")
+        finally:
+            with _progs_lock:
+                _warm_inflight.discard(key)
 
     with _progs_lock:
-        if key in _warmed:
+        if key in _warmed or key in _warm_inflight:
             t = threading.Thread(target=lambda: None)
             t.start()  # joinable no-op: callers may t.join() the result
             return t
-        _warmed.add(key)
+        _warm_inflight.add(key)
     t = threading.Thread(target=run, daemon=True, name="sparse-warm")
     t.start()
     return t

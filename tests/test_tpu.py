@@ -1285,3 +1285,257 @@ class TestVersionCaptureRace:
         t.join(5)
         r.join(5)
         assert got["v"][0] == (fr.uid, v_before + 1)
+
+
+class TestGroupNMaintainedTensor:
+    """VERDICT r4 #1b: unfiltered N>=3 GroupBy must absorb write churn
+    through the maintained per-shard tensor (host delta/slab tiers), not
+    re-dispatch the nary sweep every epoch — and stay exact vs the
+    oracle through every tier."""
+
+    def _build(self, holder, rng, n_shards=4):
+        idx = holder.create_index("i")
+        for fn, nrows in (("f", 4), ("g", 4), ("h", 3)):
+            f = idx.create_field(fn)
+            for s in range(n_shards):
+                cols = np.unique(
+                    rng.integers(0, SHARD_WIDTH, 2500, dtype=np.uint64)
+                ) + s * SHARD_WIDTH
+                f.import_bits(
+                    rng.integers(0, nrows, cols.size, dtype=np.uint64), cols
+                )
+        return idx
+
+    def _updates(self):
+        from pilosa_tpu.utils.stats import global_stats
+
+        return global_stats._counters.get(
+            ("groupn_incremental_updates_total", ()), 0
+        )
+
+    Q = "GroupBy(Rows(f), Rows(g), Rows(h))"
+
+    def test_host_slab_matches_pershard_kernel(self, rng):
+        from pilosa_tpu.exec.tpu import _host_slab_groupn
+        from pilosa_tpu.ops.kernels import nary_stats_pershard
+
+        rf, rg, rh, w = 8, 8, 4, 512
+        fs = rng.integers(0, 2**32, (2, rf, w), dtype=np.uint32)
+        gs = rng.integers(0, 2**32, (2, rg, w), dtype=np.uint32)
+        hs = rng.integers(0, 2**32, (2, rh, w), dtype=np.uint32)
+        per = np.asarray(
+            nary_stats_pershard(fs, gs, (hs,), interpret=True)
+        )  # [K, S, rf, rg]
+        for s in range(2):
+            host = _host_slab_groupn([fs[s], gs[s], hs[s]], [rf, rg, rh])
+            np.testing.assert_array_equal(
+                host, per[:, s].reshape(-1).astype(np.int32)
+            )
+
+    def test_point_write_delta_tier(self, holder, rng):
+        idx = self._build(holder, rng)
+        be = TPUBackend(holder)
+        dev = Executor(holder, backend=be)
+        host = Executor(holder)
+        assert dev.execute("i", self.Q) == host.execute("i", self.Q)
+        n0 = self._updates()
+        # Point writes on each field in turn: every epoch must resolve
+        # through the incremental tier, exactly.
+        for j, fn in enumerate(("f", "g", "h", "f")):
+            idx.field(fn).set_bit(j % 3, (j % 4) * SHARD_WIDTH + 12345 + j)
+            assert dev.execute("i", self.Q) == host.execute("i", self.Q), fn
+        assert self._updates() == n0 + 4
+        # Clears too (negative deltas).
+        idx.field("f").clear_bit(0, 12345)
+        assert dev.execute("i", self.Q) == host.execute("i", self.Q)
+        assert self._updates() == n0 + 5
+
+    def test_bulk_write_slab_tier(self, holder, rng):
+        idx = self._build(holder, rng)
+        be = TPUBackend(holder)
+        dev = Executor(holder, backend=be)
+        host = Executor(holder)
+        dev.execute("i", self.Q)
+        n0 = self._updates()
+        # Bulk import into one shard: the op ring can't explain it ->
+        # that shard's row re-derives from _pack_confirmed slabs.
+        cols = np.unique(
+            rng.integers(0, SHARD_WIDTH, 3000, dtype=np.uint64)
+        ) + 2 * SHARD_WIDTH
+        idx.field("g").import_bits(
+            rng.integers(0, 4, cols.size, dtype=np.uint64), cols
+        )
+        assert dev.execute("i", self.Q) == host.execute("i", self.Q)
+        assert self._updates() == n0 + 1
+
+    def test_row_growth_redispatches(self, holder, rng):
+        idx = self._build(holder, rng)
+        be = TPUBackend(holder)
+        dev = Executor(holder, backend=be)
+        host = Executor(holder)
+        dev.execute("i", self.Q)
+        # New max row on h changes the tensor K axis: must re-dispatch
+        # (stack heights are padded to 8, so grow past the pad).
+        idx.field("h").set_bit(9, SHARD_WIDTH + 7)
+        assert dev.execute("i", self.Q) == host.execute("i", self.Q)
+
+    def test_mixed_churn_stays_exact(self, holder, rng):
+        idx = self._build(holder, rng)
+        be = TPUBackend(holder)
+        dev = Executor(holder, backend=be)
+        host = Executor(holder)
+        dev.execute("i", self.Q)
+        w = np.random.default_rng(5)
+        for step in range(12):
+            fn = ("f", "g", "h")[step % 3]
+            if step % 5 == 4:
+                cols = np.unique(
+                    w.integers(0, SHARD_WIDTH, 500, dtype=np.uint64)
+                ) + int(w.integers(0, 4)) * SHARD_WIDTH
+                idx.field(fn).import_bits(
+                    w.integers(0, 3, cols.size, dtype=np.uint64), cols
+                )
+            else:
+                idx.field(fn).set_bit(
+                    int(w.integers(0, 3)),
+                    int(w.integers(0, 4 * SHARD_WIDTH)),
+                )
+            assert dev.execute("i", self.Q) == host.execute("i", self.Q), step
+
+    def test_four_fields(self, holder, rng):
+        idx = self._build(holder, rng)
+        f = idx.create_field("e")
+        for s in range(4):
+            cols = np.unique(
+                rng.integers(0, SHARD_WIDTH, 1500, dtype=np.uint64)
+            ) + s * SHARD_WIDTH
+            f.import_bits(np.zeros(cols.size, dtype=np.uint64) + rng.integers(0, 2), cols)
+        be = TPUBackend(holder)
+        dev = Executor(holder, backend=be)
+        host = Executor(holder)
+        q = "GroupBy(Rows(f), Rows(g), Rows(h), Rows(e))"
+        assert dev.execute("i", q) == host.execute("i", q)
+        idx.field("e").set_bit(1, 3 * SHARD_WIDTH + 99)
+        assert dev.execute("i", q) == host.execute("i", q)
+
+
+class TestMinMaxChurnAbsorption:
+    """VERDICT r4 #7: Min/Max must absorb point-value churn through the
+    per-shard extremum table — O(1) for monotone writes, host re-derive
+    (no device dispatch) only for shards whose incumbent was cleared —
+    and stay exact vs the oracle through every tier."""
+
+    def _build(self, holder, rng, shards=3):
+        idx = holder.create_index("i")
+        idx.create_field("v", options_for_int(-1000, 1000))
+        cols = np.unique(
+            rng.integers(0, shards * SHARD_WIDTH, 600, dtype=np.uint64)
+        )
+        idx.field("v").import_value(cols, rng.integers(-900, 901, cols.size))
+        return idx, cols
+
+    def _upd(self, name):
+        from pilosa_tpu.utils.stats import global_stats
+
+        return global_stats._counters.get((name, ()), 0)
+
+    def _check(self, holder, be, shards):
+        ex = Executor(holder)
+        for kind, q in (("min", "Min(field=v)"), ("max", "Max(field=v)")):
+            want = ex.execute("i", q)[0]
+            got = getattr(be, f"bsi_{kind}")("i", "v", shards)
+            assert got == (want.val, want.count), (kind, got, want)
+
+    def test_monotone_writes_are_o1(self, holder, rng):
+        idx, cols = self._build(holder, rng)
+        shards = [0, 1, 2]
+        be = TPUBackend(holder)
+        self._check(holder, be, shards)
+        n0 = self._upd("minmax_incremental_updates_total")
+        r0 = self._upd("minmax_shard_rederives_total")
+        # A middling value: beats neither extremum -> pure O(1) update.
+        free = int(cols.max()) + 10
+        idx.field("v").set_value(free, 5)
+        self._check(holder, be, shards)
+        assert self._upd("minmax_incremental_updates_total") == n0 + 2
+        assert self._upd("minmax_shard_rederives_total") == r0
+        # New global min and max: still O(1) (better value replaces).
+        idx.field("v").set_value(free + 1, -999)
+        idx.field("v").set_value(free + 2, 999)
+        self._check(holder, be, shards)
+        assert self._upd("minmax_shard_rederives_total") == r0
+
+    def test_cleared_incumbent_rederives_one_shard(self, holder, rng):
+        idx, cols = self._build(holder, rng)
+        shards = [0, 1, 2]
+        be = TPUBackend(holder)
+        # Plant a unique global minimum, warm the table.
+        free = int(cols.max()) + 10
+        idx.field("v").set_value(free, -999)
+        self._check(holder, be, shards)
+        r0 = self._upd("minmax_shard_rederives_total")
+        # Overwrite the incumbent minimum with a middling value: its
+        # shard's extremum is cleared -> exactly that shard re-derives
+        # on the host.
+        idx.field("v").set_value(free, 17)
+        self._check(holder, be, shards)
+        assert self._upd("minmax_shard_rederives_total") == r0 + 1
+        # Max table for the same epoch should NOT have re-derived
+        # (the old -999 and new 17 both lose to the max incumbent)...
+        # already covered by the +1 (min) instead of +2.
+
+    @staticmethod
+    def _clear(f, col):
+        f._bsi_fragment(col // SHARD_WIDTH).clear_value(
+            col, f.bsi_group().bit_depth
+        )
+
+    def test_clear_value_and_ties(self, holder, rng):
+        idx = holder.create_index("i")
+        idx.create_field("v", options_for_int(-100, 100))
+        f = idx.field("v")
+        # Tie: two columns in different shards share the minimum.
+        f.set_value(5, -50)
+        f.set_value(SHARD_WIDTH + 7, -50)
+        f.set_value(20, 30)
+        be = TPUBackend(holder)
+        shards = [0, 1]
+        self._check(holder, be, shards)
+        assert be.bsi_min("i", "v", shards) == (-50, 2)
+        # Clearing one of the tied pair: count drops, value holds.
+        self._clear(f, 5)
+        self._check(holder, be, shards)
+        assert be.bsi_min("i", "v", shards) == (-50, 1)
+        # Clearing the last: shard 1's incumbent clears -> re-derive.
+        self._clear(f, SHARD_WIDTH + 7)
+        self._check(holder, be, shards)
+        assert be.bsi_min("i", "v", shards) == (30, 1)
+
+    def test_bulk_import_rederives_not_redispatches(self, holder, rng):
+        idx, cols = self._build(holder, rng)
+        shards = [0, 1, 2]
+        be = TPUBackend(holder)
+        self._check(holder, be, shards)
+        n0 = self._upd("minmax_incremental_updates_total")
+        # Bulk import into shard 1: ring can't explain -> host re-derive
+        # of that shard (still the incremental tier, no dispatch).
+        newc = np.unique(
+            rng.integers(SHARD_WIDTH, 2 * SHARD_WIDTH, 300, dtype=np.uint64)
+        )
+        idx.field("v").import_value(newc, rng.integers(-900, 901, newc.size))
+        self._check(holder, be, shards)
+        assert self._upd("minmax_incremental_updates_total") == n0 + 2
+
+    def test_churn_stays_exact(self, holder, rng):
+        idx, cols = self._build(holder, rng)
+        shards = [0, 1, 2]
+        be = TPUBackend(holder)
+        self._check(holder, be, shards)
+        w = np.random.default_rng(9)
+        for step in range(25):
+            col = int(w.integers(0, 3 * SHARD_WIDTH))
+            if step % 7 == 6:
+                self._clear(idx.field("v"), col)
+            else:
+                idx.field("v").set_value(col, int(w.integers(-1000, 1001)))
+            self._check(holder, be, shards)
