@@ -74,6 +74,17 @@ def cmd_server(args) -> int:
         executor.long_query_time = cfg.long_query_time
     api = API(holder, executor)
 
+    # TLS (reference server/tlsconfig.go): certificate+key serve HTTPS;
+    # peers are dialed with a CA-verified (or skip-verify) context. A
+    # bare host in cluster.hosts inherits the local scheme so an
+    # all-TLS cluster doesn't need https:// spelled 9 times.
+    local_scheme = "https" if cfg.tls.enabled else "http"
+    client_ssl = (
+        cfg.tls.client_context()
+        if (cfg.tls.enabled or cfg.tls.skip_verify or cfg.tls.ca_certificate)
+        else None
+    )
+
     def wire_cluster(topo_nodes, local_id):
         """Shared cluster bootstrap for both the static-hosts and --join
         paths: build the topology, attach seams, start daemons."""
@@ -85,7 +96,8 @@ def cmd_server(args) -> int:
         if local is None:
             return None
         cluster = Cluster(local, topo, holder,
-                          client=InternalClient(timeout=cfg.client_timeout))
+                          client=InternalClient(timeout=cfg.client_timeout,
+                                                ssl_context=client_ssl))
         cluster.logger = log
         cluster.attach(executor, api)
         api.cluster = cluster
@@ -111,7 +123,8 @@ def cmd_server(args) -> int:
 
         local_id = f"node-{cfg.host}-{cfg.port}"
         local = Node(
-            id=local_id, uri=URI(scheme="http", host=cfg.host, port=cfg.port)
+            id=local_id,
+            uri=URI(scheme=local_scheme, host=cfg.host, port=cfg.port),
         )
         join_cluster_ref = wire_cluster([local], local_id)
     elif cfg.cluster.hosts:
@@ -120,9 +133,13 @@ def cmd_server(args) -> int:
         # Node IDs derive from the URI so every host computes the same
         # ID-sorted ring without an out-of-band registry (the reference
         # persists a UUID and gossips it; static topology needs neither).
+        import dataclasses as _dc
+
         nodes = []
         for h in cfg.cluster.hosts:
             u = URI.parse(h)
+            if "://" not in h and local_scheme != "http":
+                u = _dc.replace(u, scheme=local_scheme)
             nodes.append(Node(id=f"node-{u.host}-{u.port}", uri=u))
         local_id = f"node-{cfg.host}-{cfg.port}"
         if cfg.cluster.coordinator:
@@ -144,8 +161,11 @@ def cmd_server(args) -> int:
             len(nodes), cfg.cluster.replicas, cluster.coordinator().id,
         )
 
-    server = Server(api, host=cfg.host, port=cfg.port)  # binds the socket
-    log.printf("listening on http://%s:%d (data: %s)", cfg.host, cfg.port, data_dir)
+    server = Server(api, host=cfg.host, port=cfg.port, tls=cfg.tls)  # binds
+    log.printf(
+        "listening on %s://%s:%d (data: %s)",
+        local_scheme, cfg.host, cfg.port, data_dir,
+    )
     if join_cluster_ref is not None:
         import threading
 
@@ -166,6 +186,21 @@ def cmd_server(args) -> int:
     return 0
 
 
+def _client_tls_context(args):
+    """ssl context for the ctl-style client commands' --ca-certificate /
+    --skip-verify trust flags (reference ctl's --tls.* flags). None for
+    plain-http hosts or default system-store verification."""
+    if getattr(args, "skip_verify", False):
+        from pilosa_tpu.server.config import TLSConfig
+
+        return TLSConfig(skip_verify=True).client_context()
+    if getattr(args, "ca_certificate", None):
+        import ssl
+
+        return ssl.create_default_context(cafile=args.ca_certificate)
+    return None
+
+
 def cmd_import(args) -> int:
     """CSV import: rows of row_id,column_id (or col,value with -v)
     (reference ctl/import.go)."""
@@ -173,6 +208,7 @@ def cmd_import(args) -> int:
     import urllib.request
 
     host = args.host.rstrip("/")
+    ctx = _client_tls_context(args)
     index, field = args.index, args.field
 
     # create index/field if requested
@@ -191,7 +227,7 @@ def cmd_import(args) -> int:
                 headers={"Content-Type": "application/json"},
             )
             try:
-                urllib.request.urlopen(req)
+                urllib.request.urlopen(req, context=ctx)
             except urllib.error.HTTPError as e:
                 if e.code != 409:  # only "already exists" is benign
                     raise
@@ -222,7 +258,7 @@ def cmd_import(args) -> int:
         method="POST",
         headers={"Content-Type": "application/json"},
     )
-    resp = urllib.request.urlopen(req)
+    resp = urllib.request.urlopen(req, context=ctx)
     print(resp.read().decode().strip())
     return 0
 
@@ -235,7 +271,9 @@ def cmd_export(args) -> int:
     url = f"{args.host.rstrip('/')}/export?index={args.index}&field={args.field}"
     if args.shard is not None:
         url += f"&shard={args.shard}"
-    resp = urllib.request.urlopen(urllib.request.Request(url))
+    resp = urllib.request.urlopen(
+        urllib.request.Request(url), context=_client_tls_context(args)
+    )
     sys.stdout.write(resp.read().decode())
     return 0
 
@@ -319,8 +357,19 @@ def main(argv=None) -> int:
     sp.add_argument("--verbose", action="store_true")
     sp.set_defaults(fn=cmd_server)
 
+    def _tls_client_flags(sp):
+        sp.add_argument(
+            "--ca-certificate", default="",
+            help="PEM CA bundle to verify an https:// host against",
+        )
+        sp.add_argument(
+            "--skip-verify", action="store_true",
+            help="accept any https:// server certificate (dev clusters)",
+        )
+
     sp = sub.add_parser("import", help="import CSV data")
     sp.add_argument("--host", default="http://localhost:10101")
+    _tls_client_flags(sp)
     sp.add_argument("-i", "--index", required=True)
     sp.add_argument("-f", "--field", required=True)
     sp.add_argument("--create", action="store_true", help="create index/field first")
@@ -334,6 +383,7 @@ def main(argv=None) -> int:
         "export", help="export a whole field (all shards/nodes) as CSV"
     )
     sp.add_argument("--host", default="http://localhost:10101")
+    _tls_client_flags(sp)
     sp.add_argument("-i", "--index", required=True)
     sp.add_argument("-f", "--field", required=True)
     sp.add_argument("-s", "--shard", type=int, default=None,

@@ -49,8 +49,11 @@ def _ts_epoch(t) -> int:
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, ssl_context=None):
         self.timeout = timeout
+        # ssl context for https:// peers (TLSConfig.client_context():
+        # CA-verified or skip-verify); None = stdlib default validation.
+        self.ssl_context = ssl_context
 
     # -- plumbing ----------------------------------------------------------
 
@@ -77,7 +80,9 @@ class InternalClient:
             for k, v in span.inject_headers().items():
                 req.add_header(k, v)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self.ssl_context
+            ) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
             detail = ""

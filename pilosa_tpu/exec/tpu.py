@@ -1859,6 +1859,8 @@ class TPUBackend:
                           pershard, vers_f, vers_g):
         """Try to apply one dirty shard's epoch as exact point-write
         deltas to pershard[i] (flat row [pair(rf*rg) | cf | cg]).
+        DUPLICATED DISCIPLINE: _groupn_shard_delta generalizes this
+        protocol to N fields — mirror any locking/version fix there.
         Returns the op count applied, or None when the slab tier must
         handle it: self-pair (ordering against a changing self), BOTH
         sides changed in the window (probes against the other side must
@@ -2621,7 +2623,14 @@ class TPUBackend:
         """Apply one dirty shard's epoch as exact point-write deltas to
         pershard[i], or None for the slab tier: more than one field
         changed (probe ordering against changing peers is ambiguous),
-        no delta history, row growth, or a probe-version conflict."""
+        no delta history, row growth, or a probe-version conflict.
+
+        DUPLICATED DISCIPLINE: this is the N-field generalization of
+        _pair_shard_delta's probe/confirm/revert protocol. Any fix to
+        the version-capture or probe-locking rules in EITHER method
+        must be mirrored in the other (they are kept separate because
+        the pair tier carries batcher/device-stack coupling this tier
+        deliberately avoids)."""
         n = len(fobjs)
         changed = [
             t for t in range(n) if hit.vers[t][i] != live[t][i]

@@ -57,6 +57,7 @@ class API:
         # Set by the HTTP server once the listener is bound.
         self.local_host = "localhost"
         self.local_port = 10101
+        self.local_scheme = "http"
 
     def _validate_state(self, method: str) -> None:
         if self.cluster is None or method in _STATE_EXEMPT:
@@ -514,7 +515,8 @@ class API:
             self.cluster.nodes_json()
             if self.cluster is not None
             else [{"id": "local",
-                   "uri": {"scheme": "http", "host": self.local_host, "port": self.local_port},
+                   "uri": {"scheme": self.local_scheme, "host": self.local_host,
+                           "port": self.local_port},
                    "isCoordinator": True, "state": "READY"}]
         )
         return {

@@ -21,6 +21,45 @@ class ClusterConfig:
 
 
 @dataclass
+class TLSConfig:
+    """reference server/tlsconfig.go:1-40 + config.go:120-130: serve
+    HTTPS when certificate+key are set; the internal client verifies
+    peers against ca_certificate (or the system store), or skips
+    verification entirely with skip_verify (self-signed dev clusters)."""
+
+    certificate: str = ""  # PEM cert (+chain) path; empty = plain HTTP
+    key: str = ""  # PEM private key path
+    ca_certificate: str = ""  # PEM CA bundle for peer verification
+    skip_verify: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.certificate and self.key)
+
+    def server_context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.certificate, self.key)
+        return ctx
+
+    def client_context(self):
+        """ssl context for OUTBOUND peer calls (internal client). Built
+        whenever any TLS field is set — a node can be a plain-HTTP
+        client of an HTTPS cluster during migration."""
+        import ssl
+
+        if self.skip_verify:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        return ssl.create_default_context(
+            cafile=self.ca_certificate or None
+        )
+
+
+@dataclass
 class Config:
     data_dir: str = "~/.pilosa-tpu"
     bind: str = "localhost:10101"
@@ -29,6 +68,7 @@ class Config:
     log_path: str = ""
     verbose: bool = False
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
     anti_entropy_interval: float = 600.0  # seconds (reference: 10m)
     metric_service: str = "memory"  # memory | none
     tracing: bool = False
@@ -93,6 +133,12 @@ class Config:
                 "replicas": self.cluster.replicas,
                 "hosts": self.cluster.hosts,
             },
+            "tls": {
+                "certificate": self.tls.certificate,
+                "key": self.tls.key,
+                "ca-certificate": self.tls.ca_certificate,
+                "skip-verify": self.tls.skip_verify,
+            },
             "long-query-time": self.long_query_time,
             "batch-window": self.batch_window,
             "preheat": self.preheat,
@@ -143,6 +189,11 @@ class Config:
         self.cluster.coordinator = c.get("coordinator", self.cluster.coordinator)
         self.cluster.replicas = c.get("replicas", self.cluster.replicas)
         self.cluster.hosts = c.get("hosts", self.cluster.hosts)
+        t = data.get("tls", {})
+        self.tls.certificate = t.get("certificate", self.tls.certificate)
+        self.tls.key = t.get("key", self.tls.key)
+        self.tls.ca_certificate = t.get("ca-certificate", self.tls.ca_certificate)
+        self.tls.skip_verify = t.get("skip-verify", self.tls.skip_verify)
 
     def _apply_env(self, env: dict) -> None:
         pre = "PILOSA_TPU_"
@@ -163,6 +214,13 @@ class Config:
             pre + "PROFILE_PORT": ("profile_port", int),
             pre + "CLIENT_TIMEOUT": ("client_timeout", float),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
+            pre + "TLS_CERTIFICATE": ("tls.certificate", str),
+            pre + "TLS_KEY": ("tls.key", str),
+            pre + "TLS_CA_CERTIFICATE": ("tls.ca_certificate", str),
+            pre + "TLS_SKIP_VERIFY": (
+                "tls.skip_verify",
+                lambda v: v.lower() in ("1", "true"),
+            ),
         }
         for key, (attr, conv) in mapping.items():
             if key in env:
@@ -188,6 +246,11 @@ class Config:
             f"client-timeout = {c.client_timeout}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
             f"[profile]\nport = {c.profile_port}\n"
+            "\n[tls]\n"
+            f'certificate = "{c.tls.certificate}"\n'
+            f'key = "{c.tls.key}"\n'
+            f'ca-certificate = "{c.tls.ca_certificate}"\n'
+            f"skip-verify = {str(c.tls.skip_verify).lower()}\n"
             "\n[anti-entropy]\n"
             f"interval = {c.anti_entropy_interval}\n"
             "\n[metric]\n"

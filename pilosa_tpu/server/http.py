@@ -60,12 +60,21 @@ def route(method: str, pattern: str):
 
 
 class Server:
-    """Owns the API + the listening socket (reference server.go Server)."""
+    """Owns the API + the listening socket (reference server.go Server).
 
-    def __init__(self, api: API, host: str = "localhost", port: int = 10101):
+    tls: an ssl.SSLContext (or a server/config.py TLSConfig with
+    certificate+key set) wraps the listener — the whole public AND
+    internal route table then speaks HTTPS (reference
+    server/tlsconfig.go wires one tls.Config into the http.Server)."""
+
+    def __init__(self, api: API, host: str = "localhost", port: int = 10101,
+                 tls=None):
         self.api = api
         self.host = host
         self.port = port
+        if tls is not None and not hasattr(tls, "wrap_socket"):
+            tls = tls.server_context() if tls.enabled else None
+        self._tls = tls
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -77,8 +86,13 @@ class Server:
 
         Handler.api = api
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        if self._tls is not None:
+            self._httpd.socket = self._tls.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
         self.port = self._httpd.server_address[1]  # resolve port 0
         api.local_host, api.local_port = self.host, self.port
+        api.local_scheme = self.scheme
 
     def open(self) -> "Server":
         self._bind()
@@ -94,8 +108,12 @@ class Server:
             self._thread.join(timeout=5)
 
     @property
+    def scheme(self) -> str:
+        return "https" if self._tls is not None else "http"
+
+    @property
     def uri(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        return f"{self.scheme}://{self.host}:{self.port}"
 
     def serve_forever(self) -> None:
         """Foreground mode for the CLI."""

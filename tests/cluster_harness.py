@@ -18,17 +18,18 @@ from pilosa_tpu.server.http import Server
 
 
 class ClusterNode:
-    def __init__(self, i: int, data_dir: str, backend_factory=None):
+    def __init__(self, i: int, data_dir: str, backend_factory=None, tls=None):
         self.i = i
         self.data_dir = data_dir
         self.holder = Holder(data_dir).open()
         backend = backend_factory(i, self.holder) if backend_factory else None
         self.executor = Executor(self.holder, backend=backend)
         self.api = API(self.holder, self.executor)
-        self.server = Server(self.api, host="127.0.0.1", port=0).open()
+        self.server = Server(self.api, host="127.0.0.1", port=0, tls=tls).open()
         self.node = Node(
             id=f"node{i}",
-            uri=URI(scheme="http", host="127.0.0.1", port=self.server.port),
+            uri=URI(scheme=self.server.scheme, host="127.0.0.1",
+                    port=self.server.port),
             is_coordinator=(i == 0),
         )
         self.cluster = None  # attached by TestCluster
@@ -43,14 +44,18 @@ class TestCluster:
 
     __test__ = False  # not a pytest class
 
-    def __init__(self, n: int, replica_n: int = 1, hasher=None, backend_factory=None):
+    def __init__(self, n: int, replica_n: int = 1, hasher=None,
+                 backend_factory=None, tls=None, client_ssl=None):
         self._tmp = tempfile.mkdtemp(prefix="pilosa-tpu-cluster-")
         self._replica_n = replica_n
         self._hasher = hasher or JmpHasher()
         self._backend_factory = backend_factory
+        self._tls = tls  # TLSConfig/SSLContext for every node's listener
+        self._client_ssl = client_ssl  # peers' outbound ssl context
         self._next_i = n
         self.nodes: list[ClusterNode] = [
-            ClusterNode(i, f"{self._tmp}/node{i}", backend_factory=backend_factory)
+            ClusterNode(i, f"{self._tmp}/node{i}",
+                        backend_factory=backend_factory, tls=tls)
             for i in range(n)
         ]
         members = [cn.node for cn in self.nodes]
@@ -58,6 +63,8 @@ class TestCluster:
             self._wire(cn, members)
 
     def _wire(self, cn: ClusterNode, members) -> None:
+        from pilosa_tpu.cluster import InternalClient
+
         topo = Topology(
             nodes=[Node(m.id, m.uri, m.is_coordinator) for m in members],
             replica_n=self._replica_n,
@@ -67,6 +74,9 @@ class TestCluster:
             local_node=topo.node_by_id(cn.node.id),
             topology=topo,
             holder=cn.holder,
+            client=InternalClient(ssl_context=self._client_ssl)
+            if self._client_ssl is not None
+            else None,
         )
         cn.cluster.attach(cn.executor, cn.api)
         cn.api.cluster = cn.cluster
@@ -78,7 +88,8 @@ class TestCluster:
         i = self._next_i
         self._next_i += 1
         cn = ClusterNode(
-            i, f"{self._tmp}/node{i}", backend_factory=self._backend_factory
+            i, f"{self._tmp}/node{i}", backend_factory=self._backend_factory,
+            tls=self._tls,
         )
         cn.node.is_coordinator = False
         self._wire(cn, [cn.node])
