@@ -74,13 +74,17 @@ class ForwardingTranslateStore:
         missing keys (VERDICT r2 weak #5: the per-key loop made a keyed
         import of 100k fresh keys 100k round trips; reference batches via
         TranslateKeysNode, http/client.go)."""
-        out = [self.local.translate_key(k, write=False) for k in keys]
+        out = self.local.translate_keys(keys, write=False)
         missing = [i for i, v in enumerate(out) if v is None]
         if not missing:
             return out
         if self.cluster.is_coordinator():
-            for i in missing:
-                out[i] = self.local.translate_key(keys[i], write=write)
+            if write:  # write=False misses are already known-absent
+                filled = self.local.translate_keys(
+                    [keys[i] for i in missing], write=True
+                )
+                for j, i in enumerate(missing):
+                    out[i] = filled[j]
             return out
         if not write:
             return out
@@ -111,7 +115,20 @@ class ForwardingTranslateStore:
         return k
 
     def translate_ids(self, ids: list[int]) -> list[Optional[str]]:
-        return [self.translate_id(i) for i in ids]
+        """Bulk id -> key: one local bulk lookup; a replica with misses
+        tails the primary ONCE and re-looks the misses up in bulk."""
+        out = self.local.translate_ids(ids)
+        missing = [i for i, v in enumerate(out) if v is None]
+        if not missing or self.cluster.is_coordinator():
+            return out
+        try:
+            self.sync_from_primary()
+        except ClientError:
+            return out
+        filled = self.local.translate_ids([ids[i] for i in missing])
+        for j, i in enumerate(missing):
+            out[i] = filled[j]
+        return out
 
     # -- replication -------------------------------------------------------
 

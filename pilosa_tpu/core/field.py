@@ -460,8 +460,16 @@ class Field:
     ) -> None:
         """Bulk bit import grouped by view and shard (reference field.go
         Import :1204, grouping by time quantum :1222-1265)."""
-        row_ids = np.asarray(row_ids, dtype=np.uint64)
-        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        # Narrow streams pass through unwidened (uint8 rows, uint32
+        # global column ids — valid up to 4096 shards): the native
+        # import reads them directly and the bulk-load path is
+        # input-bandwidth bound.
+        row_ids = np.asarray(row_ids)
+        if row_ids.dtype != np.uint8:
+            row_ids = row_ids.astype(np.uint64, copy=False)
+        column_ids = np.asarray(column_ids)
+        if column_ids.dtype != np.uint32:
+            column_ids = column_ids.astype(np.uint64, copy=False)
         if timestamps is None:
             # Fast path: everything goes to the standard view — skip the
             # per-bit grouping loop entirely.

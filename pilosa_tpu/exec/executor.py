@@ -252,19 +252,23 @@ class Executor:
         """ids -> keys on results (reference executor.go translateResults :2786)."""
         if isinstance(result, Row) and idx.options.keys and idx.translate_store is not None:
             cols = result.columns()
-            result.keys = [idx.translate_store.translate_id(int(v)) for v in cols.tolist()]
+            result.keys = idx.translate_store.translate_ids(
+                [int(v) for v in cols.tolist()]
+            )
         if isinstance(result, PairsField):
             f = idx.field(result.field_name) if result.field_name else None
             if f is not None and f.options.keys and f.translate_store is not None:
+                ks = f.translate_store.translate_ids([p.id for p in result.pairs])
                 result.pairs = [
-                    Pair(id=p.id, count=p.count, key=f.translate_store.translate_id(p.id) or "")
-                    for p in result.pairs
+                    Pair(id=p.id, count=p.count, key=ks[i] or "")
+                    for i, p in enumerate(result.pairs)
                 ]
         if isinstance(result, RowIDs):
             field_name = c.args.get("field") or c.args.get("_field")
             f = idx.field(field_name) if field_name else None
             if f is not None and f.options.keys and f.translate_store is not None:
-                result.keys = [f.translate_store.translate_id(r) or "" for r in result]
+                ks = f.translate_store.translate_ids(list(result))
+                result.keys = [k or "" for k in ks]
         if isinstance(result, PairField):
             f = idx.field(result.field_name) if result.field_name else None
             if f is not None and f.options.keys and f.translate_store is not None:

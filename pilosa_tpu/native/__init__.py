@@ -84,6 +84,14 @@ def _load() -> ctypes.CDLL | None:
                     ctypes.c_void_p,
                     ctypes.c_void_p,
                 ]
+                lib.pilosa_import_containers32.restype = ctypes.c_longlong
+                lib.pilosa_import_containers32.argtypes = (
+                    lib.pilosa_import_containers.argtypes
+                )
+                lib.pilosa_import_containers_r8c32.restype = ctypes.c_longlong
+                lib.pilosa_import_containers_r8c32.argtypes = (
+                    lib.pilosa_import_containers.argtypes
+                )
                 lib.pilosa_compress_words.restype = ctypes.c_longlong
                 lib.pilosa_compress_words.argtypes = [
                     ctypes.c_void_p,
@@ -166,8 +174,21 @@ def import_containers(rows, cols, shard_width_exp: int, key_cap: int = 1 << 16):
         return None
     import numpy as np
 
-    rows = np.ascontiguousarray(rows, dtype=np.uint64)
-    cols = np.ascontiguousarray(cols, dtype=np.uint64)
+    # Narrow streams stay narrow (the C import is input-load bound):
+    # uint32 columns hold global ids up to 4096 shards; uint8 rows hold
+    # the common short-field case — together 5 B/pair vs 16.
+    if getattr(cols, "dtype", None) == np.uint32:
+        cols = np.ascontiguousarray(cols)
+        if getattr(rows, "dtype", None) == np.uint8:
+            rows = np.ascontiguousarray(rows)
+            entry = lib.pilosa_import_containers_r8c32
+        else:
+            rows = np.ascontiguousarray(rows, dtype=np.uint64)
+            entry = lib.pilosa_import_containers32
+    else:
+        rows = np.ascontiguousarray(rows, dtype=np.uint64)
+        cols = np.ascontiguousarray(cols, dtype=np.uint64)
+        entry = lib.pilosa_import_containers
     n = rows.size
     cap = min(n, key_cap)
     # keys/counts are thread-local scratch (callers consume them within
@@ -184,7 +205,7 @@ def import_containers(rows, cols, shard_width_exp: int, key_cap: int = 1 << 16):
         _scratch.bufs = scr
     out_keys, out_counts = scr
     out_lows = np.empty(max(n, 1), dtype=np.uint16)
-    rc = lib.pilosa_import_containers(
+    rc = entry(
         rows.ctypes.data,
         cols.ctypes.data,
         n,

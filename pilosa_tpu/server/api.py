@@ -303,11 +303,11 @@ class API:
         if column_keys:
             if idx.translate_store is None:
                 raise APIError("index does not use string keys")
-            column_ids = [idx.translate_store.translate_key(k) for k in column_keys]
+            column_ids = idx.translate_store.translate_keys(column_keys)
         if row_keys:
             if f.translate_store is None:
                 raise APIError("field does not use string keys")
-            row_ids = [f.translate_store.translate_key(k) for k in row_keys]
+            row_ids = f.translate_store.translate_keys(row_keys)
         if self.cluster is not None and not remote:
             self._route_import(index, field, row_ids, column_ids, timestamps, clear)
             return
@@ -349,7 +349,7 @@ class API:
         if column_keys:
             if idx.translate_store is None:
                 raise APIError("index does not use string keys")
-            column_ids = [idx.translate_store.translate_key(k) for k in column_keys]
+            column_ids = idx.translate_store.translate_keys(column_keys)
         if self.cluster is not None and not remote:
             self._route_import_values(index, field, column_ids, values, clear)
             return

@@ -803,7 +803,7 @@ class _Handler(BaseHTTPRequestHandler):
         if store is None:
             self._error("no translate store", status=400)
             return
-        self._reply({"ids": [store.translate_key(k) for k in keys]})
+        self._reply({"ids": store.translate_keys(keys)})
 
     @route("GET", r"/internal/translate/data")
     def handle_get_translate_data(self):

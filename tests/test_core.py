@@ -347,3 +347,77 @@ class TestHierarchy:
         assert schema[0]["fields"][0]["name"] == "f"
         assert schema[0]["shardWidth"] == SHARD_WIDTH
         h.close()
+
+
+class TestTranslateStoreBulk:
+    """VERDICT r4 #5: translate_keys must be ONE transaction (chunked
+    membership SELECT + executemany INSERT + re-SELECT), not a per-key
+    SELECT+INSERT+commit loop through one lock."""
+
+    def _store(self):
+        from pilosa_tpu.store.translate import TranslateStore
+
+        return TranslateStore(None)
+
+    def test_bulk_matches_per_key_semantics(self):
+        ts = self._store()
+        a = ts.translate_key("a")
+        got = ts.translate_keys(["b", "a", "c", "b", "b"])
+        # existing key keeps its id; duplicates in one batch share one id
+        assert got[1] == a
+        assert got[0] == got[3] == got[4]
+        assert len({got[0], got[1], got[2]}) == 3
+        # ids are stable on re-query and visible per-key
+        assert ts.translate_keys(["c", "b"]) == [got[2], got[0]]
+        assert ts.translate_key("c") == got[2]
+
+    def test_write_false_misses_stay_none(self):
+        ts = self._store()
+        ts.translate_key("x")
+        assert ts.translate_keys(["x", "nope"], write=False) == [1, None]
+        assert ts.translate_key("nope", write=False) is None
+
+    def test_read_only_raises_on_miss_only(self):
+        from pilosa_tpu.store.translate import (
+            TranslateStore,
+            TranslateStoreReadOnlyError,
+        )
+
+        ts = TranslateStore(None)
+        ts.translate_key("x")
+        ts.read_only = True
+        assert ts.translate_keys(["x"]) == [1]
+        import pytest as _pytest
+
+        with _pytest.raises(TranslateStoreReadOnlyError):
+            ts.translate_keys(["x", "fresh"])
+
+    def test_chunking_over_variable_limit(self):
+        ts = self._store()
+        keys = [f"k{i}" for i in range(1301)]  # > 2 IN-clause chunks
+        ids = ts.translate_keys(keys)
+        assert sorted(ids) == list(range(1, 1302))
+        assert ts.translate_ids(ids) == keys
+        assert ts.translate_ids([99999, ids[7]]) == [None, "k7"]
+
+    def test_bulk_is_order_of_magnitude_faster_than_loop(self, tmp_path):
+        """The VERDICT done-bar, scaled to test time: a fresh keyed
+        batch through translate_keys must beat the per-key loop by
+        >=10x on a FILE-backed store (the loop pays a durable commit —
+        an fsync — per key; the batch pays one. The ratio only grows
+        with batch size)."""
+        import time as _time
+
+        from pilosa_tpu.store.translate import TranslateStore
+
+        n = 400
+        ts = TranslateStore(str(tmp_path / "loop" / "keys.db"))
+        t0 = _time.perf_counter()
+        for i in range(n):
+            ts.translate_key(f"loop{i}")
+        t_loop = _time.perf_counter() - t0
+        ts2 = TranslateStore(str(tmp_path / "bulk" / "keys.db"))
+        t0 = _time.perf_counter()
+        ts2.translate_keys([f"bulk{i}" for i in range(n)])
+        t_bulk = _time.perf_counter() - t0
+        assert t_bulk * 10 <= t_loop, (t_bulk, t_loop)
