@@ -34,11 +34,18 @@ TYPE_BITMAP = "bitmap"
 # First-class in-memory RLE containers (VERDICT r3 missing #5; reference
 # roaring.go:64-69,1940-1943): data is uint16[R, 2] of [start, last]
 # INCLUSIVE runs, sorted, non-overlapping, non-adjacent. Reads (contains,
-# counts, pack, serialize) are run-native; mutating/set-algebra ops
-# convert to array/bitmap first (the result re-packs to runs on the next
-# Bitmap.optimize()) — a full 2^16 run costs 4 bytes here vs 8 KiB as a
-# bitmap, which is the parity point: host RAM on runny data.
+# counts, pack, serialize) AND set algebra against run/array peers are
+# run-native (VERDICT r4 #4; reference run-aware op matrix around
+# roaring.go:2599-2790) — a runny container survives queries without
+# ever materializing its 8 KiB bitmap twin. Ops against bitmap peers
+# materialize (the reference does run×bitmap through the bitmap form
+# too); point mutators convert, and optimize() re-packs.
 TYPE_RUN = "run"
+
+#: RUN -> array/bitmap twin materializations (run_materializations in
+#: tests): time-quantum view queries over runny containers must keep
+#: this flat on run/array op pairs.
+UNRUN_MATERIALIZATIONS = [0]
 
 _EMPTY_U16 = np.empty(0, dtype=np.uint16)
 
@@ -70,6 +77,97 @@ def _sorted_union(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     if out.size:
         out = out[np.concatenate(([True], out[1:] != out[:-1]))]
     return out
+
+
+def _positions_to_runs(pos: np.ndarray) -> np.ndarray:
+    """Sorted-unique positions -> [[start, last], ...] int64."""
+    p = pos.astype(np.int64)
+    if p.size == 0:
+        return np.empty((0, 2), dtype=np.int64)
+    breaks = np.nonzero(np.diff(p) != 1)[0]
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [p.size - 1]))
+    return np.stack([p[starts], p[ends]], axis=1)
+
+
+def _runs_member_mask(runs: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    """Boolean mask over pos: pos[i] inside some run. Vectorized: the
+    predecessor run by start, then an upper-bound check on its last."""
+    if runs.shape[0] == 0 or pos.size == 0:
+        return np.zeros(pos.size, dtype=bool)
+    starts = runs[:, 0].astype(np.int64)
+    lasts = runs[:, 1].astype(np.int64)
+    p = pos.astype(np.int64)
+    idx = np.searchsorted(starts, p, side="right") - 1
+    ok = idx >= 0
+    return ok & (p <= lasts[np.clip(idx, 0, starts.size - 1)])
+
+
+def _intersect_runs(ra: np.ndarray, rb: np.ndarray) -> np.ndarray:
+    """Overlap sweep of two sorted run lists -> runs int64 (reference
+    intersectRunRun, roaring.go's run-aware op matrix)."""
+    out = []
+    i = j = 0
+    na, nb = ra.shape[0], rb.shape[0]
+    while i < na and j < nb:
+        s = max(ra[i, 0], rb[j, 0])
+        l = min(ra[i, 1], rb[j, 1])
+        if s <= l:
+            out.append((s, l))
+        if ra[i, 1] < rb[j, 1]:
+            i += 1
+        else:
+            j += 1
+    return np.array(out, dtype=np.int64).reshape(-1, 2)
+
+
+def _union_runs(ra: np.ndarray, rb: np.ndarray) -> np.ndarray:
+    """Merge + coalesce (adjacent runs fuse) -> runs int64. Vectorized
+    interval merge: sort by start, running max of ends, break where the
+    next start clears the running end by more than adjacency."""
+    allr = np.concatenate([ra, rb]).astype(np.int64)
+    if allr.shape[0] == 0:
+        return allr.reshape(-1, 2)
+    allr = allr[np.argsort(allr[:, 0], kind="stable")]
+    starts = allr[:, 0]
+    ends = np.maximum.accumulate(allr[:, 1])
+    brk = np.nonzero(starts[1:] > ends[:-1] + 1)[0]
+    s_idx = np.concatenate(([0], brk + 1))
+    e_idx = np.concatenate((brk, [allr.shape[0] - 1]))
+    return np.stack([starts[s_idx], ends[e_idx]], axis=1)
+
+
+def _runs_could_win(n_runs_upper: int, n_upper: int) -> bool:
+    """Cheap pre-gate for run-native batch ops: when even the BEST-case
+    result (no coalescing losses counted) cannot encode smaller as runs,
+    the materialized numpy kernels are faster than the run sweeps — a
+    scattered 14k-value with_many through the run path measured ~90x
+    slower than the bitmap kernel it replaced (code review r5), and the
+    result demoted to a bitmap anyway."""
+    return _runs_win(n_runs_upper, max(n_upper, 1))
+
+
+def _difference_runs(ra: np.ndarray, rb: np.ndarray) -> np.ndarray:
+    """ra \\ rb sweep -> runs int64."""
+    out = []
+    j = 0
+    nb = rb.shape[0]
+    for s, l in ra.astype(np.int64):
+        cur = int(s)
+        while j < nb and int(rb[j, 1]) < cur:
+            j += 1
+        k = j
+        while k < nb and int(rb[k, 0]) <= l:
+            bs, bl = int(rb[k, 0]), int(rb[k, 1])
+            if bs > cur:
+                out.append((cur, bs - 1))
+            cur = max(cur, bl + 1)
+            if cur > l:
+                break
+            k += 1
+        if cur <= l:
+            out.append((cur, int(l)))
+    return np.array(out, dtype=np.int64).reshape(-1, 2)
 
 
 def _as_bitmap_words(arr: np.ndarray) -> np.ndarray:
@@ -236,22 +334,22 @@ class Container:
         containers, detected for the others)."""
         if self.typ == TYPE_RUN:
             return self.data.astype(np.int32)
-        pos = self.positions().astype(np.int32)
-        if pos.size == 0:
-            return np.empty((0, 2), dtype=np.int32)
-        breaks = np.nonzero(np.diff(pos) != 1)[0]
-        starts = np.concatenate(([0], breaks + 1))
-        ends = np.concatenate((breaks, [pos.size - 1]))
-        return np.stack([pos[starts], pos[ends]], axis=1)
+        return _positions_to_runs(self.positions()).astype(np.int32)
 
     def _unrun(self) -> "Container":
         """RUN -> array/bitmap twin (same bits) for ops with no RLE
-        form; identity for the other types."""
+        form; identity for the other types. Counted: run/array op pairs
+        must never come through here (the run-native paths exist so
+        time-quantum views don't allocate twins, VERDICT r4 #4)."""
         if self.typ != TYPE_RUN:
             return self
+        UNRUN_MATERIALIZATIONS[0] += 1
         if self._n <= ARRAY_MAX_SIZE:
             return Container(TYPE_ARRAY, self.positions(), self._n)
         return Container(TYPE_BITMAP, self.bitmap_words(), self._n)
+
+    def _i64_runs(self) -> np.ndarray:
+        return self.data.astype(np.int64)
 
     def contains(self, v: int) -> bool:
         if self.typ == TYPE_ARRAY:
@@ -325,7 +423,18 @@ class Container:
         if vs.size == 0:
             return self
         if self.typ == TYPE_RUN:
-            return self._unrun().with_many(vs)
+            # Run-native when the result can stay RLE; a scattered batch
+            # (run count ~ size) goes through the materialized kernels
+            # instead (see _runs_could_win).
+            vs_u = np.unique(vs.astype(np.uint16))
+            vs_runs = _positions_to_runs(vs_u)
+            if _runs_could_win(
+                self.data.shape[0] + vs_runs.shape[0], self._n + vs_u.size
+            ):
+                return Container.from_runs(
+                    _union_runs(self._i64_runs(), vs_runs)
+                )
+            return self._unrun().with_many(vs_u)
         if self.typ == TYPE_ARRAY:
             # _sorted_union's stable radix sort + adjacent-dedup handles
             # unsorted/duplicated vs directly — no np.unique pre-sort.
@@ -339,7 +448,18 @@ class Container:
         if vs.size == 0:
             return self
         if self.typ == TYPE_RUN:
-            return self._unrun().without_many(vs)
+            vs_u = np.unique(vs.astype(np.uint16))
+            vs_runs = _positions_to_runs(vs_u)
+            # Removal can only add as many runs as removed spans; same
+            # could-win gate as with_many keeps scattered batches on the
+            # vectorized kernels.
+            if _runs_could_win(
+                self.data.shape[0] + vs_runs.shape[0], self._n
+            ):
+                return Container.from_runs(
+                    _difference_runs(self._i64_runs(), vs_runs)
+                )
+            return self._unrun().without_many(vs_u)
         if self.typ == TYPE_ARRAY:
             # The membership table is duplicate- and order-insensitive.
             keep = ~_sorted_member_mask(self.data, vs.astype(np.uint16))
@@ -350,8 +470,21 @@ class Container:
         return Container.from_bitmap_words(self.data & ~mask)
 
     # -- set algebra -----------------------------------------------------
+    # run×run and run×array compute ON the runs (reference's run-aware
+    # op matrix, roaring.go:2599-2790); run×bitmap materializes (so does
+    # the reference's — the bitmap side has no structure to exploit).
 
     def intersect(self, other: "Container") -> "Container":
+        if self.typ == TYPE_RUN and other.typ == TYPE_RUN:
+            return Container.from_runs(
+                _intersect_runs(self._i64_runs(), other._i64_runs())
+            )
+        if self.typ == TYPE_RUN and other.typ == TYPE_ARRAY:
+            keep = _runs_member_mask(self.data, other.data)
+            return Container(TYPE_ARRAY, other.data[keep], None)
+        if self.typ == TYPE_ARRAY and other.typ == TYPE_RUN:
+            keep = _runs_member_mask(other.data, self.data)
+            return Container(TYPE_ARRAY, self.data[keep], None)
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             if a.data.size > b.data.size:
@@ -367,6 +500,13 @@ class Container:
         return Container.from_bitmap_words(a.data & b.data)
 
     def intersection_count(self, other: "Container") -> int:
+        if self.typ == TYPE_RUN and other.typ == TYPE_RUN:
+            r = _intersect_runs(self._i64_runs(), other._i64_runs())
+            return int((r[:, 1] - r[:, 0] + 1).sum()) if r.size else 0
+        if self.typ == TYPE_RUN and other.typ == TYPE_ARRAY:
+            return int(_runs_member_mask(self.data, other.data).sum())
+        if self.typ == TYPE_ARRAY and other.typ == TYPE_RUN:
+            return int(_runs_member_mask(other.data, self.data).sum())
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             if a.data.size > b.data.size:
@@ -380,12 +520,46 @@ class Container:
         return int(np.bitwise_count(a.data & b.data).sum())
 
     def union(self, other: "Container") -> "Container":
+        if self.typ == TYPE_RUN and other.typ == TYPE_RUN:
+            return Container.from_runs(
+                _union_runs(self._i64_runs(), other._i64_runs())
+            )
+        if (self.typ == TYPE_RUN and other.typ == TYPE_ARRAY) or (
+            self.typ == TYPE_ARRAY and other.typ == TYPE_RUN
+        ):
+            run_c, arr_c = (
+                (self, other) if self.typ == TYPE_RUN else (other, self)
+            )
+            arr_runs = _positions_to_runs(arr_c.data)
+            # Scattered arrays (run count ~ size) can't yield a runny
+            # union: the vectorized kernels win (code review r5).
+            if _runs_could_win(
+                run_c.data.shape[0] + arr_runs.shape[0],
+                run_c._n + arr_c._n,
+            ):
+                return Container.from_runs(
+                    _union_runs(run_c._i64_runs(), arr_runs)
+                )
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             return Container.from_positions(_sorted_union(a.data, b.data))
         return Container.from_bitmap_words(a.bitmap_words() | b.bitmap_words())
 
     def difference(self, other: "Container") -> "Container":
+        if self.typ == TYPE_RUN and other.typ == TYPE_RUN:
+            return Container.from_runs(
+                _difference_runs(self._i64_runs(), other._i64_runs())
+            )
+        if self.typ == TYPE_RUN and other.typ == TYPE_ARRAY:
+            return Container.from_runs(
+                _difference_runs(
+                    self._i64_runs(), _positions_to_runs(other.data)
+                )
+            )
+        if self.typ == TYPE_ARRAY and other.typ == TYPE_RUN:
+            keep = ~_runs_member_mask(other.data, self.data)
+            out = self.data[keep]
+            return Container(TYPE_ARRAY, out, int(out.size))
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY:
             if b.typ == TYPE_ARRAY:
@@ -397,6 +571,32 @@ class Container:
         return Container.from_bitmap_words(a.data & ~b.bitmap_words())
 
     def xor(self, other: "Container") -> "Container":
+        run_pair = (
+            self.typ == TYPE_RUN and other.typ in (TYPE_RUN, TYPE_ARRAY)
+        ) or (self.typ == TYPE_ARRAY and other.typ == TYPE_RUN)
+        if run_pair:
+            ra = (
+                self._i64_runs()
+                if self.typ == TYPE_RUN
+                else _positions_to_runs(self.data)
+            )
+            rb = (
+                other._i64_runs()
+                if other.typ == TYPE_RUN
+                else _positions_to_runs(other.data)
+            )
+            # Same scattered-operand gate as union (code review r5):
+            # xor can produce at most ra+rb+1 runs.
+            if _runs_could_win(
+                ra.shape[0] + rb.shape[0] + 1, self._n + other._n
+            ):
+                # (a\b) and (b\a) are disjoint; their union coalesces
+                # any adjacency the symmetric difference re-creates.
+                return Container.from_runs(
+                    _union_runs(
+                        _difference_runs(ra, rb), _difference_runs(rb, ra)
+                    )
+                )
         a, b = self._unrun(), other._unrun()
         if a.typ == TYPE_ARRAY and b.typ == TYPE_ARRAY:
             return Container.from_positions(np.setxor1d(a.data, b.data, assume_unique=True))
@@ -623,10 +823,12 @@ class Bitmap:
 
     def optimize(self) -> int:
         """Re-pack containers as RLE runs where that is the smallest
-        encoding (reference roaring.go Optimize): mutating ops leave
+        encoding (reference roaring.go Optimize). Batch mutators and
+        run/array set algebra are run-preserving since r5; point
+        mutators (with_bit/without_bit) and bitmap-side ops still leave
         array/bitmap results, so long-lived runny fragments call this
-        after bulk loads / snapshots to reclaim host RAM. Returns the
-        number of containers converted."""
+        after point-write churn to reclaim host RAM. Returns the number
+        of containers converted."""
         converted = 0
         for key in self.keys():
             c = self._cs[key]
