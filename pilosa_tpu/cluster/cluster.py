@@ -611,6 +611,23 @@ class Cluster:
                 NODE_STATE_READY,
                 NODE_STATE_DOWN,
             ):
+                # Asymmetric-partition guard (SWIM-style, r5): a peer's
+                # DOWN claim is a VOTE against our own probe history,
+                # never an overwrite — an unconditional overwrite let
+                # one one-sided partition flap the whole cluster
+                # (claimer marks DOWN and broadcasts; a healthy
+                # receiver overwrites, then its own next probe flips it
+                # READY and re-broadcasts, forever). Symmetric failures
+                # (the node is really dead) still converge fast: every
+                # receiver's probes are failing too, so the vote tops
+                # up their confirm counter.
+                fd = getattr(self, "failure_detector", None)
+                if (
+                    state == NODE_STATE_DOWN
+                    and fd is not None
+                    and not fd.vote_down(nid)
+                ):
+                    return
                 target.state = state
         elif typ == bc.MSG_SET_COORDINATOR:
             new_id = msg.get("id")

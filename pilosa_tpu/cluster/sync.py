@@ -395,6 +395,9 @@ class FailureDetector:
     def __init__(self, cluster, interval: float = 1.0, confirm_down: int = CONFIRM_DOWN,
                  logger=None):
         self.cluster = cluster
+        # Backref for the asymmetric-partition guard: disseminated DOWN
+        # claims consult our probe history (cluster.receive_message).
+        cluster.failure_detector = self
         self.interval = interval
         self.confirm_down = confirm_down
         self.log = logger or NopLogger()
@@ -407,6 +410,19 @@ class FailureDetector:
         self._peer_reports: dict[tuple[str, str], str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def vote_down(self, node_id: str) -> bool:
+        """A peer's disseminated DOWN claim counts as ONE vote on our
+        confirm counter — same SWIM discipline as _merge_peer_view,
+        never an outright overwrite (a single transient local probe
+        failure plus one broadcast must not mark a reachable node DOWN;
+        code review r5). No vote at all while our probes succeed.
+        Returns True when the accumulated evidence reaches
+        confirm_down (the caller then applies the DOWN)."""
+        if self._fails.get(node_id, 0) <= 0:
+            return False
+        self._fails[node_id] += 1
+        return self._fails[node_id] >= self.confirm_down
 
     def probe_once(self) -> None:
         topo = self.cluster.topology

@@ -551,11 +551,16 @@ class Container:
                 _difference_runs(self._i64_runs(), other._i64_runs())
             )
         if self.typ == TYPE_RUN and other.typ == TYPE_ARRAY:
-            return Container.from_runs(
-                _difference_runs(
-                    self._i64_runs(), _positions_to_runs(other.data)
+            arr_runs = _positions_to_runs(other.data)
+            # Same scattered-operand gate as with_many/union/xor: a
+            # removal can split at most one run per removed span.
+            if _runs_could_win(
+                self.data.shape[0] + arr_runs.shape[0], self._n
+            ):
+                return Container.from_runs(
+                    _difference_runs(self._i64_runs(), arr_runs)
                 )
-            )
+            return self._unrun().difference(other)
         if self.typ == TYPE_ARRAY and other.typ == TYPE_RUN:
             keep = ~_runs_member_mask(other.data, self.data)
             out = self.data[keep]

@@ -1,20 +1,135 @@
 """In-process multi-node cluster harness (reference test/pilosa.go:88
 MustRunCluster): n real servers in one process on ephemeral ports, static
 topology (no gossip), deterministic ModHasher placement available for
-tests that assert specific owners."""
+tests that assert specific owners. FaultProxy + RewriteClient build
+ASYMMETRIC network partitions (one node's outbound to one peer routed
+through a refusable/blackholable real TCP proxy — the socket-level
+analog of the reference's pumba container-pause harness,
+internal/clustertests/cluster_test.go:68-92)."""
 
 from __future__ import annotations
 
 import shutil
+import socket
 import tempfile
+import threading
 import time
 
-from pilosa_tpu.cluster import Cluster, Node, Topology, URI
+from pilosa_tpu.cluster import Cluster, InternalClient, Node, Topology, URI
 from pilosa_tpu.cluster.topology import JmpHasher
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.exec.executor import Executor
 from pilosa_tpu.server.api import API
 from pilosa_tpu.server.http import Server
+
+
+class FaultProxy:
+    """Real-TCP forwarder with injectable faults, per-connection:
+
+    - mode 'pass': pipe bytes both ways to the target
+    - mode 'refuse': close incoming connections immediately (RST-ish —
+      the dialer sees an instant transport error)
+    - mode 'blackhole': accept, read, never answer (the dialer blocks
+      until its timeout — the one-sided-silence failure shape)
+    """
+
+    def __init__(self, target_host: str, target_port: int):
+        self.target = (target_host, target_port)
+        self.mode = "pass"
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(32)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            mode = self.mode
+            if mode == "refuse":
+                conn.close()
+                continue
+            threading.Thread(
+                target=self._serve, args=(conn, mode), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket, mode: str) -> None:
+        try:
+            if mode == "blackhole":
+                conn.settimeout(0.2)
+                while not self._stop.is_set():
+                    try:
+                        if not conn.recv(65536):
+                            return  # peer gave up
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        return
+                return
+            try:
+                up = socket.create_connection(self.target, timeout=5)
+            except OSError:
+                return  # target gone: behaves like refuse
+
+            def pipe(src, dst):
+                try:
+                    while True:
+                        data = src.recv(65536)
+                        if not data:
+                            break
+                        dst.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    for s in (src, dst):
+                        try:
+                            s.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+
+            t = threading.Thread(target=pipe, args=(up, conn), daemon=True)
+            t.start()
+            pipe(conn, up)
+            t.join(timeout=5)
+            up.close()
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class RewriteClient(InternalClient):
+    """InternalClient that dials selected peers through a FaultProxy:
+    rewrites is the {'host:port': 'host:proxyport'} connection map. Node
+    identity (URIs, ids) is untouched — only THIS node's outbound
+    connections move, which is what makes the partition asymmetric."""
+
+    def __init__(self, rewrites: dict, timeout: float = 0.5):
+        super().__init__(timeout=timeout)
+        self.rewrites = rewrites
+
+    def _do(self, method, uri, path, body=None,
+            content_type="application/json", raw=False):
+        from pilosa_tpu.cluster.client import _uri_str
+
+        u = _uri_str(uri)
+        scheme, _, hostport = u.partition("://")
+        mapped = self.rewrites.get(hostport)
+        if mapped is not None:
+            u = f"{scheme}://{mapped}"
+        return super()._do(method, u, path, body=body,
+                           content_type=content_type, raw=raw)
 
 
 class ClusterNode:

@@ -1,0 +1,143 @@
+"""Asymmetric network partitions (VERDICT r4 #9; reference pumba
+harness, internal/clustertests/cluster_test.go:68-92): node1's outbound
+to node2 goes through a real TCP proxy that can refuse or blackhole
+while every other direction stays healthy — the one failure class
+SIGKILL/SIGSTOP legs cannot produce (they partition a node from
+EVERYONE). Asserts: the one-sided observer degrades only its own view,
+healthy peers reject its DOWN claim (SWIM corroboration), nobody flaps,
+the coordinator never splits, and release heals."""
+
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.sync import FailureDetector
+from pilosa_tpu.cluster.topology import NODE_STATE_DOWN, NODE_STATE_READY
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from tests.cluster_harness import FaultProxy, RewriteClient, TestCluster
+
+
+def _view(cn) -> dict:
+    return {n.id: n.state for n in cn.cluster.topology.nodes}
+
+
+def _coord(cn):
+    return next(
+        (n.id for n in cn.cluster.topology.nodes if n.is_coordinator), None
+    )
+
+
+class TestAsymmetricPartition:
+    def _setup(self, tc):
+        """Wire node1's outbound to node2 through a proxy; manual-drive
+        failure detectors (probe_once round-robin — no timing flake)."""
+        n2 = tc[2].node.uri
+        proxy = FaultProxy(n2.host, n2.port)
+        rc = RewriteClient(
+            {f"{n2.host}:{n2.port}": f"127.0.0.1:{proxy.port}"}, timeout=0.5
+        )
+        tc[1].cluster.client = rc
+        tc[1].cluster.broadcaster.client = rc
+        fds = [
+            FailureDetector(cn.cluster, interval=999, confirm_down=3)
+            for cn in tc.nodes
+        ]
+        return proxy, fds
+
+    def _rounds(self, fds, k: int) -> None:
+        for _ in range(k):
+            for fd in fds:
+                fd.probe_once()
+            time.sleep(0.05)  # let async broadcasts land
+
+    def test_one_sided_partition_no_flap_no_splitbrain(self):
+        with TestCluster(3, replica_n=2) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 5 for s in range(4)]
+            tc.query(0, "i", " ".join(f"Set({c}, f=1)" for c in cols))
+            proxy, fds = self._setup(tc)
+            try:
+                # Healthy: everyone READY after full probe rounds.
+                self._rounds(fds, 2)
+                for cn in tc.nodes:
+                    assert set(_view(cn).values()) == {NODE_STATE_READY}
+
+                # One-sided refuse: node1 -> node2 dies instantly while
+                # node0<->node2 and node2 -> node1 stay healthy.
+                proxy.mode = "refuse"
+                self._rounds(fds, 4)  # past confirm_down=3
+                assert _view(tc[1])["node2"] == NODE_STATE_DOWN
+                # The observer's own cluster degrades (replica routing
+                # takes over), but ONLY its view: healthy peers must
+                # reject the uncorroborated DOWN claim.
+                assert tc[1].cluster.state() == "DEGRADED"
+                assert _view(tc[0])["node2"] == NODE_STATE_READY
+                assert _view(tc[2])["node1"] == NODE_STATE_READY
+                assert tc[0].cluster.state() == "NORMAL"
+
+                # No flapping: across further rounds the views are
+                # STABLE (node1 keeps its DOWN; peers keep READY).
+                for _ in range(5):
+                    self._rounds(fds, 1)
+                    assert _view(tc[1])["node2"] == NODE_STATE_DOWN
+                    assert _view(tc[0])["node2"] == NODE_STATE_READY
+                    assert _view(tc[2])["node2"] == NODE_STATE_READY
+                # No split-brain: node0 is the one coordinator in every
+                # view, throughout.
+                for cn in tc.nodes:
+                    assert _coord(cn) == "node0"
+
+                # Queries still answer everywhere (replica_n=2 routes
+                # node1's scatter around the peer it cannot reach).
+                for i in range(3):
+                    out = tc.query(i, "i", "Count(Row(f=1))")
+                    assert out["results"][0] == len(cols), i
+
+                # Release: node1's next probe heals its view.
+                proxy.mode = "pass"
+                self._rounds(fds, 2)
+                for cn in tc.nodes:
+                    assert set(_view(cn).values()) == {NODE_STATE_READY}
+                    assert _coord(cn) == "node0"
+                assert tc[1].cluster.state() == "NORMAL"
+            finally:
+                proxy.close()
+
+    def test_blackhole_partition_times_out_and_heals(self):
+        """Blackhole (accept, never answer): the dialer pays its timeout
+        instead of an instant error — same convergence, no flap."""
+        with TestCluster(3, replica_n=2) as tc:
+            proxy, fds = self._setup(tc)
+            try:
+                self._rounds(fds, 1)
+                proxy.mode = "blackhole"
+                self._rounds(fds, 4)
+                assert _view(tc[1])["node2"] == NODE_STATE_DOWN
+                assert _view(tc[0])["node2"] == NODE_STATE_READY
+                for cn in tc.nodes:
+                    assert _coord(cn) == "node0"
+                proxy.mode = "pass"
+                self._rounds(fds, 2)
+                for cn in tc.nodes:
+                    assert set(_view(cn).values()) == {NODE_STATE_READY}
+            finally:
+                proxy.close()
+
+    def test_symmetric_down_still_converges_in_one_broadcast(self):
+        """The corroboration gate must NOT slow real failures: when a
+        node is dead to EVERYONE, a peer's disseminated DOWN lands on
+        receivers whose own probes are failing too."""
+        with TestCluster(3, replica_n=2) as tc:
+            proxy, fds = self._setup(tc)
+            proxy.close()  # not used here
+            tc[2].server.close()  # node2 really dies
+            try:
+                # Each node probes once: everyone's counter starts
+                # failing; then drive node1 to confirm_down.
+                self._rounds(fds, 4)
+                for i in (0, 1):
+                    assert _view(tc[i])["node2"] == NODE_STATE_DOWN, i
+            finally:
+                pass
