@@ -145,8 +145,10 @@ class Fragment:
         # uid is process-unique (never reused, unlike id()) for cache keys.
         self.version = 0
         self.uid = next(_fragment_uids)
-        # Owning view's data-generation bump; see _mutated.
-        self.on_mutate: Optional[Callable[[], None]] = None
+        # Owning view's data-generation bump (called with this
+        # fragment's shard for the view's mutation journal); see
+        # _mutated.
+        self.on_mutate: Optional[Callable[[int], None]] = None
         self._row_cache: dict[int, Bitmap] = {}
         # Lazily-computed per-block checksums, invalidated by row on write
         # (reference caches block checksums too, fragment.go:1762-1776).
@@ -250,9 +252,10 @@ class Fragment:
         self.version += 1
         # Owning view's data-generation bump (set in view._new_fragment):
         # lets stack caches check freshness in O(1) instead of walking
-        # every fragment's (uid, version) per query.
+        # every fragment's (uid, version) per query. The shard arg feeds
+        # the view's mutation journal (view.dirty_shards_since).
         if self.on_mutate is not None:
-            self.on_mutate()
+            self.on_mutate(self.shard)
         if row_ids is None:
             self._row_cache.clear()
             self._block_sums.clear()

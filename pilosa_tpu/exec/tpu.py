@@ -3204,7 +3204,9 @@ class TPUBackend:
             return None
         vn = bsi_view_name(field_name)
         v = f.view(vn)
-        vers_new = self._live_versions(f, shards_t, vn)
+        vers_new = self._epoch_versions(
+            f, shards_t, vn, vers_old, ent[0][1]
+        )
         d_sum = 0
         d_cnt = 0
         for i, s in enumerate(shards_t):
@@ -3229,6 +3231,33 @@ class TPUBackend:
         )
         self.stats.count("sum_incremental_updates_total")
         return result
+
+    def _epoch_versions(self, f, shards_t, vn, vers_old, gen_recorded):
+        """Per-shard live versions for an epoch update, built from the
+        view's mutation journal when it fully explains
+        (gen_recorded, now]: only the dirtied shards pay a locked
+        fragment read; every other shard carries its RECORDED version
+        forward (exact — an unjournaled shard had no _mutated, so its
+        (uid, version) is unchanged). Falls back to the full locked walk
+        (_live_versions) when the journal can't explain. At 954 shards
+        the walk cost ~1.8 ms x3 aggregate kinds per write epoch — the
+        minmax churn leg's dominant serving cost."""
+        v = f.view(vn)
+        if v is None or vers_old is None:
+            return self._live_versions(f, shards_t, vn)
+        dirty = v.dirty_shards_since(gen_recorded)
+        if dirty is None or len(vers_old) != len(shards_t):
+            return self._live_versions(f, shards_t, vn)
+        out = list(vers_old)
+        for i, s in enumerate(shards_t):
+            if s in dirty:
+                fr = v.fragment(s)
+                if fr is None:
+                    out[i] = None
+                else:
+                    with fr.lock:  # serialize with a mid-write bump
+                        out[i] = (fr.uid, fr.version)
+        return tuple(out)
 
     def _agg_fingerprint(self, index, field_name, shards):
         idx = self.holder.index(index)
@@ -3398,7 +3427,9 @@ class TPUBackend:
         base, depth = bg.base, bg.bit_depth
         vn = bsi_view_name(field_name)
         v = f.view(vn)
-        vers_new = self._live_versions(f, shards_t, vn)
+        vers_new = self._epoch_versions(
+            f, shards_t, vn, vers_old, ent[0][1]
+        )
         better = (
             (lambda a, b: a < b) if kind == "bsi_min" else (lambda a, b: a > b)
         )

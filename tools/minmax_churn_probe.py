@@ -1,0 +1,11 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec.tpu import TPUBackend
+import bench
+h = Holder(None).open()
+t0 = time.time(); bench.build_index(h); print(f"build {time.time()-t0:.1f}s", flush=True)
+bench.build_bsi_field(h)
+be = TPUBackend(h)
+ro, churn, wr = bench.bench_minmax_churn(h, be)
+print(f"minmax ro {ro:.0f} churn {churn:.0f} ratio {churn/ro:.3f} wrate {wr:.1f}", flush=True)

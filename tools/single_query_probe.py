@@ -1,0 +1,48 @@
+"""VERDICT r4 #8: where do the ~18ms over relay_rtt_floor_ms go in
+single_query_p50_ms? Phase-split at the bench shape."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec.tpu import TPUBackend
+from pilosa_tpu.pql import parse_string
+import bench
+
+h = Holder(None).open()
+t0 = time.time()
+bench.build_index(h)
+print(f"build {time.time()-t0:.1f}s", flush=True)
+be = TPUBackend(h)
+shards = list(range(bench.SHARDS))
+rng = np.random.default_rng(7)
+queries = [f"Count(Intersect(Row(f={int(rng.integers(0,8))}), Row(g={int(rng.integers(0,8))})))" for _ in range(30)]
+calls = [parse_string(q).calls[0].children[0] for q in queries]
+be.count_shards("bench", calls[0], shards)  # warm
+rtt = bench.measure_rtt_floor()
+print(f"relay_rtt_floor {rtt*1e3:.2f} ms", flush=True)
+
+# total single-query p50
+lat = []
+for c in calls:
+    t0 = time.perf_counter(); be.count_shards("bench", c, shards); lat.append(time.perf_counter()-t0)
+lat.sort()
+print(f"single_query_p50 {lat[len(lat)//2]*1e3:.2f} ms", flush=True)
+
+# host-side assemble alone (spec+blocks+scalars, cache-hit path)
+t = []
+for c in calls:
+    t0 = time.perf_counter(); be._assemble("bench", c, tuple(shards)); t.append(time.perf_counter()-t0)
+t.sort()
+print(f"assemble p50 {t[len(t)//2]*1e3:.3f} ms", flush=True)
+
+# dispatch+readback of the already-compiled count program on resident blocks
+spec, blocks, scalars = be._assemble("bench", calls[0], tuple(shards))
+prog = be._program("count", spec, True)
+int(np.asarray(prog(blocks, scalars)))  # warm this spec shape
+t = []
+for c in calls:
+    spec, blocks, scalars = be._assemble("bench", c, tuple(shards))
+    fn = be._program("count", spec, True)
+    t0 = time.perf_counter(); int(np.asarray(fn(blocks, scalars))); t.append(time.perf_counter()-t0)
+t.sort()
+print(f"dispatch+readback p50 {t[len(t)//2]*1e3:.2f} ms", flush=True)
