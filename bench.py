@@ -38,16 +38,29 @@ engine actually trying (VERDICT r3 weak #6) rather than a single thread.
 
 Prints ONE JSON line {metric, value, unit, vs_baseline, ...}.
 
+Capture-proof harness (ISSUE r6, VERDICT r5 next-round #1):
+- BenchConn.post() retries ONCE on a transient connection reset with a
+  fresh connection; retries are counted into the JSON (http_post_retries)
+  alongside the server's http_connection_aborts_total.
+- Every completed leg checkpoints the accumulated results to
+  BENCH_partial.json (+ a partial JSON line on stderr), so a crash in
+  leg N+1 leaves legs 1..N parseable instead of a null artifact.
+- A phase-attribution leg scrapes the server's query_phase_seconds
+  histograms and runs the single-query leg under QueryProfiles, so the
+  over-floor latency decomposes into named phases instead of a guess.
+
 Env knobs: BENCH_SHARDS (default 954 = 1B cols), BENCH_ROWS (8),
 BENCH_DENSITY (0.05), BENCH_BATCH (256), BENCH_SECONDS (10),
 BENCH_LATENCY_N (30), BENCH_HTTP_CLIENTS (16),
 BENCH_HTTP_QUERIES_PER_REQ (16), BENCH_WRITE_RATES ("0,1,10,100"),
-BENCH_CHURN_SECONDS (8).
+BENCH_CHURN_SECONDS (8), BENCH_PARTIAL_PATH (BENCH_partial.json).
 """
 
 import concurrent.futures
+import http.client
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -59,9 +72,12 @@ import numpy as np
 from pilosa_tpu.core import Holder
 from pilosa_tpu.exec import Executor
 from pilosa_tpu.exec.batcher import CountBatcher
-from pilosa_tpu.exec.tpu import TPUBackend
 from pilosa_tpu.pql import parse_string
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+# The device backend import is deferred to main(): it needs a jax with
+# shard_map, and deferring keeps BenchConn + the prometheus parsers
+# importable by tests on any toolchain.
 
 SHARDS = int(os.environ.get("BENCH_SHARDS", "954"))  # 954*2^20 > 1e9 columns
 ROWS = int(os.environ.get("BENCH_ROWS", "8"))
@@ -77,6 +93,127 @@ WRITE_RATES = [
 CHURN_SECONDS = float(os.environ.get("BENCH_CHURN_SECONDS", "8"))
 
 WORDS = SHARD_WIDTH // 32
+
+PARTIAL_PATH = os.environ.get(
+    "BENCH_PARTIAL_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.json"),
+)
+
+_RETRY_LOCK = threading.Lock()
+RETRIES = {"post": 0, "get": 0}
+
+
+class BenchConn:
+    """Keep-alive HTTP client with capture-proof retry (VERDICT r5
+    next-round #1a): ONE transient reset (listen-backlog overflow, a
+    keep-alive connection the server closed under us) reconnects and
+    retries instead of killing the whole bench run; retries are counted
+    into the output JSON so a flaky window is visible, and a SECOND
+    consecutive failure propagates — systemic failure must stay loud."""
+
+    TRANSIENT = (
+        ConnectionResetError,
+        ConnectionAbortedError,
+        BrokenPipeError,
+        http.client.BadStatusLine,
+        http.client.CannotSendRequest,
+        http.client.ResponseNotReady,
+    )
+
+    def __init__(self, host: str, port: int, path: str = "/"):
+        self.host, self.port, self.path = host, port, path
+        self.conn = http.client.HTTPConnection(host, port)
+
+    def post(self, body: str, path: str = None) -> list:
+        try:
+            return self._once(body, path)
+        except self.TRANSIENT:
+            with _RETRY_LOCK:
+                RETRIES["post"] += 1
+            self.conn.close()
+            self.conn = http.client.HTTPConnection(self.host, self.port)
+            return self._once(body, path)
+
+    def _once(self, body: str, path: str) -> list:
+        self.conn.request(
+            "POST", path or self.path, body,
+            {"Content-Type": "application/json"},
+        )
+        resp = self.conn.getresponse()
+        return json.loads(resp.read())["results"]
+
+    def get_text(self, path: str) -> str:
+        try:
+            return self._get_once(path)
+        except self.TRANSIENT:
+            # Same one-shot retry as post(): the end-of-run /metrics
+            # scrape must not be the one unprotected request that zeroes
+            # an otherwise complete artifact. Counted separately — a
+            # scrape retry must not read as a disturbed query POST.
+            with _RETRY_LOCK:
+                RETRIES["get"] += 1
+            self.conn.close()
+            self.conn = http.client.HTTPConnection(self.host, self.port)
+            return self._get_once(path)
+
+    def _get_once(self, path: str) -> str:
+        self.conn.request("GET", path)
+        return self.conn.getresponse().read().decode()
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+def parse_prometheus(text: str) -> dict:
+    """'name{tags} value' lines -> {full series name: float}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def phase_totals(metrics_text: str) -> tuple:
+    """(sums, counts) per phase from query_phase_seconds histograms,
+    merged across call tags."""
+    sums, counts = {}, {}
+    for k, v in parse_prometheus(metrics_text).items():
+        m = re.match(
+            r"pilosa_query_phase_seconds_(sum|count)\{.*?phase=\"([^\"]+)\"", k
+        )
+        if not m:
+            continue
+        d = sums if m.group(1) == "sum" else counts
+        d[m.group(2)] = d.get(m.group(2), 0.0) + v
+    return sums, counts
+
+
+def phase_means_ms(metrics_text: str, baseline: tuple = None) -> dict:
+    """{phase: mean ms per PROFILE SAMPLE} from the server's
+    query_phase_seconds histograms — the server-side half of the
+    phase-attribution leg. On the HTTP path one sample covers one whole
+    REQUEST (a 16-Count body or a batched-Set write is one sample), so
+    these means are per-request, not per-query — compare against request
+    latencies, never against a per-query figure.
+    The registry is process-global and cumulative, so callers sharing a
+    process with earlier profiled legs (bench_cpu/minmax run through the
+    same Executor) must pass the leg-start scrape as `baseline`; the
+    means are then computed over the diff (code review r6)."""
+    sums, counts = phase_totals(metrics_text)
+    if baseline is not None:
+        base_sums, base_counts = baseline
+        sums = {p: v - base_sums.get(p, 0.0) for p, v in sums.items()}
+        counts = {p: v - base_counts.get(p, 0.0) for p, v in counts.items()}
+    return {
+        p: round(1e3 * sums[p] / counts[p], 3)
+        for p in sums
+        if counts.get(p)
+    }
 
 
 def build_index(h: Holder):
@@ -160,6 +297,8 @@ def measure_rtt_floor() -> float:
 
 
 def bench_tpu(holder, queries) -> tuple[float, list[int], float, object]:
+    from pilosa_tpu.exec.tpu import TPUBackend
+
     be = TPUBackend(holder)
     shards = list(range(SHARDS))
     calls = [parse_string(q).calls[0].children[0] for q in queries]
@@ -217,18 +356,38 @@ def bench_sweep_device_only(be) -> float:
     return max(0.0, slopes[2])
 
 
-def bench_tpu_single(be, queries) -> tuple[float, float]:
-    """Unbatched: one dispatch + one scalar readback per query."""
+def bench_tpu_single(be, queries) -> tuple[float, float, dict, float]:
+    """Unbatched: one dispatch + one scalar readback per query. Each
+    query runs under a QueryProfile so the host cost decomposes into
+    named phases — the attribution of the 9 ms over-floor gap that r5
+    could not diagnose (ISSUE r6). Returns (p50, p99, mean phase ms
+    dict, mean total seconds); means (not medians) keep the phases
+    additive against the total."""
+    from pilosa_tpu.utils.qprofile import profile_scope
+
     shards = list(range(SHARDS))
     calls = [parse_string(q).calls[0].children[0] for q in queries[:LATENCY_N]]
     be.count_shards("bench", calls[0], shards)  # warm
     lat = []
+    phase_tot: dict = {}
     for c in calls:
         t0 = time.perf_counter()
-        be.count_shards("bench", c, shards)
+        with profile_scope(index="bench", call="Count") as prof:
+            be.count_shards("bench", c, shards)
         lat.append(time.perf_counter() - t0)
+        for k, v in prof.phases.items():
+            phase_tot[k] = phase_tot.get(k, 0.0) + v
+    mean_total = sum(lat) / len(lat)
+    phase_ms = {
+        k: round(v / len(calls) * 1e3, 3) for k, v in sorted(phase_tot.items())
+    }
     lat.sort()
-    return lat[len(lat) // 2], lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    return (
+        lat[len(lat) // 2],
+        lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        phase_ms,
+        mean_total,
+    )
 
 
 def bench_topn(be) -> float:
@@ -258,9 +417,10 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     (VERDICT r3 #1): every write starts a new epoch — the resident stack
     refreshes via a dirty-shard splice and the next batch re-sweeps —
     so QPS(W) is the sustained serving rate under churn, not a cache
-    artifact. Returns ({W: qps}, single-request p50 at W=0)."""
-    import http.client
-
+    artifact. Every client posts through BenchConn, so one transient
+    reset retries instead of zeroing the artifact (VERDICT r5 #1a).
+    Returns ({W: qps}, achieved rates, single-request p50 at W=0, and
+    the server-side telemetry scrape: per-phase means + abort count)."""
     from pilosa_tpu.server.api import API
     from pilosa_tpu.server.http import Server
 
@@ -269,15 +429,15 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     srv = Server(API(holder, ex), host="localhost", port=0).open()
     path = "/index/bench/query"
 
-    def post(conn, body: str) -> list:
-        conn.request("POST", path, body, {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        return json.loads(resp.read())["results"]
-
     per_req = HTTP_QUERIES_PER_REQ
     bodies = ["".join(queries[i : i + per_req]) for i in range(0, len(queries), per_req)]
-    warm = http.client.HTTPConnection("localhost", srv.port)
-    post(warm, bodies[0])  # warm: compile + upload through the serving path
+    warm = BenchConn("localhost", srv.port, path)
+    warm.post(bodies[0])  # warm: compile + upload through the serving path
+    # Leg-start histogram baseline: the registry is cumulative and this
+    # process already profiled the oracle/single/minmax legs — the HTTP
+    # breakdown must cover only what the serving path did from here on
+    # (the warm request's compile outlier is also excluded).
+    phase_base = phase_totals(warm.get_text("/metrics"))
 
     wcol = [0]  # distinct column per write: every Set is a real mutation
 
@@ -285,7 +445,7 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
         stop = threading.Event()
 
         def writer():
-            conn = http.client.HTTPConnection("localhost", srv.port)
+            conn = BenchConn("localhost", srv.port, path)
             rng = np.random.default_rng(99)
             # Batch Sets per request above ~50 writes/s: a sequential
             # one-Set-per-POST writer tops out near 100/s on this host,
@@ -307,7 +467,7 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
                     wcol[0] += 1
                     col = shard * SHARD_WIDTH + (wcol[0] % SHARD_WIDTH)
                     body.append(f"Set({col}, f={row})")
-                post(conn, "".join(body))
+                conn.post("".join(body))
             conn.close()
 
         wt = None
@@ -319,10 +479,10 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
         deadline = time.time() + seconds
 
         def client(k: int) -> None:
-            conn = http.client.HTTPConnection("localhost", srv.port)
+            conn = BenchConn("localhost", srv.port, path)
             j = k
             while time.time() < deadline:
-                post(conn, bodies[j % len(bodies)])
+                conn.post(bodies[j % len(bodies)])
                 counters[k] += per_req
                 j += 1
             conn.close()
@@ -353,12 +513,22 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     lat = []
     for q in queries[: max(5, LATENCY_N // 3)]:
         t0 = time.perf_counter()
-        post(warm, q)
+        warm.post(q)
         lat.append(time.perf_counter() - t0)
     lat.sort()
+    # Phase-attribution scrape: the server's own query_phase_seconds
+    # histograms + abort counter, read BEFORE teardown so the bench
+    # JSON carries the serving-path breakdown, not a guess.
+    metrics_text = warm.get_text("/metrics")
+    http_phase_ms = phase_means_ms(metrics_text, baseline=phase_base)
+    # The abort counter carries route/method tags: sum every series.
+    aborts = int(sum(
+        v for k, v in parse_prometheus(metrics_text).items()
+        if k.startswith("pilosa_http_connection_aborts_total")
+    ))
     warm.close()
     srv.close()
-    return qps_at_rate, achieved_rate, lat[len(lat) // 2]
+    return qps_at_rate, achieved_rate, lat[len(lat) // 2], http_phase_ms, aborts
 
 
 def bench_group_by(holder, be) -> tuple[float, float]:
@@ -462,12 +632,48 @@ def bench_cpu(holder, parsed_queries) -> float:
 
 
 def main():
+    out: dict = {
+        "partial": True,
+        "legs_done": [],
+        "config": {
+            "shards": SHARDS,
+            "columns": SHARDS * SHARD_WIDTH,
+            "rows_per_field": ROWS,
+            "density": DENSITY,
+            "batch": BATCH,
+            "write_rates": WRITE_RATES,
+        },
+    }
+
+    def write_artifact(blob: str) -> None:
+        """Atomic temp+rename: a crash DURING the leg-N+1 write must not
+        truncate the legs-1..N artifact it exists to preserve."""
+        try:
+            tmp = PARTIAL_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(blob + "\n")
+            os.replace(tmp, PARTIAL_PATH)
+        except OSError:
+            pass
+
+    def checkpoint(leg: str, **kv) -> None:
+        """Capture-proof artifact (VERDICT r5 next-round #1b): rewrite
+        the accumulated results after EVERY completed leg — a crash in
+        leg N+1 leaves legs 1..N parseable in BENCH_partial.json (and
+        on stderr) instead of a parsed=null artifact."""
+        out.update(kv)
+        out["legs_done"].append(leg)
+        blob = json.dumps(out)
+        write_artifact(blob)
+        print(blob, file=sys.stderr, flush=True)
+
     h = Holder(None)  # in-memory: bench measures query path, not disk
     h.open()
     t_build = time.time()
     build_index(h)
     t_build = time.time() - t_build
     build_bsi_field(h)
+    checkpoint("build", build_seconds=round(t_build, 1))
 
     rng = np.random.default_rng(7)
     queries = [
@@ -477,8 +683,19 @@ def main():
     parsed = [parse_string(q) for q in queries]
 
     rtt_floor = measure_rtt_floor()
+    checkpoint("rtt_floor", relay_rtt_floor_ms=round(rtt_floor * 1e3, 2))
     cpu_qps = bench_cpu(h, parsed)
+    checkpoint(
+        "cpu_oracle",
+        baseline="numpy_oracle_cpu_threadpool (NOT Go/roaring; see BASELINE.md)",
+        baseline_qps=round(cpu_qps, 2),
+    )
     tpu_qps, tpu_first, sweep_ms, be = bench_tpu(h, queries)
+    checkpoint(
+        "tpu_batch",
+        cache_hit_resolve_qps=round(tpu_qps, 1),
+        cold_sweep_ms=round(sweep_ms, 2),
+    )
 
     # Correctness cross-check BEFORE the churn legs mutate the index:
     # TPU batch results must equal the CPU oracle on the same snapshot.
@@ -486,23 +703,6 @@ def main():
     for i in sorted({0, BATCH // 2, BATCH - 1}):
         want = ex.execute("bench", queries[i])[0]
         assert tpu_first[i] == want, (i, tpu_first[i], want)
-
-    sweep_dev_s = bench_sweep_device_only(be)
-    # Floor re-measured ADJACENT to the single-query leg: the relay RTT
-    # drifts over minutes, so a start-of-bench floor makes the delta a
-    # drift artifact (VERDICT r4 #8 — the honest number is p50 minus a
-    # floor captured under the same network conditions).
-    rtt_floor_adjacent = measure_rtt_floor()
-    p50, p99 = bench_tpu_single(be, queries)
-    topn_p50 = bench_topn(be)
-    # GroupBy BEFORE the churn legs: its cold figure is the h-stack
-    # pack + upload + tri-program compile — measured after churn it
-    # also absorbed a full f-stack rebuild (hundreds of dirtied shards)
-    # and read as 3x worse than a real cold start.
-    groupby_cold_s, groupby_warm_s = bench_group_by(h, be)
-    mm_ro, mm_churn, mm_wrate = bench_minmax_churn(h, be)
-    qps_at_rate, achieved_rate, http_p50 = bench_http(h, be, queries)
-    http_qps = qps_at_rate.get("0", next(iter(qps_at_rate.values())))
 
     # Roofline: logical bytes each query's AND+popcount would touch in a
     # naive per-query gather (2 rows x shards x 128 KiB); the pair sweep
@@ -512,54 +712,96 @@ def main():
     # chip's HBM roofline — the r3 cache-amplified figure is deleted.
     bytes_per_query = 2 * SHARDS * WORDS * 4
     sweep_bytes = 2 * SHARDS * ROWS * WORDS * 4
-
-    print(
-        json.dumps(
-            {
-                "metric": "intersect_count_qps_http",
-                "value": http_qps,
-                "unit": "queries/s",
-                "vs_baseline": round(http_qps / cpu_qps, 2) if cpu_qps else None,
-                "baseline": "numpy_oracle_cpu_threadpool (NOT Go/roaring; see BASELINE.md)",
-                "baseline_qps": round(cpu_qps, 2),
-                "qps_at_write_rate": qps_at_rate,
-                "write_rate_achieved": achieved_rate,
-                "cache_hit_resolve_qps": round(tpu_qps, 1),
-                "cold_sweep_ms": round(sweep_ms, 2),
-                "sweep_ms_device_only": round(sweep_dev_s * 1e3, 2),
-                "hbm_sweep_gbps": round(sweep_bytes / sweep_dev_s / 1e9, 1)
-                if sweep_dev_s > 0
-                else None,
-                "relay_rtt_floor_ms": round(rtt_floor * 1e3, 2),
-                "http_single_p50_ms": round(http_p50 * 1e3, 2),
-                "single_query_p50_ms": round(p50 * 1e3, 2),
-                "single_query_over_floor_ms": round(
-                    (p50 - rtt_floor_adjacent) * 1e3, 2
-                ),
-                "single_query_p99_ms": round(p99 * 1e3, 2),
-                "topn_p50_ms": round(topn_p50 * 1e3, 2),
-                "groupby_3field_cold_s": round(groupby_cold_s, 2),
-                "groupby_3field_warm_ms": round(groupby_warm_s * 1e3, 1),
-                "minmax_qps_read_only": round(mm_ro, 1),
-                "minmax_qps_at_write_100": round(mm_churn, 1),
-                "minmax_churn_qps_ratio": round(mm_churn / mm_ro, 3)
-                if mm_ro
-                else None,
-                "minmax_write_rate_achieved": round(mm_wrate, 1),
-                "bytes_touched_per_query_logical": bytes_per_query,
-                "bytes_touched_per_query_physical": sweep_bytes // BATCH,
-                "build_seconds": round(t_build, 1),
-                "config": {
-                    "shards": SHARDS,
-                    "columns": SHARDS * SHARD_WIDTH,
-                    "rows_per_field": ROWS,
-                    "density": DENSITY,
-                    "batch": BATCH,
-                    "write_rates": WRITE_RATES,
-                },
-            }
-        )
+    sweep_dev_s = bench_sweep_device_only(be)
+    checkpoint(
+        "sweep_device_only",
+        sweep_ms_device_only=round(sweep_dev_s * 1e3, 2),
+        hbm_sweep_gbps=round(sweep_bytes / sweep_dev_s / 1e9, 1)
+        if sweep_dev_s > 0
+        else None,
+        bytes_touched_per_query_logical=bytes_per_query,
+        bytes_touched_per_query_physical=sweep_bytes // BATCH,
     )
+    # Floor re-measured ADJACENT to the single-query leg: the relay RTT
+    # drifts over minutes, so a start-of-bench floor makes the delta a
+    # drift artifact (VERDICT r4 #8 — the honest number is p50 minus a
+    # floor captured under the same network conditions).
+    rtt_floor_adjacent = measure_rtt_floor()
+    p50, p99, single_phase_ms, single_mean_s = bench_tpu_single(be, queries)
+    # Over-floor attribution: the phases sum to ~the whole query (the
+    # readback phase carries the floor), so named-phase coverage of the
+    # over-floor gap is (sum(phases) - floor) / (mean - floor). ≥80% is
+    # the ISSUE r6 acceptance bar; the remainder is inter-phase glue.
+    floor_ms = rtt_floor_adjacent * 1e3
+    phase_sum_ms = sum(single_phase_ms.values())
+    over_floor_ms = single_mean_s * 1e3 - floor_ms
+    attributed_pct = (
+        round(
+            100.0
+            * min(1.0, max(0.0, phase_sum_ms - floor_ms) / over_floor_ms),
+            1,
+        )
+        if over_floor_ms > 0
+        else None
+    )
+    checkpoint(
+        "single_query",
+        single_query_p50_ms=round(p50 * 1e3, 2),
+        single_query_over_floor_ms=round((p50 - rtt_floor_adjacent) * 1e3, 2),
+        single_query_p99_ms=round(p99 * 1e3, 2),
+        single_query_phase_ms=single_phase_ms,
+        single_query_attributed_pct=attributed_pct,
+    )
+    topn_p50 = bench_topn(be)
+    checkpoint("topn", topn_p50_ms=round(topn_p50 * 1e3, 2))
+    # GroupBy BEFORE the churn legs: its cold figure is the h-stack
+    # pack + upload + tri-program compile — measured after churn it
+    # also absorbed a full f-stack rebuild (hundreds of dirtied shards)
+    # and read as 3x worse than a real cold start.
+    groupby_cold_s, groupby_warm_s = bench_group_by(h, be)
+    checkpoint(
+        "groupby",
+        groupby_3field_cold_s=round(groupby_cold_s, 2),
+        groupby_3field_warm_ms=round(groupby_warm_s * 1e3, 1),
+    )
+    mm_ro, mm_churn, mm_wrate = bench_minmax_churn(h, be)
+    checkpoint(
+        "minmax_churn",
+        minmax_qps_read_only=round(mm_ro, 1),
+        minmax_qps_at_write_100=round(mm_churn, 1),
+        minmax_churn_qps_ratio=round(mm_churn / mm_ro, 3) if mm_ro else None,
+        minmax_write_rate_achieved=round(mm_wrate, 1),
+    )
+    qps_at_rate, achieved_rate, http_p50, http_phase_ms, aborts = bench_http(
+        h, be, queries
+    )
+    http_qps = qps_at_rate.get("0", next(iter(qps_at_rate.values())))
+    checkpoint(
+        "http",
+        qps_at_write_rate=qps_at_rate,
+        write_rate_achieved=achieved_rate,
+        http_single_p50_ms=round(http_p50 * 1e3, 2),
+        # Per-REQUEST means (one profile per request; requests carry 16
+        # queries or batched writes) — named so it can't be misread as a
+        # per-query figure against http_single_p50_ms.
+        http_phase_per_request_ms=http_phase_ms,
+        http_post_retries=RETRIES["post"],
+        http_get_retries=RETRIES["get"],
+        http_connection_aborts=aborts,
+    )
+
+    out.update(
+        {
+            "metric": "intersect_count_qps_http",
+            "value": http_qps,
+            "unit": "queries/s",
+            "vs_baseline": round(http_qps / cpu_qps, 2) if cpu_qps else None,
+            "partial": False,
+        }
+    )
+    blob = json.dumps(out)
+    write_artifact(blob)  # artifact file ends complete, not mid-checkpoint
+    print(blob)
 
 
 if __name__ == "__main__":

@@ -81,13 +81,16 @@ class View:
         # bench minmax churn leg's dominant cost (r5).
         self._journal: deque = deque()
         self._journal_floor = 0  # newest generation ever evicted
-        # Journal-ONLY lock (never nested with view.lock or any
-        # fragment lock, so no ordering hazard): writers append under
-        # their per-fragment locks only, and an unlocked reader could
-        # miss a dirty shard (two writers can append out of generation
-        # order, breaking the reader's early-exit) or crash iterating
-        # a mutating deque — both would silently or loudly break the
-        # exactness invariant (code review r5).
+        # Journal lock invariant (ADVICE r5): this is a strict LEAF
+        # acquired while HOLDING other locks — fragment writers call
+        # _bump_data under their fr.lock, and create/delete_fragment
+        # under view.lock — and nothing ever acquires another lock while
+        # holding it, which is what keeps the nesting deadlock-free.
+        # It exists because an unlocked reader could miss a dirty shard
+        # (two writers can append out of generation order, breaking the
+        # reader's early-exit) or crash iterating a mutating deque —
+        # both would silently or loudly break the exactness invariant
+        # (code review r5).
         self._journal_lock = threading.Lock()
 
     JOURNAL_MAX = 512

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Optional
 
+from pilosa_tpu.utils.qprofile import current_profile
 from pilosa_tpu.utils.stats import global_stats
 
 
@@ -75,7 +76,11 @@ class CountBatcher:
                 self._leader_active = True
         if am_leader:
             self._drain(leader_call=True)
-        item.event.wait()
+        # Telemetry: a follower's whole cost is this wait (the leader's
+        # dispatch work self-attributes inside count_batch_async); for
+        # the leader the event is already set and the phase is ~0.
+        with current_profile().phase("batch_wait"):
+            item.event.wait()
         if item.error is not None:
             raise item.error
         return item.result  # type: ignore[return-value]

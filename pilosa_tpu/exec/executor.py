@@ -34,6 +34,7 @@ from pilosa_tpu.exec.result import (
 from pilosa_tpu.pql import Call, Condition, Query, parse_string
 from pilosa_tpu.pql.ast import is_reserved_arg
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.qprofile import profile_scope
 from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.utils.tracing import global_tracer
 
@@ -90,9 +91,26 @@ class Executor:
         shards: Optional[list[int]] = None,
         opt: Optional[ExecOptions] = None,
     ) -> list[Any]:
+        # Query-lifecycle telemetry: reuse the HTTP layer's profile when
+        # one is active (the common serving path), else own a fresh one
+        # so direct API/executor callers still land in /debug/queries.
+        with profile_scope(
+            index=index, query=query if isinstance(query, str) else ""
+        ) as prof:
+            return self._execute_profiled(index, query, shards, opt, prof)
+
+    def _execute_profiled(
+        self,
+        index: str,
+        query: Union[str, Query],
+        shards: Optional[list[int]],
+        opt: Optional[ExecOptions],
+        prof,
+    ) -> list[Any]:
         opt = opt or ExecOptions()
         if isinstance(query, str):
-            query = parse_string(query)
+            with prof.phase("parse"):
+                query = parse_string(query)
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError(f"index not found: {index}")
@@ -105,6 +123,8 @@ class Executor:
         stats = self.stats.with_tags(f"index:{index}")
         results = []
         translate = self._needs_translation(idx)
+        if query.calls and not prof.call:
+            prof.call = query.calls[0].name
         with self.tracer.start_span("executor.Execute") as span:
             span.set_tag("index", index)
             calls = query.calls
@@ -129,11 +149,12 @@ class Executor:
                     batch = calls[i : i + run]
                     stats.count("query_Count_total", run)
                     if not opt.remote:
-                        batch = [
-                            self._translate_call(idx, b)
-                            if translate or b.has_str_args else b
-                            for b in batch
-                        ]
+                        with prof.phase("key_translate"):
+                            batch = [
+                                self._translate_call(idx, b)
+                                if translate or b.has_str_args else b
+                                for b in batch
+                            ]
                     with self.tracer.start_span("executor.executeCountBatch"):
                         inner = [b.children[0] for b in batch]
                         sh = self._shards(index, shards)
@@ -150,18 +171,29 @@ class Executor:
                 # are returned raw; translation happens only at the
                 # coordinator (reference executor.go:121-127).
                 if not opt.remote and (translate or call.has_str_args):
-                    call = self._translate_call(idx, call)
+                    with prof.phase("key_translate"):
+                        call = self._translate_call(idx, call)
                 with self.tracer.start_span(f"executor.execute{call.name}"):
                     result = self.execute_call(index, call, shards, opt)
                 if not opt.remote:
-                    result = self._translate_result(idx, call, result)
+                    with prof.phase("key_translate"):
+                        result = self._translate_result(idx, call, result)
                 results.append(result)
                 i += 1
+            # Phase breakdown on the executor span so /debug/traces shows
+            # where each trace's time went (serialize happens above this
+            # span and lands only in /metrics + /debug/queries).
+            span.set_tag("qid", prof.qid)
+            span.set_tag("phasesMs", prof.phases_ms())
         elapsed = _time.perf_counter() - t0
         stats.timing("execute_duration_seconds", elapsed)
         if elapsed > self.long_query_time and self.logger is not None:
-            # reference api.go:1157 long-query log.
-            self.logger.printf("%.3fs longQueryTime exceeded: %r", elapsed, query)
+            # reference api.go:1157 long-query log, now with the phase
+            # breakdown so a slow query arrives pre-diagnosed.
+            self.logger.printf(
+                "%.3fs longQueryTime exceeded: %r [qid=%d %s]",
+                elapsed, query, prof.qid, prof.phase_summary(),
+            )
         return results
 
     # ------------------------------------------------------------------

@@ -79,6 +79,7 @@ from pilosa_tpu.ops.sparse import (
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.qprofile import current_profile
 from pilosa_tpu.utils.stats import global_stats
 
 _DEVICE_LOWERED = ("Row", "Range", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift")
@@ -877,23 +878,38 @@ class TPUBackend:
             raise NotFoundError(f"field not found: {name}")
         return f
 
+    def _count_version_walk(self, kind: str, tier: str, n_shards: int) -> None:
+        """Freshness-walk attribution (ISSUE r6): every per-shard version
+        read is counted so the O(S) full walks at 954 shards are visible
+        on /metrics (version_walk_total / version_walk_shards_total,
+        tagged kind=full|journal and the stats tier that paid for it)
+        and in the active query's /debug/queries counters. The journal
+        tier's shard count is the dirty set — the O(dirty) claim the
+        bench and tests assert instead of assuming."""
+        st = self.stats.with_tags(f"kind:{kind}", f"tier:{tier}")
+        st.count("version_walk_total")
+        st.count("version_walk_shards_total", n_shards)
+        prof = current_profile()
+        prof.incr(f"version_walk_{kind}")
+        prof.incr(f"version_walk_{kind}_shards", n_shards)
+
     def _confirm_vers(self, field_obj, shards_t, recorded,
-                      view_name=VIEW_STANDARD):
+                      view_name=VIEW_STANDARD, tier="other"):
         """Post-capture version confirmation: any shard whose live
         (uid, version) moved past the recorded capture version gets
         _VERS_STALE, so the next epoch slab-rederives it instead of
         delta-replaying ops onto content that may already include them
         (sweeps/stack builds read fragment content after reading
         versions; the window is small but real under churn)."""
-        live = self._live_versions(field_obj, shards_t, view_name)
+        live = self._live_versions(field_obj, shards_t, view_name, tier=tier)
         if live == recorded:
             return recorded
         return tuple(
             r if r == l else _VERS_STALE for r, l in zip(recorded, live)
         )
 
-    @staticmethod
-    def _live_versions(field_obj, shards_t, view_name=VIEW_STANDARD):
+    def _live_versions(self, field_obj, shards_t, view_name=VIEW_STANDARD,
+                       tier="other"):
         """Per-shard (uid, version) read straight from the live fragments
         — the write-epoch key the host stats caches compare against.
         Reading the LIVE versions (not the resident stack's) is what lets
@@ -906,18 +922,28 @@ class TPUBackend:
         return a pre-write version for post-write content. Locked reads
         serialize with the writer, which makes _confirm_vers (built on
         this) a true post-capture barrier — a capture that raced a write
-        is always seen as moved and recorded _VERS_STALE."""
+        is always seen as moved and recorded _VERS_STALE.
+
+        This is the FULL walk — O(len(shards_t)) locked reads — and is
+        counted as such per tier (by locked reads actually taken, so a
+        missing view or absent fragments don't inflate the accounting);
+        _epoch_versions is the journal-backed O(dirty) alternative for
+        epoch updates."""
         v = field_obj.view(view_name)
         if v is None:
+            self._count_version_walk("full", tier, 0)
             return tuple(None for _ in shards_t)
         out = []
+        n_read = 0
         for s in shards_t:
             fr = v.fragment(s)
             if fr is None:
                 out.append(None)
             else:
+                n_read += 1
                 with fr.lock:
                     out.append((fr.uid, fr.version))
+        self._count_version_walk("full", tier, n_read)
         return tuple(out)
 
     def _build(self, index: str, c: Call, shards: tuple[int, ...],
@@ -1346,32 +1372,37 @@ class TPUBackend:
         else:
             shards_t = tuple(shards)
             positions = list(range(len(shards)))
+        prof = current_profile()
         try:
-            spec, blocks, scalars = self._assemble(index, c, shards_t)
+            with prof.phase("plan"):
+                spec, blocks, scalars = self._assemble(index, c, shards_t)
         except _Unsupported:
             out = Row()
             for s in shards:
                 out.merge(self.cpu.bitmap_call_shard(index, c, s))
             return out
-        with jax.profiler.TraceAnnotation("pilosa.bitmap_call"):
+        with jax.profiler.TraceAnnotation("pilosa.bitmap_call"), prof.phase(
+            "device_dispatch"
+        ):
             slab = self._program("vec", spec, False)(blocks, scalars)
         # Subset requests gather on device first: reading the whole
         # [S_pad, W] slab back for one shard would move ~120 MB over the
         # relay link when 128 KiB is needed.
-        if len(positions) * 4 <= slab.shape[0]:
-            slab = slab[jnp.asarray(positions, dtype=jnp.int32)]
-            host = np.asarray(slab)  # [len(positions), W]
-            rows = zip(range(len(positions)), shards)
-        else:
-            host = np.asarray(slab)  # [S_pad, W], one readback
-            rows = zip(positions, shards)
-        out = Row()
-        for pos, s in rows:
-            words = host[pos]
-            if not words.any():
-                continue
-            out.merge(Row.from_segment(s, Bitmap(unpack_row(words))))
-        return out
+        with prof.phase("host_reduce"):
+            if len(positions) * 4 <= slab.shape[0]:
+                slab = slab[jnp.asarray(positions, dtype=jnp.int32)]
+                host = np.asarray(slab)  # [len(positions), W]
+                rows = zip(range(len(positions)), shards)
+            else:
+                host = np.asarray(slab)  # [S_pad, W], one readback
+                rows = zip(positions, shards)
+            out = Row()
+            for pos, s in rows:
+                words = host[pos]
+                if not words.any():
+                    continue
+                out.merge(Row.from_segment(s, Bitmap(unpack_row(words))))
+            return out
 
     def count_shard(self, index: str, c: Call, shard: int) -> int:
         return self.count_shards(index, c, [shard])
@@ -1380,16 +1411,25 @@ class TPUBackend:
         """Whole-query count: ONE jitted dispatch over all shards + one
         scalar readback — the reference's scatter-gather mapReduce
         collapsed into device arithmetic (BASELINE.json north star)."""
+        prof = current_profile()
         try:
-            spec, blocks, scalars = self._assemble(index, c, tuple(shards))
+            with prof.phase("plan"):
+                spec, blocks, scalars = self._assemble(
+                    index, c, tuple(shards)
+                )
         except _Unsupported:
             return sum(self.cpu.count_shard(index, c, s) for s in shards)
         s_pad = blocks[0].shape[0]
         reduce_dev = s_pad <= MAX_DEVICE_SUM_SHARDS
-        with jax.profiler.TraceAnnotation("pilosa.count"):
+        with jax.profiler.TraceAnnotation("pilosa.count"), prof.phase(
+            "device_dispatch"
+        ):
             partials = self._program("count", spec, reduce_dev)(blocks, scalars)
-        # Host sum in Python ints: exact for any shard count.
-        return int(np.asarray(partials, dtype=np.uint64).sum())
+        # Host sum in Python ints: exact for any shard count. The
+        # readback (np.asarray) blocks on the device round trip, so this
+        # phase carries the relay RTT floor — the bench subtracts it.
+        with prof.phase("host_reduce"):
+            return int(np.asarray(partials, dtype=np.uint64).sum())
 
     def count_batch(self, index: str, calls: list[Call], shards: list[int]) -> list[int]:
         """Q count queries in one (or few) dispatches; see count_batch_async."""
@@ -1638,29 +1678,30 @@ class TPUBackend:
         # missing the same epoch would each redo the same host update on
         # this one-core host — the herd ran the dirty set away into
         # repeated device sweeps at 100 writes/s.
-        while True:
-            fv = f_obj.view(VIEW_STANDARD)
-            gv = g_obj.view(VIEW_STANDARD)
-            gen_f = fv.generation if fv is not None else -1
-            gen_g = gv.generation if gv is not None else -1
-            with self._pair_lock:
-                hit = self._pair_cache.get(ckey)
-                if (
-                    hit is not None
-                    and hit.shards == shards_t
-                    and hit.gen_f == gen_f
-                    and hit.gen_g == gen_g
-                ):
-                    self._pair_cache[ckey] = self._pair_cache.pop(ckey)  # LRU
-                    self.stats.count("pair_stats_cache_hits_total")
-                    return functools.partial(
-                        self._pair_fetch, entries, hit, hit.rf, hit.rg
-                    )
-                latch = self._stats_updating.get(ckey)
-                if latch is None:
-                    self._stats_updating[ckey] = threading.Event()
-                    break
-            latch.wait(timeout=60)
+        with current_profile().phase("freshness"):
+            while True:
+                fv = f_obj.view(VIEW_STANDARD)
+                gv = g_obj.view(VIEW_STANDARD)
+                gen_f = fv.generation if fv is not None else -1
+                gen_g = gv.generation if gv is not None else -1
+                with self._pair_lock:
+                    hit = self._pair_cache.get(ckey)
+                    if (
+                        hit is not None
+                        and hit.shards == shards_t
+                        and hit.gen_f == gen_f
+                        and hit.gen_g == gen_g
+                    ):
+                        self._pair_cache[ckey] = self._pair_cache.pop(ckey)  # LRU
+                        self.stats.count("pair_stats_cache_hits_total")
+                        return functools.partial(
+                            self._pair_fetch, entries, hit, hit.rf, hit.rg
+                        )
+                    latch = self._stats_updating.get(ckey)
+                    if latch is None:
+                        self._stats_updating[ckey] = threading.Event()
+                        break
+                latch.wait(timeout=60)
         try:
             return self._pair_refresh(
                 index, entries, fa, fb, f_obj, g_obj, shards_t,
@@ -1680,11 +1721,16 @@ class TPUBackend:
         updater role makes store-time re-validation unnecessary."""
         # Walk the per-shard versions — the fine-grained diff that tells
         # dirty shards apart from writes outside the queried set.
-        vers_f = self._live_versions(f_obj, shards_t)
-        vers_g = vers_f if fb == fa else self._live_versions(g_obj, shards_t)
-        ent = self._pair_try_incremental(
-            hit, f_obj, g_obj, shards_t, gen_f, gen_g, vers_f, vers_g
-        )
+        prof = current_profile()
+        with prof.phase("freshness"):
+            vers_f = self._live_versions(f_obj, shards_t, tier="pair")
+            vers_g = (
+                vers_f if fb == fa
+                else self._live_versions(g_obj, shards_t, tier="pair")
+            )
+            ent = self._pair_try_incremental(
+                hit, f_obj, g_obj, shards_t, gen_f, gen_g, vers_f, vers_g
+            )
         if ent is not None:
             with self._pair_lock:
                 self._pair_cache.pop(ckey, None)
@@ -1694,13 +1740,16 @@ class TPUBackend:
             )
 
         # Sweep path: fetch (build/splice) the stacks, then one dispatch.
-        fblock, _, bvers_f = self._get_block_with_versions(index, f_obj, shards_t)
-        if fb == fa:
-            gblock, bvers_g = fblock, bvers_f
-        else:
-            gblock, _, bvers_g = self._get_block_with_versions(
-                index, g_obj, shards_t
+        with prof.phase("stack_fetch"):
+            fblock, _, bvers_f = self._get_block_with_versions(
+                index, f_obj, shards_t
             )
+            if fb == fa:
+                gblock, bvers_g = fblock, bvers_f
+            else:
+                gblock, _, bvers_g = self._get_block_with_versions(
+                    index, g_obj, shards_t
+                )
         rf, rg = fblock.shape[1], gblock.shape[1]
         reason, pershard_ok = self._pair_gates(fblock.shape[0], rf, rg)
         if reason is not None:
@@ -1715,16 +1764,19 @@ class TPUBackend:
         # batches and the single-flight waiters share this one sweep
         # instead of each missing until the first resolver lands.
         self.stats.count("pair_stats_sweeps_total")
-        with jax.profiler.TraceAnnotation("pilosa.pair_stats"):
+        with jax.profiler.TraceAnnotation("pilosa.pair_stats"), prof.phase(
+            "device_dispatch"
+        ):
             flat = self._pair_program(pershard=pershard_ok)(fblock, gblock)
         # Shards whose fragments moved during the stack build/dispatch
         # record _VERS_STALE (see _confirm_vers): the swept content for
         # them is ambiguous relative to any version we could record.
-        vers_f = self._confirm_vers(f_obj, shards_t, vers_f)
-        vers_g = (
-            vers_f if fb == fa
-            else self._confirm_vers(g_obj, shards_t, vers_g)
-        )
+        with prof.phase("freshness"):
+            vers_f = self._confirm_vers(f_obj, shards_t, vers_f, tier="pair")
+            vers_g = (
+                vers_f if fb == fa
+                else self._confirm_vers(g_obj, shards_t, vers_g, tier="pair")
+            )
         ent = _PairEntry(shards_t, rf, rg, flat, None,
                          gen_f, gen_g, vers_f, vers_g)
         with self._pair_lock:
@@ -1938,6 +1990,10 @@ class TPUBackend:
     def _pair_fetch(self, entries, ent, rf, rg) -> list[int]:
         """Resolve stats (device array on first touch, host np after) and
         derive the batch's counts."""
+        with current_profile().phase("host_reduce"):
+            return self._pair_fetch_inner(entries, ent, rf, rg)
+
+    def _pair_fetch_inner(self, entries, ent, rf, rg) -> list[int]:
         stats = ent.stats
         if not isinstance(stats, np.ndarray):
             raw = np.asarray(stats)  # ONE readback for all stats
@@ -2439,7 +2495,9 @@ class TPUBackend:
                     daemon=True, name="groupn-prewarm",
                 )
                 prewarm.start()
-            live = [self._live_versions(f, shards_t) for f in fobjs]
+            live = [
+                self._live_versions(f, shards_t, tier="groupn") for f in fobjs
+            ]
             upd = self._groupn_try_incremental(hit, fobjs, views, shards_t, live)
             if upd is not None:
                 pershard, vers_rec, rs, totals = upd
@@ -2539,7 +2597,7 @@ class TPUBackend:
         # The sweep read stack content packed at-or-after the recorded
         # versions: stale out any shard that moved (see _confirm_vers).
         vers_rec = tuple(
-            self._confirm_vers(f, shards_t, verss[i])
+            self._confirm_vers(f, shards_t, verss[i], tier="groupn")
             for i, f in enumerate(fobjs)
         )
         ent = _GroupNEntry(cfp, totals, pershard, rs, vers_rec)
@@ -2771,22 +2829,24 @@ class TPUBackend:
     def _generic_batch_dispatch(self, index, calls, shards_t):
         """Group same-(spec, leaf-blocks) calls into fused scan dispatches:
         row ids become [Q] traced vectors, one program per group."""
+        prof = current_profile()
         results: list[Optional[int]] = [None] * len(calls)
         groups: dict = {}
         assembled: dict[int, tuple] = {}
         fallbacks: list[int] = []
-        for i, c in enumerate(calls):
-            try:
-                spec, blocks, scalars = self._assemble(index, c, shards_t)
-            except _Unsupported:
-                fallbacks.append(i)
-                continue
-            # Blocks are cache-owned arrays, so identity keys the group:
-            # same spec shape with different views/fields means different
-            # block objects and must not share one dispatch.
-            key = (spec, tuple(id(b) for b in blocks))
-            groups.setdefault(key, []).append(i)
-            assembled[i] = (blocks, scalars)
+        with prof.phase("plan"):
+            for i, c in enumerate(calls):
+                try:
+                    spec, blocks, scalars = self._assemble(index, c, shards_t)
+                except _Unsupported:
+                    fallbacks.append(i)
+                    continue
+                # Blocks are cache-owned arrays, so identity keys the
+                # group: same spec shape with different views/fields means
+                # different block objects and must not share one dispatch.
+                key = (spec, tuple(id(b) for b in blocks))
+                groups.setdefault(key, []).append(i)
+                assembled[i] = (blocks, scalars)
         pending = []
         for (spec, _bk), idxs in groups.items():
             blocks = assembled[idxs[0]][0]
@@ -2798,7 +2858,9 @@ class TPUBackend:
                 # SAME program over the same blocks (e.g. Count(All())
                 # repeated) — one fused count serves them all; a scan
                 # over a zero-leaf pytree has no query axis to scan.
-                with jax.profiler.TraceAnnotation("pilosa.count_batch"):
+                with jax.profiler.TraceAnnotation(
+                    "pilosa.count_batch"
+                ), prof.phase("device_dispatch"):
                     out = self._program("count", spec, reduce_dev)(blocks, ())
                 pending.append((idxs, out, True))
                 continue
@@ -2808,22 +2870,25 @@ class TPUBackend:
                 )
                 for j in range(n_scalars)
             )
-            with jax.profiler.TraceAnnotation("pilosa.count_batch"):
+            with jax.profiler.TraceAnnotation(
+                "pilosa.count_batch"
+            ), prof.phase("device_dispatch"):
                 out = self._program("count_batch", spec, reduce_dev)(blocks, scalars)
             pending.append((idxs, out, False))
 
         def resolve() -> list[int]:
-            for idxs, out, shared in pending:
-                arr = np.asarray(out, dtype=np.uint64)
-                if shared:
-                    val = int(arr.sum())  # scalar, or [S] partials
-                    for i in idxs:
-                        results[i] = val
-                    continue
-                if arr.ndim == 2:  # [Q, S] partials past the device-sum bound
-                    arr = arr.sum(axis=1)
-                for j, i in enumerate(idxs):
-                    results[i] = int(arr[j])
+            with current_profile().phase("host_reduce"):
+                for idxs, out, shared in pending:
+                    arr = np.asarray(out, dtype=np.uint64)
+                    if shared:
+                        val = int(arr.sum())  # scalar, or [S] partials
+                        for i in idxs:
+                            results[i] = val
+                        continue
+                    if arr.ndim == 2:  # [Q, S] partials past device-sum bound
+                        arr = arr.sum(axis=1)
+                    for j, i in enumerate(idxs):
+                        results[i] = int(arr[j])
             for i in fallbacks:
                 results[i] = self.count_shards(index, calls[i], list(shards_t))
             return results  # type: ignore[return-value]
@@ -2892,8 +2957,9 @@ class TPUBackend:
         try:
             # Generation moved: try the host table update against LIVE
             # fragment versions — no stack fetch, no device round trip.
-            live_vers = self._live_versions(f, shards_t)
-            upd = self._topn_try_incremental(f, hit, shards_t, live_vers)
+            with current_profile().phase("freshness"):
+                live_vers = self._live_versions(f, shards_t, tier="topn")
+                upd = self._topn_try_incremental(f, hit, shards_t, live_vers)
             if upd is not None:
                 pershard, vers_rec = upd
                 counts = pershard.sum(axis=0).astype(np.uint64)
@@ -2943,7 +3009,7 @@ class TPUBackend:
         if ckey is not None:
             # Dispatch read the stack content after the versions: stale
             # out any shard that moved meanwhile (see _confirm_vers).
-            vers = self._confirm_vers(f, shards_t, vers)
+            vers = self._confirm_vers(f, shards_t, vers, tier="topn")
             with self._pair_lock:
                 self._topn_cache[ckey] = (cfp, counts, pershard, vers)
                 while len(self._topn_cache) > MAX_PAIR_CACHE_ENTRIES:
@@ -3142,7 +3208,10 @@ class TPUBackend:
         if hit is not None and hit[1] is not None:
             return hit[1]
         if hit is not None:
-            upd = self._sum_try_incremental(index, field_name, shards, hit[0])
+            with current_profile().phase("freshness"):
+                upd = self._sum_try_incremental(
+                    index, field_name, shards, hit[0]
+                )
             if upd is not None:
                 return upd
         pre_vers = None
@@ -3151,25 +3220,32 @@ class TPUBackend:
             f0 = idx0.field(field_name) if idx0 else None
             if f0 is not None:
                 pre_vers = self._live_versions(
-                    f0, tuple(shards), bsi_view_name(field_name)
+                    f0, tuple(shards), bsi_view_name(field_name), tier="sum"
                 )
+        prof = current_profile()
         try:
-            f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
-                index, field_name, shards, filter_call
-            )
+            with prof.phase("stack_fetch"):
+                f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
+                    index, field_name, shards, filter_call
+                )
         except _Unsupported:
             return None
         if bsi_block.shape[0] > MAX_DEVICE_SUM_SHARDS:
             return None
         depth = opts.bit_depth
-        with jax.profiler.TraceAnnotation("pilosa.bsi_sum"):
+        with jax.profiler.TraceAnnotation("pilosa.bsi_sum"), prof.phase(
+            "device_dispatch"
+        ):
             pos_c, neg_c, cnt = self._program(
                 "bsi_sum", spec, True, extra=depth
             )(bsi_block, blocks, scalars)
-        pos_c = np.asarray(pos_c, dtype=np.uint64)
-        neg_c = np.asarray(neg_c, dtype=np.uint64)
-        total = sum((int(pos_c[i]) - int(neg_c[i])) << i for i in range(depth))
-        count = int(cnt)
+        with prof.phase("host_reduce"):
+            pos_c = np.asarray(pos_c, dtype=np.uint64)
+            neg_c = np.asarray(neg_c, dtype=np.uint64)
+            total = sum(
+                (int(pos_c[i]) - int(neg_c[i])) << i for i in range(depth)
+            )
+            count = int(cnt)
         result = (total + opts.base * count, count)
         if hit is not None:
             extra = None
@@ -3178,7 +3254,8 @@ class TPUBackend:
                 # get _VERS_STALE): recorded versions never describe
                 # older content than swept — the delta tier requires it.
                 vers = self._confirm_vers(
-                    f, tuple(shards), pre_vers, bsi_view_name(field_name)
+                    f, tuple(shards), pre_vers, bsi_view_name(field_name),
+                    tier="sum",
                 )
                 extra = (total, count, vers)
             self._agg_store("sum", index, field_name, hit[0], result, extra)
@@ -3205,7 +3282,7 @@ class TPUBackend:
         vn = bsi_view_name(field_name)
         v = f.view(vn)
         vers_new = self._epoch_versions(
-            f, shards_t, vn, vers_old, ent[0][1]
+            f, shards_t, vn, vers_old, ent[0][1], tier="sum"
         )
         d_sum = 0
         d_cnt = 0
@@ -3232,7 +3309,8 @@ class TPUBackend:
         self.stats.count("sum_incremental_updates_total")
         return result
 
-    def _epoch_versions(self, f, shards_t, vn, vers_old, gen_recorded):
+    def _epoch_versions(self, f, shards_t, vn, vers_old, gen_recorded,
+                        tier="agg"):
         """Per-shard live versions for an epoch update, built from the
         view's mutation journal when it fully explains
         (gen_recorded, now]: only the dirtied shards pay a locked
@@ -3241,22 +3319,27 @@ class TPUBackend:
         (uid, version) is unchanged). Falls back to the full locked walk
         (_live_versions) when the journal can't explain. At 954 shards
         the walk cost ~1.8 ms x3 aggregate kinds per write epoch — the
-        minmax churn leg's dominant serving cost."""
+        minmax churn leg's dominant serving cost. Counted per tier as a
+        kind=journal walk whose shard count is the DIRTY set (the
+        O(dirty) invariant tests/test_telemetry.py asserts)."""
         v = f.view(vn)
         if v is None or vers_old is None:
-            return self._live_versions(f, shards_t, vn)
+            return self._live_versions(f, shards_t, vn, tier=tier)
         dirty = v.dirty_shards_since(gen_recorded)
         if dirty is None or len(vers_old) != len(shards_t):
-            return self._live_versions(f, shards_t, vn)
+            return self._live_versions(f, shards_t, vn, tier=tier)
         out = list(vers_old)
+        n_read = 0
         for i, s in enumerate(shards_t):
             if s in dirty:
                 fr = v.fragment(s)
                 if fr is None:
                     out[i] = None
                 else:
+                    n_read += 1  # counted like _live_versions: locked reads
                     with fr.lock:  # serialize with a mid-write bump
                         out[i] = (fr.uid, fr.version)
+        self._count_version_walk("journal", tier, n_read)
         return tuple(out)
 
     def _agg_fingerprint(self, index, field_name, shards):
@@ -3321,9 +3404,10 @@ class TPUBackend:
         if hit is not None and hit[1] is not None:
             return hit[1]
         if hit is not None:
-            upd = self._minmax_try_incremental(
-                kind, index, field_name, shards, hit[0]
-            )
+            with current_profile().phase("freshness"):
+                upd = self._minmax_try_incremental(
+                    kind, index, field_name, shards, hit[0]
+                )
             if upd is not None:
                 return upd
         pre_vers = None
@@ -3332,18 +3416,23 @@ class TPUBackend:
             f0 = idx0.field(field_name) if idx0 else None
             if f0 is not None:
                 pre_vers = self._live_versions(
-                    f0, tuple(shards), bsi_view_name(field_name)
+                    f0, tuple(shards), bsi_view_name(field_name),
+                    tier="minmax",
                 )
+        prof = current_profile()
         try:
-            f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
-                index, field_name, shards, filter_call
-            )
+            with prof.phase("stack_fetch"):
+                f, opts, spec, blocks, scalars, bsi_block = self._bsi_setup(
+                    index, field_name, shards, filter_call
+                )
         except _Unsupported:
             return None
         if bsi_block.shape[0] > MAX_DEVICE_SUM_SHARDS:
             return None
         depth = opts.bit_depth
-        with jax.profiler.TraceAnnotation("pilosa." + kind):
+        with jax.profiler.TraceAnnotation("pilosa." + kind), prof.phase(
+            "device_dispatch"
+        ):
             bits_a, cnt_a, bits_b, cnt_b, branch_any, consider_any = (
                 np.asarray(x)
                 for x in self._program(kind, spec, True, extra=depth)(
@@ -3378,7 +3467,8 @@ class TPUBackend:
             extra = None
             if pre_vers is not None:
                 vers = self._confirm_vers(
-                    f, tuple(shards), pre_vers, bsi_view_name(field_name)
+                    f, tuple(shards), pre_vers, bsi_view_name(field_name),
+                    tier="minmax",
                 )
                 extra = (tuple(pershard), vers)
             self._agg_store(kind, index, field_name, hit[0], result, extra)
@@ -3428,7 +3518,7 @@ class TPUBackend:
         vn = bsi_view_name(field_name)
         v = f.view(vn)
         vers_new = self._epoch_versions(
-            f, shards_t, vn, vers_old, ent[0][1]
+            f, shards_t, vn, vers_old, ent[0][1], tier="minmax"
         )
         better = (
             (lambda a, b: a < b) if kind == "bsi_min" else (lambda a, b: a > b)

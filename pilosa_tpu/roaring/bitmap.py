@@ -590,10 +590,16 @@ class Container:
                 if other.typ == TYPE_RUN
                 else _positions_to_runs(other.data)
             )
-            # Same scattered-operand gate as union (code review r5):
-            # xor can produce at most ra+rb+1 runs.
+            # Same scattered-operand gate as union (code review r5),
+            # sized per ADVICE r5. The provable bound is ra+rb output
+            # runs (an xor membership toggle needs an operand toggle;
+            # ≤2(ra+rb) toggles → ≤ra+rb runs, achieved when one
+            # operand's runs split the other's), so 2*(ra+rb) carries a
+            # deliberate 2x margin: marginal operand pairs route to the
+            # vectorized kernels, the direction the r5 perf fix chose
+            # after the scattered-operand run sweep measured ~90x slow.
             if _runs_could_win(
-                ra.shape[0] + rb.shape[0] + 1, self._n + other._n
+                2 * (ra.shape[0] + rb.shape[0]), self._n + other._n
             ):
                 # (a\b) and (b\a) are disjoint; their union coalesces
                 # any adjacency the symmetric difference re-creates.

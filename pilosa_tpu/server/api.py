@@ -124,20 +124,27 @@ class API:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns, remote=remote,
         )
-        out: dict[str, Any] = {
-            "results": [self._encode_result(r, exclude_columns) for r in results]
-        }
-        if column_attrs and not exclude_columns:
-            out["columnAttrSets"] = attr_sets
-        return out
+        from pilosa_tpu.utils.qprofile import current_profile
+
+        with current_profile().phase("serialize"):
+            out: dict[str, Any] = {
+                "results": [
+                    self._encode_result(r, exclude_columns) for r in results
+                ]
+            }
+            if column_attrs and not exclude_columns:
+                out["columnAttrSets"] = attr_sets
+            return out
 
     def query_proto(self, index: str, query: str, **kw) -> bytes:
         """Protobuf QueryResponse (reference QueryResponse public.proto:66;
         Go client libraries speak this both ways)."""
         from pilosa_tpu.server.wire import encode_query_response
+        from pilosa_tpu.utils.qprofile import current_profile
 
         results, attr_sets = self.query_results(index, query, **kw)
-        return encode_query_response(results, attr_sets)
+        with current_profile().phase("serialize"):
+            return encode_query_response(results, attr_sets)
 
     def _encode_result(self, r: Any, exclude_columns: bool) -> Any:
         from pilosa_tpu.core.row import Row

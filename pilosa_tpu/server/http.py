@@ -11,12 +11,14 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import __version__
+from pilosa_tpu.utils.qprofile import profile_scope
 from pilosa_tpu.server.api import API, APIError
 from pilosa_tpu.server.wire import (
     ImportRequest,
@@ -34,6 +36,38 @@ _TOKEN_RE = re.compile(r"[!#$%&'*+\-.^_`|~0-9A-Za-z]+")
 
 _PPROF = None
 _PPROF_LOCK = threading.Lock()
+
+#: Process start, for /debug/vars uptime.
+_START_TIME = time.time()
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    """socketserver's default listen backlog is 5: under the bench's 16
+    keep-alive clients plus a churn writer, a burst of reconnects (or a
+    thread-scheduling stall on a one-core host) overflows it and the
+    kernel RSTs the excess SYNs — the mid-window ConnectionResetError
+    that zeroed BENCH_r05 (VERDICT r5 #1c). 128 matches the half of
+    net.core.somaxconn actually honored everywhere."""
+
+    request_queue_size = 128
+
+    def handle_error(self, request, client_address):
+        """A client that vanishes mid-exchange can surface OUTSIDE the
+        route dispatcher's abort trap (e.g. send_error during request
+        parsing hitting a reset socket): count it on the same
+        http_connection_aborts_total the dispatcher uses instead of
+        letting socketserver spray a traceback on stderr. Anything
+        that is not a connection-teardown race keeps the default noisy
+        behavior — real bugs must stay loud."""
+        import sys as _sys
+
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            from pilosa_tpu.utils.stats import global_stats
+
+            global_stats.count("http_connection_aborts_total")
+            return
+        super().handle_error(request, client_address)
 
 
 def _profiler():
@@ -85,7 +119,7 @@ class Server:
             pass
 
         Handler.api = api
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = _HTTPServer((self.host, self.port), Handler)
         if self._tls is not None:
             self._httpd.socket = self._tls.wrap_socket(
                 self._httpd.socket, server_side=True
@@ -354,8 +388,16 @@ class _Handler(BaseHTTPRequestHandler):
                     self._error(
                         str(e), status=e.status, code=getattr(e, "code", "")
                     )
-                except BrokenPipeError:
-                    pass
+                except (BrokenPipeError, ConnectionResetError):
+                    # The client went away mid-response (or reset the
+                    # socket under us). Nothing to send back — but count
+                    # it: silent aborts are how BENCH_r05's mid-window
+                    # reset went undiagnosed (VERDICT r5 #1c). Close the
+                    # connection: a keep-alive loop would read the dead
+                    # socket, raise a SECOND reset into handle_error,
+                    # and double-count this one abort.
+                    stats.count("http_connection_aborts_total")
+                    self.close_connection = True
                 except Exception as e:  # mirror the reference's panic trap
                     stats.count("http_request_errors_total")
                     self._error(f"PANIC: {e}\n{traceback.format_exc()}", status=500)
@@ -468,22 +510,32 @@ class _Handler(BaseHTTPRequestHandler):
         # Content negotiation (reference handler.go: protobuf responses
         # when the client Accepts application/x-protobuf).
         accept = (self.headers.get("Accept") or "").split(";")[0].strip()
-        if accept == "application/x-protobuf":
-            try:
-                data = self.api.query_proto(index, query, **kw)
-            except APIError as e:
-                from pilosa_tpu.server.wire import encode_query_response
+        # The query-lifecycle profile opens HERE — at HTTP receipt — so
+        # the breakdown covers the whole serving path through response
+        # serialization; the executor reuses this profile (nested
+        # profile_scope) and adds its phases to the same record.
+        with profile_scope(
+            index=index, query=query if isinstance(query, str) else ""
+        ) as prof:
+            if accept == "application/x-protobuf":
+                try:
+                    data = self.api.query_proto(index, query, **kw)
+                except APIError as e:
+                    from pilosa_tpu.server.wire import encode_query_response
 
-                self._reply(
-                    encode_query_response([], err=str(e)),
-                    status=e.status,
-                    content_type="application/x-protobuf",
-                )
+                    prof.error = str(e)[:200]
+                    self._reply(
+                        encode_query_response([], err=str(e)),
+                        status=e.status,
+                        content_type="application/x-protobuf",
+                    )
+                    return
+                with prof.phase("serialize"):
+                    self._reply(data, content_type="application/x-protobuf")
                 return
-            self._reply(data, content_type="application/x-protobuf")
-            return
-        out = self.api.query(index, query, **kw)
-        self._reply(out)
+            out = self.api.query(index, query, **kw)
+            with prof.phase("serialize"):
+                self._reply(out)
 
     @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
     def handle_post_import(self, index, field):
@@ -574,6 +626,37 @@ class _Handler(BaseHTTPRequestHandler):
             global_stats.gauge("tpu_resident_bytes", blocks.resident_bytes())
             global_stats.gauge("tpu_stack_evictions", blocks.evictions)
         self._reply(global_stats.prometheus_text(), content_type="text/plain; version=0.0.4")
+
+    @route("GET", r"/debug/queries")
+    def handle_debug_queries(self):
+        """Recent + in-flight queries with per-phase breakdowns (the ring
+        behind pilosa_tpu/utils/qprofile.py). ?n bounds the recent list.
+        The operator's first stop for 'why is THIS query slow': phases,
+        version-walk counters, and errors per query, newest first."""
+        from pilosa_tpu.utils.qprofile import global_query_ring
+
+        n = int(self.query.get("n", "50"))
+        self._reply(
+            {
+                "inflight": global_query_ring.inflight(),
+                "recent": global_query_ring.recent(n),
+            }
+        )
+
+    @route("GET", r"/debug/vars")
+    def handle_debug_vars(self):
+        """expvar-style JSON dump of the whole stats registry (reference
+        /debug/vars, http/handler.go:307): every counter/gauge/timing
+        series by its prometheus series name — the greppable twin of
+        /metrics for tooling that wants JSON."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        out = {
+            "version": __version__,
+            "uptimeSeconds": round(time.time() - _START_TIME, 3),
+        }
+        out.update(global_stats.snapshot())
+        self._reply(out)
 
     @route("GET", r"/debug/traces")
     def handle_debug_traces(self):

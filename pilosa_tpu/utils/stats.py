@@ -98,6 +98,29 @@ class StatsClient:
             pairs.append(f'{k}="{v}"')
         return "{" + ",".join(pairs) + "}"
 
+    def snapshot(self) -> dict:
+        """expvar-style dict of every live series (served by /debug/vars,
+        the reference's expvar route, http/handler.go:307). Same series
+        naming as the prometheus text — name{k="v",...} — so operators
+        can grep either surface with one vocabulary. Timings export the
+        monotonic count/sum plus ring-sampled p50/p99."""
+        r = self._root
+        out: dict[str, dict] = {"counters": {}, "gauges": {}, "timings": {}}
+        with r._lock:
+            for (name, tags), v in sorted(r._counters.items()):
+                out["counters"][name + self._fmt_tags(tags)] = v
+            for (name, tags), v in sorted(r._gauges.items()):
+                out["gauges"][name + self._fmt_tags(tags)] = v
+            for (name, tags), samples in sorted(r._timings.items()):
+                n, total = r._timing_totals[(name, tags)]
+                entry: dict = {"count": n, "sum": total}
+                if samples:
+                    s = sorted(samples)
+                    entry["p50"] = s[len(s) // 2]
+                    entry["p99"] = s[min(len(s) - 1, int(len(s) * 0.99))]
+                out["timings"][name + self._fmt_tags(tags)] = entry
+        return out
+
     def prometheus_text(self) -> str:
         """Prometheus exposition format for /metrics (reference
         prometheus/prometheus.go backend + /metrics route)."""
@@ -152,6 +175,9 @@ class NopStatsClient:
 
     def prometheus_text(self):
         return "\n"
+
+    def snapshot(self):
+        return {"counters": {}, "gauges": {}, "timings": {}}
 
 
 global_stats = StatsClient()

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import shutil
 import socket
+import struct
 import tempfile
 import threading
 import time
@@ -31,6 +32,11 @@ class FaultProxy:
       the dialer sees an instant transport error)
     - mode 'blackhole': accept, read, never answer (the dialer blocks
       until its timeout — the one-sided-silence failure shape)
+    - mode 'reset_once': hard-RST exactly ONE incoming connection
+      (SO_LINGER 0 close — the client sees ConnectionResetError /
+      BadStatusLine mid-exchange), then auto-revert to 'pass' so a
+      retry with a fresh connection succeeds. The single-transient
+      fault shape bench.py's capture-proof post() retry covers.
     """
 
     def __init__(self, target_host: str, target_port: int):
@@ -53,6 +59,18 @@ class FaultProxy:
                 return
             mode = self.mode
             if mode == "refuse":
+                conn.close()
+                continue
+            if mode == "reset_once":
+                # SO_LINGER(on, 0): close sends RST, not FIN — the
+                # client's in-flight request dies with a reset instead
+                # of a clean EOF. One-shot: revert before closing so
+                # the retry's connection races nothing.
+                self.mode = "pass"
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack("ii", 1, 0),
+                )
                 conn.close()
                 continue
             threading.Thread(
