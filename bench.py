@@ -49,11 +49,22 @@ Capture-proof harness (ISSUE r6, VERDICT r5 next-round #1):
   histograms and runs the single-query leg under QueryProfiles, so the
   over-floor latency decomposes into named phases instead of a guess.
 
+Round-7 legs (ISSUE r7):
+- cold_build: f/g stack uploads measured twice in the same run — dense
+  baseline vs the roaring-container wire (ops/sparse.py CONTAINER tier)
+  — as cold_build_dense_seconds / cold_build_seconds, with the
+  stack_container_* counter deltas proving the wire engaged.
+- churn-walk deltas: every churn window reports
+  version_walk_total{kind=full|journal} deltas (plus the per-tier FULL
+  breakdown), so a serving tier that regresses to O(all-shards)
+  freshness walks names itself in the artifact.
+
 Env knobs: BENCH_SHARDS (default 954 = 1B cols), BENCH_ROWS (8),
 BENCH_DENSITY (0.05), BENCH_BATCH (256), BENCH_SECONDS (10),
 BENCH_LATENCY_N (30), BENCH_HTTP_CLIENTS (16),
 BENCH_HTTP_QUERIES_PER_REQ (16), BENCH_WRITE_RATES ("0,1,10,100"),
-BENCH_CHURN_SECONDS (8), BENCH_PARTIAL_PATH (BENCH_partial.json).
+BENCH_CHURN_SECONDS (8), BENCH_WARM_TIMEOUT (600),
+BENCH_PARTIAL_PATH (BENCH_partial.json).
 """
 
 import concurrent.futures
@@ -74,6 +85,7 @@ from pilosa_tpu.exec import Executor
 from pilosa_tpu.exec.batcher import CountBatcher
 from pilosa_tpu.pql import parse_string
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.stats import global_stats
 
 # The device backend import is deferred to main(): it needs a jax with
 # shard_map, and deferring keeps BenchConn + the prometheus parsers
@@ -91,6 +103,7 @@ WRITE_RATES = [
     float(w) for w in os.environ.get("BENCH_WRITE_RATES", "0,1,10,100").split(",")
 ]
 CHURN_SECONDS = float(os.environ.get("BENCH_CHURN_SECONDS", "8"))
+WARM_TIMEOUT = float(os.environ.get("BENCH_WARM_TIMEOUT", "600"))
 
 WORDS = SHARD_WIDTH // 32
 
@@ -216,6 +229,37 @@ def phase_means_ms(metrics_text: str, baseline: tuple = None) -> dict:
     }
 
 
+def walk_totals() -> dict:
+    """Freshness-walk counters by kind, summed over tiers, plus the
+    per-tier breakdown of FULL walks — the churn-walk legs' raw data
+    (ISSUE r7: journal-complete serving must keep kind=full flat under
+    churn). Reads the in-process registry: the bench server and the
+    direct-backend legs share global_stats."""
+    snap = global_stats.snapshot()["counters"]
+    out = {"full": 0.0, "journal": 0.0, "full_by_tier": {}}
+    for k, v in snap.items():
+        m = re.match(r'version_walk_total\{kind="(full|journal)",tier="([^"]+)"\}', k)
+        if not m:
+            continue
+        out[m.group(1)] += v
+        if m.group(1) == "full":
+            tiers = out["full_by_tier"]
+            tiers[m.group(2)] = tiers.get(m.group(2), 0.0) + v
+    return out
+
+
+def walk_delta(before: dict, after: dict) -> dict:
+    return {
+        "full": round(after["full"] - before["full"]),
+        "journal": round(after["journal"] - before["journal"]),
+        "full_by_tier": {
+            t: round(n - before["full_by_tier"].get(t, 0.0))
+            for t, n in after["full_by_tier"].items()
+            if n - before["full_by_tier"].get(t, 0.0) > 0
+        },
+    }
+
+
 def build_index(h: Holder):
     """The timed build: the 1B-column bitmap index (f, g, h) — the same
     content as rounds 1-4, so build_seconds stays comparable. Column
@@ -296,10 +340,74 @@ def measure_rtt_floor() -> float:
     return lat[len(lat) // 2]
 
 
-def bench_tpu(holder, queries) -> tuple[float, list[int], float, object]:
-    from pilosa_tpu.exec.tpu import TPUBackend
+def _wait_sparse_warm(device, timeout: float = WARM_TIMEOUT) -> bool:
+    """Block until the background sparse/container program warm has
+    landed — the cold-build comparison must measure wire formats, not
+    one side racing its own warm into dense fallbacks."""
+    from pilosa_tpu.ops import sparse as sp
 
-    be = TPUBackend(holder)
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if sp.container_progs_ready(device) and all(
+            sp.chunk_prog_ready(device, b) for b in sp.BUCKETS
+        ):
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def bench_cold_build(holder, be) -> tuple[float, float, dict]:
+    """Cold f/g stack builds, dense baseline vs container wire in the
+    SAME run (ISSUE r7 acceptance: cold_build_seconds strictly below the
+    dense baseline). Dense first; the container-built stacks stay
+    resident for the rest of the bench. Each build blocks on the device
+    arrays so async dispatch can't flatter either side."""
+    import jax
+
+    from pilosa_tpu.ops import sparse as sp
+
+    shards = tuple(range(SHARDS))
+    fields = [be._field("bench", n) for n in ("f", "g")]
+
+    def build_both() -> float:
+        t0 = time.perf_counter()
+        for fo in fields:
+            block, _ = be.blocks.get("bench", fo, shards)
+            if block is not None:
+                jax.block_until_ready(block)
+        return time.perf_counter() - t0
+
+    # Throwaway build of f first: compiles the per-shape placement
+    # programs (zeros/place/final) and the stack's update-fn warm, so
+    # NEITHER timed leg carries one-time XLA compiles — the dense leg
+    # runs first and would otherwise donate its compile time to the
+    # container leg's figure (code review r7).
+    be.blocks.get("bench", fields[0], shards)
+    be.blocks.clear()
+    prev = sp.CONTAINER_TIER_ENABLED
+    sp.CONTAINER_TIER_ENABLED = False
+    try:
+        dense_s = build_both()
+    finally:
+        sp.CONTAINER_TIER_ENABLED = prev
+    be.blocks.clear()
+    snap0 = global_stats.snapshot()["counters"]
+    cont_s = build_both()
+    snap1 = global_stats.snapshot()["counters"]
+    cont = {
+        k: round(snap1.get(k, 0.0) - snap0.get(k, 0.0))
+        for k in (
+            "stack_container_chunks_total",
+            "stack_container_pos_total",
+            "stack_container_runs_total",
+            "stack_container_wire_bytes_total",
+            "stack_container_not_warm_total",
+        )
+    }
+    return cont_s, dense_s, cont
+
+
+def bench_tpu(holder, queries, be) -> tuple[float, list[int], float]:
     shards = list(range(SHARDS))
     calls = [parse_string(q).calls[0].children[0] for q in queries]
     # warmup: compile + upload blocks
@@ -324,7 +432,7 @@ def bench_tpu(holder, queries) -> tuple[float, list[int], float, object]:
         be.count_batch("bench", calls[:BATCH], shards)
         n_done += BATCH
     dt = time.time() - t0
-    return n_done / dt, first, sweep_ms, be
+    return n_done / dt, first, sweep_ms
 
 
 def bench_sweep_device_only(be) -> float:
@@ -502,12 +610,17 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
 
     qps_at_rate = {}
     achieved_rate = {}
+    walks0 = walk_totals()
     for w in WRITE_RATES:
         seconds = SECONDS if w == 0 else CHURN_SECONDS
         key = str(int(w) if w == int(w) else w)
         qps_at_rate[key], achieved = run_window(w, seconds)
         qps_at_rate[key] = round(qps_at_rate[key], 1)
         achieved_rate[key] = round(achieved, 1)
+    # Churn-walk leg (ISSUE r7): the whole rate sweep must resolve its
+    # freshness through the journal tier — a nonzero FULL delta here
+    # names the tier that regressed.
+    churn_walks = walk_delta(walks0, walk_totals())
 
     # Single-request latency through the full HTTP path (one Count).
     lat = []
@@ -528,7 +641,10 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     ))
     warm.close()
     srv.close()
-    return qps_at_rate, achieved_rate, lat[len(lat) // 2], http_phase_ms, aborts
+    return (
+        qps_at_rate, achieved_rate, lat[len(lat) // 2], http_phase_ms,
+        aborts, churn_walks,
+    )
 
 
 def bench_group_by(holder, be) -> tuple[float, float]:
@@ -552,13 +668,13 @@ def bench_group_by(holder, be) -> tuple[float, float]:
     return cold, warm
 
 
-def bench_minmax_churn(holder, be) -> tuple[float, float, float]:
+def bench_minmax_churn(holder, be) -> tuple[float, float, float, dict]:
     """Min/Max churn absorption (VERDICT r4 #7): serve a Min/Max/Sum mix
     while a writer issues point SetValues at ~100/s. The per-shard
     extremum tables absorb each epoch on the host (O(1) monotone, one
     fragment re-scan when an incumbent clears), so QPS under churn must
     hold near the read-only rate. Returns (qps_read_only, qps_churn,
-    achieved write rate)."""
+    achieved write rate, churn-window walk-kind deltas)."""
     ex = Executor(holder, backend=be)
     queries = ["Min(field=v)", "Max(field=v)", "Sum(field=v)"]
     for q in queries:
@@ -610,8 +726,9 @@ def bench_minmax_churn(holder, be) -> tuple[float, float, float]:
         return n / dt, wrote[0] / dt
 
     qps_ro, _ = window(0, 4.0)
+    w0 = walk_totals()
     qps_churn, wrate = window(100.0, CHURN_SECONDS)
-    return qps_ro, qps_churn, wrate
+    return qps_ro, qps_churn, wrate, walk_delta(w0, walk_totals())
 
 
 def bench_cpu(holder, parsed_queries) -> float:
@@ -690,7 +807,22 @@ def main():
         baseline="numpy_oracle_cpu_threadpool (NOT Go/roaring; see BASELINE.md)",
         baseline_qps=round(cpu_qps, 2),
     )
-    tpu_qps, tpu_first, sweep_ms, be = bench_tpu(h, queries)
+    # Cold-build leg (ISSUE r7): dense-baseline vs container-wire f/g
+    # stack uploads measured back to back in THIS run; the container
+    # build's stacks stay resident for every later leg.
+    from pilosa_tpu.exec.tpu import TPUBackend
+
+    be = TPUBackend(h)
+    warm_ok = _wait_sparse_warm(be.blocks.device)
+    cold_s, cold_dense_s, cont_counters = bench_cold_build(h, be)
+    checkpoint(
+        "cold_build",
+        cold_build_seconds=round(cold_s, 2),
+        cold_build_dense_seconds=round(cold_dense_s, 2),
+        cold_build_wire_warm=warm_ok,
+        stack_container=cont_counters,
+    )
+    tpu_qps, tpu_first, sweep_ms = bench_tpu(h, queries, be)
     checkpoint(
         "tpu_batch",
         cache_hit_resolve_qps=round(tpu_qps, 1),
@@ -764,17 +896,19 @@ def main():
         groupby_3field_cold_s=round(groupby_cold_s, 2),
         groupby_3field_warm_ms=round(groupby_warm_s * 1e3, 1),
     )
-    mm_ro, mm_churn, mm_wrate = bench_minmax_churn(h, be)
+    mm_ro, mm_churn, mm_wrate, mm_walks = bench_minmax_churn(h, be)
     checkpoint(
         "minmax_churn",
         minmax_qps_read_only=round(mm_ro, 1),
         minmax_qps_at_write_100=round(mm_churn, 1),
         minmax_churn_qps_ratio=round(mm_churn / mm_ro, 3) if mm_ro else None,
         minmax_write_rate_achieved=round(mm_wrate, 1),
+        minmax_churn_version_walks=mm_walks,
     )
-    qps_at_rate, achieved_rate, http_p50, http_phase_ms, aborts = bench_http(
-        h, be, queries
-    )
+    (
+        qps_at_rate, achieved_rate, http_p50, http_phase_ms, aborts,
+        http_churn_walks,
+    ) = bench_http(h, be, queries)
     http_qps = qps_at_rate.get("0", next(iter(qps_at_rate.values())))
     checkpoint(
         "http",
@@ -788,6 +922,7 @@ def main():
         http_post_retries=RETRIES["post"],
         http_get_retries=RETRIES["get"],
         http_connection_aborts=aborts,
+        churn_version_walks=http_churn_walks,
     )
 
     out.update(

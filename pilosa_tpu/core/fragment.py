@@ -883,6 +883,20 @@ class Fragment:
             column_ids = np.asarray(column_ids, dtype=np.uint64)
             values = np.asarray(values, dtype=np.int64)
             cols = column_ids % np.uint64(SHARD_WIDTH)
+            # Last-write-wins dedup (ADVICE r5 #1, reference batch
+            # semantics): a repeated column must land its FINAL value
+            # only. Without this, the per-plane set/clear lists carry
+            # both occurrences — on the fresh-fragment path (clears
+            # skipped) the two values' plane bits OR into garbage, and
+            # on the general path clear-beats-set regardless of order.
+            # np.unique on the reversed stream keeps each column's last
+            # occurrence.
+            if cols.size:
+                _, rev_first = np.unique(cols[::-1], return_index=True)
+                if rev_first.size != cols.size:
+                    keep = cols.size - 1 - rev_first
+                    cols = cols[keep]
+                    values = values[keep]
             uvals = np.abs(values).astype(np.uint64)
             to_set = []
             to_clear = []

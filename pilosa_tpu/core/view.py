@@ -78,7 +78,11 @@ class View:
         # discover WHICH shards moved in O(writes) instead of walking
         # every fragment's (uid, version) per epoch — at 954 shards the
         # walk cost ~1.8 ms x3 aggregate kinds per write epoch, the
-        # bench minmax churn leg's dominant cost (r5).
+        # bench minmax churn leg's dominant cost (r5). Journal-complete
+        # since r7: every serving tier consumes it (Sum/Min/Max, pair,
+        # TopN, GroupN — exec/tpu.py _epoch_versions), so JOURNAL_MAX
+        # bounds how many writes may land between two freshness checks
+        # of ANY hot tier before that check degrades to a full walk.
         self._journal: deque = deque()
         self._journal_floor = 0  # newest generation ever evicted
         # Journal lock invariant (ADVICE r5): this is a strict LEAF

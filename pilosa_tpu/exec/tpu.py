@@ -204,22 +204,24 @@ class _StackedBlocks:
             if self.mesh is None and (nbytes // 4) >= MIN_CHUNKED_WORDS:
                 # Streaming packed upload (VERDICT r4 #1): shard slabs
                 # compress and ship as they pack, so the wire rides
-                # under the host pack instead of after it, and sparse
-                # stacks (time-quantum views, short fields) ship a
-                # fraction of their dense bytes. ops/sparse.py for the
-                # wire format and the fixed-shape program design.
+                # under the host pack instead of after it. Fragments
+                # stream container-natively (ISSUE r7): array/run
+                # containers ship as 16-bit positions / run spans and
+                # expand on device, so word-dense-but-bit-sparse stacks
+                # (the f/g bench shape) stop shipping dense AND skip the
+                # host-side dense pack; word-sparse stacks (time-quantum
+                # views, short fields) still ship the zero-word-mask
+                # wire. ops/sparse.py for the tier decision and the
+                # fixed-shape program design.
                 builder = ChunkedStackBuilder(self.device, shape)
-                zero_slab = np.zeros(rows_p * WORDS_PER_SHARD, dtype=np.uint32)
+                slab_words = rows_p * WORDS_PER_SHARD
                 for s in shards:
                     fr = frags[s]
                     if fr is not None:
-                        builder.feed(
-                            pack_fragment(fr, n_rows=rows_p).reshape(-1)
-                        )
+                        builder.feed_fragment(fr, rows_p)
                     else:
-                        builder.feed(zero_slab)
-                for _ in range(s_pad - len(shards)):
-                    builder.feed(zero_slab)
+                        builder.skip(slab_words)
+                builder.skip((s_pad - len(shards)) * slab_words)
                 arr = builder.finish()
             else:
                 host = np.zeros(shape, dtype=np.uint32)
@@ -1719,14 +1721,30 @@ class TPUBackend:
         stack fetch + device sweep otherwise. Runs WITHOUT _pair_lock
         (slab packing / stack builds are the slow part); the exclusive
         updater role makes store-time re-validation unnecessary."""
-        # Walk the per-shard versions — the fine-grained diff that tells
-        # dirty shards apart from writes outside the queried set.
+        # Per-shard version diff that tells dirty shards apart from
+        # writes outside the queried set. Journal-complete (ISSUE r7):
+        # when a previous entry recorded versions at a known generation,
+        # the view journal names the dirtied shards and only THOSE pay a
+        # locked fragment read — O(dirty), not O(all shards). The full
+        # walk remains only for cold pairs (no recorded versions) and
+        # journal-eviction windows.
         prof = current_profile()
+        hit_ok = hit is not None and hit.shards == shards_t
         with prof.phase("freshness"):
-            vers_f = self._live_versions(f_obj, shards_t, tier="pair")
+            vers_f = self._epoch_versions(
+                f_obj, shards_t, VIEW_STANDARD,
+                hit.vers_f if hit_ok else None,
+                hit.gen_f if hit_ok else -1,
+                tier="pair",
+            )
             vers_g = (
                 vers_f if fb == fa
-                else self._live_versions(g_obj, shards_t, tier="pair")
+                else self._epoch_versions(
+                    g_obj, shards_t, VIEW_STANDARD,
+                    hit.vers_g if hit_ok else None,
+                    hit.gen_g if hit_ok else -1,
+                    tier="pair",
+                )
             )
             ent = self._pair_try_incremental(
                 hit, f_obj, g_obj, shards_t, gen_f, gen_g, vers_f, vers_g
@@ -2495,8 +2513,23 @@ class TPUBackend:
                     daemon=True, name="groupn-prewarm",
                 )
                 prewarm.start()
+            # Journal-complete freshness (ISSUE r7): a retained entry's
+            # recorded per-field versions + the views' journals make the
+            # walk O(dirty shards) per field; only cold tuples (or an
+            # evicted journal window) pay the full locked walk.
+            hit_ok = (
+                hit is not None
+                and hit.cfp[0] == shards_t
+                and hit.vers is not None
+            )
             live = [
-                self._live_versions(f, shards_t, tier="groupn") for f in fobjs
+                self._epoch_versions(
+                    f, shards_t, VIEW_STANDARD,
+                    hit.vers[t] if hit_ok else None,
+                    hit.cfp[1][t] if hit_ok else -1,
+                    tier="groupn",
+                )
+                for t, f in enumerate(fobjs)
             ]
             upd = self._groupn_try_incremental(hit, fobjs, views, shards_t, live)
             if upd is not None:
@@ -2957,8 +2990,23 @@ class TPUBackend:
         try:
             # Generation moved: try the host table update against LIVE
             # fragment versions — no stack fetch, no device round trip.
+            # Journal-complete freshness (ISSUE r7): the retained entry's
+            # recorded versions + the view journal make this O(dirty
+            # shards); the full locked walk remains only for cold fields
+            # and journal-eviction windows.
+            hit_ok = (
+                hit is not None
+                and len(hit) >= 4
+                and hit[3] is not None
+                and hit[0][0] == shards_t
+            )
             with current_profile().phase("freshness"):
-                live_vers = self._live_versions(f, shards_t, tier="topn")
+                live_vers = self._epoch_versions(
+                    f, shards_t, VIEW_STANDARD,
+                    hit[3] if hit_ok else None,
+                    hit[0][1] if hit_ok else -1,
+                    tier="topn",
+                )
                 upd = self._topn_try_incremental(f, hit, shards_t, live_vers)
             if upd is not None:
                 pershard, vers_rec = upd
@@ -3321,7 +3369,15 @@ class TPUBackend:
         the walk cost ~1.8 ms x3 aggregate kinds per write epoch — the
         minmax churn leg's dominant serving cost. Counted per tier as a
         kind=journal walk whose shard count is the DIRTY set (the
-        O(dirty) invariant tests/test_telemetry.py asserts)."""
+        O(dirty) invariant tests/test_telemetry.py asserts).
+
+        Every serving-path freshness consumer routes through here
+        (ISSUE r7 journal-complete): Sum/Min/Max value epochs, the pair
+        tier (_pair_refresh), the TopN rank table (_topn_counts), and
+        the GroupN tensor (_groupn_tensor). _VERS_STALE entries recorded
+        by a racing capture self-heal: the write that staled them bumped
+        the generation AFTER gen_recorded was read, so the journal names
+        that shard dirty and the locked re-read replaces the sentinel."""
         v = f.view(vn)
         if v is None or vers_old is None:
             return self._live_versions(f, shards_t, vn, tier=tier)

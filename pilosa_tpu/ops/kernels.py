@@ -395,6 +395,96 @@ def nary_stats_pershard(f_stack, g_stack, extras, interpret: bool = False):
     )(f_stack, g_stack, *extras)
 
 
+# ---------------------------------------------------------------------------
+# Container-native upload expansion (ISSUE r7): device-side rebuild of a
+# dense uint32 chunk from roaring-container wire buffers (ops/sparse.py
+# CONTAINER tier). Fixed shapes only — ops/sparse.py AOT-compiles these
+# once per process and pages variable-size container streams through
+# them, so no chunk ever pays an XLA compile on a cold build path.
+# ---------------------------------------------------------------------------
+
+#: Bits per roaring container slot (the 16-bit low-position domain).
+CONTAINER_SLOT_BITS = 1 << 16
+
+
+def expand_array_positions(acc, pos16, slot_counts, nnz):
+    """OR one page of array-container bits into the chunk accumulator.
+
+    acc: uint32[C] dense chunk words (donated by the caller's compile).
+    pos16: uint16[P] low 16 bits of each set position, grouped by slot
+        in ascending slot order; entries past nnz are padding.
+    slot_counts: int32[NSLOTS] positions-per-slot for THIS page (sums
+        to nnz), mapping each pos16 entry back to its container slot.
+    nnz: int32 scalar, live entries in pos16.
+
+    The scatter uses add, which equals OR here: positions within a
+    container are unique (sorted-unique array invariant) and container
+    slots partition the chunk's word space, so no (word, bit) pair is
+    ever contributed twice — by this page, another page, or another
+    wire tier (runs/remainder cover disjoint slots). Padding entries
+    are routed out of bounds and dropped.
+    """
+    n_slots = slot_counts.shape[0]
+    p = pos16.shape[0]
+    slot = jnp.repeat(
+        jnp.arange(n_slots, dtype=jnp.int32), slot_counts,
+        total_repeat_length=p,
+    )
+    bit = slot * CONTAINER_SLOT_BITS + pos16.astype(jnp.int32)
+    valid = jnp.arange(p, dtype=jnp.int32) < nnz
+    word = jnp.where(valid, bit >> 5, acc.shape[0])
+    val = jnp.left_shift(
+        jnp.uint32(1), (bit & 31).astype(jnp.uint32)
+    )
+    # The wire stream is globally ascending (slots ascend, positions
+    # ascend within a container) and padding lands past the end, so the
+    # scatter indices are non-decreasing — declared so XLA can lower a
+    # sequential-window scatter instead of the generic one.
+    return acc.at[word].add(val, mode="drop", indices_are_sorted=True)
+
+
+def expand_run_spans(acc, lo, hi, nnz):
+    """OR one page of run-container spans into the chunk accumulator.
+
+    lo/hi: int32[R] inclusive chunk-relative bit bounds per run (slot
+    base already folded in by the host); entries past nnz are padding.
+    Each run decomposes into at most two partial edge words (scatter-
+    add; masks from distinct runs in one word are disjoint because runs
+    are disjoint, so add equals OR) and an interior of all-ones words
+    recovered by a +1/-1 boundary scatter and a cumsum coverage test —
+    no per-run loop, so one fixed-shape program serves any run count.
+    """
+    c = acc.shape[0]
+    full = jnp.uint32(0xFFFFFFFF)
+    r = lo.shape[0]
+    valid = jnp.arange(r, dtype=jnp.int32) < nnz
+    w_lo = lo >> 5
+    w_hi = hi >> 5
+    m_lo = jnp.left_shift(full, (lo & 31).astype(jnp.uint32))
+    m_hi = jnp.right_shift(full, (31 - (hi & 31)).astype(jnp.uint32))
+    same = w_lo == w_hi
+    # Runs arrive sorted-disjoint with padding past the live prefix, so
+    # the first-edge indices are non-decreasing; the second-edge and
+    # interior-delta scatters interleave dropped entries and stay
+    # generic.
+    acc = acc.at[jnp.where(valid, w_lo, c)].add(
+        jnp.where(same, m_lo & m_hi, m_lo), mode="drop",
+        indices_are_sorted=True,
+    )
+    acc = acc.at[jnp.where(valid & ~same, w_hi, c)].add(m_hi, mode="drop")
+    # Interior words [w_lo+1, w_hi) are fully covered; delta has one +1
+    # per span start and one -1 per span end, so the running sum is
+    # positive exactly inside some span (spans from disjoint runs never
+    # overlap, so counts cannot cancel across runs).
+    start = w_lo + 1
+    has_interior = valid & (start < w_hi)
+    delta = jnp.zeros((c + 1,), jnp.int32)
+    delta = delta.at[jnp.where(has_interior, start, c + 1)].add(1, mode="drop")
+    delta = delta.at[jnp.where(has_interior, w_hi, c + 1)].add(-1, mode="drop")
+    cover = jnp.cumsum(delta[:-1]) > 0
+    return acc | jnp.where(cover, full, jnp.uint32(0))
+
+
 def pair_stats_xla(f_stack, g_stack):
     """Fused-XLA reference formulation of pair_stats (same results; used
     as the differential oracle for the Pallas kernel and as the fallback

@@ -1,0 +1,62 @@
+"""Tier-1 bench smoke (ISSUE r7 satellite): run bench.py end to end at a
+tiny shape and assert the BENCH JSON is complete and carries the keys
+the round driver consumes — an artifact-zeroing regression (a leg that
+crashes, a renamed key, a partial=true artifact) fails HERE instead of
+burning a full round to discover it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SMOKE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_SHARDS": "3",
+    "BENCH_ROWS": "2",
+    "BENCH_DENSITY": "0.02",
+    "BENCH_BATCH": "8",
+    "BENCH_SECONDS": "0.3",
+    "BENCH_LATENCY_N": "3",
+    "BENCH_HTTP_CLIENTS": "2",
+    "BENCH_HTTP_QUERIES_PER_REQ": "4",
+    "BENCH_WRITE_RATES": "0,10",
+    "BENCH_CHURN_SECONDS": "0.5",
+    # A failed background warm must degrade the wire (dense fallback),
+    # never hang the smoke on the warm poll.
+    "BENCH_WARM_TIMEOUT": "120",
+}
+
+
+def test_bench_smoke(tmp_path):
+    pytest.importorskip(
+        "pilosa_tpu.exec.tpu",
+        reason="bench needs the device backend (jax.shard_map)",
+        exc_type=ImportError,
+    )
+    env = dict(os.environ, **SMOKE_ENV)
+    env["BENCH_PARTIAL_PATH"] = str(tmp_path / "BENCH_partial.json")
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-4000:])
+    blob = json.loads(out.stdout.strip().splitlines()[-1])
+    # Complete artifact, not a crash-truncated partial.
+    assert blob["partial"] is False
+    assert blob["value"] is not None
+    # The r7 keys the driver's acceptance reads.
+    assert "cold_build_seconds" in blob
+    assert "cold_build_dense_seconds" in blob
+    assert "churn_version_walks" in blob
+    assert "minmax_churn_qps_ratio" in blob
+    # Every leg checkpointed along the way.
+    for leg in ("build", "cold_build", "tpu_batch", "single_query",
+                "minmax_churn", "http"):
+        assert leg in blob["legs_done"], blob["legs_done"]
+    # The partial artifact also landed complete on disk.
+    disk = json.loads(open(env["BENCH_PARTIAL_PATH"]).read())
+    assert disk["partial"] is False

@@ -426,6 +426,49 @@ class TestRunNativeSetAlgebra:
         cs["arr_dense"].difference(cs["runs"])
         assert bm.UNRUN_MATERIALIZATIONS[0] == before
 
+    def test_run_x_bitmap_direct_no_unrun(self, rng):
+        """run x bitmap intersect verbs (ISSUE r7 satellite) AND the
+        bitmap words against a cumsum coverage mask — no _unrun twin,
+        bit-exact against the position-set oracle."""
+        from pilosa_tpu.roaring import bitmap as bm
+        from pilosa_tpu.roaring.bitmap import Container
+
+        rc = Container.from_runs(
+            np.array([[0, 10], [100, 4000], [4002, 4002], [60000, 65535]],
+                     dtype=np.int64)
+        )
+        pos = np.unique(rng.integers(0, 65536, 9000).astype(np.uint16))
+        bc = Container.from_positions(pos)
+        assert rc.typ == "run" and bc.typ == "bitmap"
+        want = sorted(set(rc.positions().tolist()) & set(pos.tolist()))
+        before = bm.UNRUN_MATERIALIZATIONS[0]
+        for a, b in ((rc, bc), (bc, rc)):
+            got = a.intersect(b)
+            got.validate()
+            assert got.positions().tolist() == want
+            assert a.intersection_count(b) == len(want)
+        assert bm.UNRUN_MATERIALIZATIONS[0] == before
+
+    def test_runs_mask_tolerates_adjacent_runs(self):
+        """A foreign writer can serialize ADJACENT (non-coalesced but
+        valid) runs; the boundary-delta mask must accumulate, not
+        assign, or the shared boundary corrupts the whole mask (code
+        review r7)."""
+        from pilosa_tpu.roaring.bitmap import (
+            Container, TYPE_RUN, _runs_to_bitmap_words,
+        )
+
+        adj = Container(
+            TYPE_RUN, np.array([[0, 4], [5, 9]], dtype=np.uint16), 10
+        )
+        words = _runs_to_bitmap_words(adj.data)
+        assert int(np.bitwise_count(words).sum()) == 10
+        full = Container.from_positions(
+            np.arange(6000, dtype=np.uint16)
+        )  # bitmap (> 4096)
+        assert adj.intersection_count(full) == 10
+        assert adj.intersect(full).positions().tolist() == list(range(10))
+
     def test_with_without_many_stay_runny(self, rng):
         from pilosa_tpu.roaring import bitmap as bm
 

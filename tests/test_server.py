@@ -444,22 +444,95 @@ class TestRequestParsing:
         finally:
             s.close()
 
-    def test_transfer_encoding_rejected_501(self, server):
-        # ADVICE r4: a chunked request treated as Content-Length 0 would
-        # leave its body in rfile to be parsed as the NEXT request on
-        # the keep-alive connection (TE.CL desync behind a front proxy).
-        # The server never implements chunked: 501 + close.
+    def test_chunked_body_decoded(self, server):
+        # ISSUE r7 (VERDICT r5 missing #1): chunked bodies decode like
+        # the reference's stdlib instead of the old blanket 501. The
+        # split JSON body must reassemble before the route parses it.
         payload = (
-            b"POST /index/te HTTP/1.1\r\nHost: x\r\n"
+            b"POST /index/chk HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
             b"Transfer-Encoding: chunked\r\n\r\n"
+            b"1\r\n{\r\n1\r\n}\r\n0\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert out.startswith(b"HTTP/1.1 200"), out[:200]
+
+    def test_chunked_with_extensions_and_keepalive(self, server):
+        # Chunk extensions are ignored (RFC 7230 §4.1.1) and the decoder
+        # consumes the full frame, so the SECOND pipelined request is
+        # served off the same connection — no TE desync.
+        payload = (
+            b"POST /index/chk2 HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"2 ;ext=1\r\n{}\r\n0\r\n\r\n"  # BWS before ';' is grammar-legal
+            b"GET /status HTTP/1.1\r\nHost: x\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert out.startswith(b"HTTP/1.1 200"), out[:200]
+        assert out.count(b"HTTP/1.1 200") == 2, out[:400]
+
+    def test_chunked_trailers_rejected(self, server):
+        payload = (
+            b"POST /index/chk3 HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"2\r\n{}\r\n0\r\nX-Trailer: v\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+        assert out.count(b"HTTP/1.1 ") == 1  # connection closed
+
+    def test_chunked_with_content_length_rejected(self, server):
+        # TE + CL is the classic TE.CL smuggling shape (RFC 7230
+        # §3.3.3): reject outright, never pick a winner.
+        payload = (
+            b"POST /index/chk4 HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\nTransfer-Encoding: chunked\r\n\r\n"
             b"2\r\n{}\r\n0\r\n\r\n"
-            b"GET /smuggled HTTP/1.1\r\nHost: x\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_repeated_transfer_encoding_rejected(self, server):
+        # TE.TE: RFC 7230 joins repeated TE headers into a coding list
+        # ("chunked, gzip" — malformed, chunked not final); first-wins
+        # would decode framing a joining proxy sees differently.
+        payload = (
+            b"POST /index/chk8 HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n"
+            b"Transfer-Encoding: gzip\r\n\r\n"
+            b"2\r\n{}\r\n0\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_non_chunked_coding_still_501(self, server):
+        payload = (
+            b"POST /index/chk5 HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: gzip\r\n\r\n"
         )
         out = self._raw(server, payload)
         assert b" 501 " in out.split(b"\r\n", 1)[0], out[:200]
-        # One response only — the connection closed; the trailing bytes
-        # were never parsed as a second request.
-        assert out.count(b"HTTP/1.1 ") == 1
+
+    def test_chunked_size_cap_413(self, server):
+        # A declared chunk past the cap dies at the size line — the
+        # decoder never buffers unbounded frames.
+        payload = (
+            b"POST /index/chk6 HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"fffffff0\r\n"
+        )
+        out = self._raw(server, payload)
+        assert b" 413 " in out.split(b"\r\n", 1)[0], out[:200]
+
+    def test_chunked_malformed_size_rejected(self, server):
+        payload = (
+            b"POST /index/chk7 HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"zz\r\n{}\r\n0\r\n\r\n"
+        )
+        out = self._raw(server, payload)
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:200]
 
     def test_obs_fold_continuation_rejected_400(self, server):
         # RFC 7230 §3.2.4: a server must reject or normalize obs-fold;

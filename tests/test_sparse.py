@@ -29,6 +29,13 @@ def small_chunks(monkeypatch):
     monkeypatch.setattr(sparse, "MIN_CHUNKED_WORDS", 2 * (1 << 12))
     for b in sparse.BUCKETS:
         sparse._chunk_prog(None, b)
+    # Container-tier expansion programs (ISSUE r7): compiled so the
+    # warm-gate opens and feed_fragment actually ships containers.
+    sparse._chunk_zeros_prog(None)
+    sparse._or_prog(None)
+    sparse._pos_prog(None)
+    sparse._run_prog(None)
+    assert sparse.container_progs_ready(None)
 
 
 class TestCompressChunk:
@@ -139,6 +146,138 @@ class TestChunkedStackBuilder:
         host[0] = rng.integers(0, 2**32, size=(8, 512), dtype=np.uint32)
         host[3, 2, 17] = 42
         self._roundtrip(host)
+
+
+def _counter(name: str) -> float:
+    from pilosa_tpu.utils.stats import global_stats
+
+    return global_stats._counters.get((name, ()), 0)
+
+
+class TestContainerWire:
+    """Roaring-container wire tier (ISSUE r7): feed_fragment must build
+    bit-identical stacks to the dense pack while shipping 16-bit
+    positions / run spans instead of dense words."""
+
+    def _fragment(self, rng, n_rows=4, density_bits=3000, runs=False,
+                  bitmap=False):
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.core.view import VIEW_STANDARD
+        from pilosa_tpu.roaring.bitmap import Container
+
+        h = Holder(None).open()
+        f = h.create_index("i").create_field("f")
+        cols = np.unique(
+            rng.integers(0, SHARD_WIDTH, density_bits, dtype=np.uint64)
+        )
+        f.import_bits(
+            rng.integers(0, n_rows, cols.size, dtype=np.uint64), cols
+        )
+        fr = f.view(VIEW_STANDARD).fragment(0)
+        if runs:
+            # plant a run container directly (the time-quantum shape)
+            fr.storage.put_container(
+                1, Container.from_runs(
+                    np.array([[0, 5000], [5002, 5002], [60000, 65535]],
+                             dtype=np.int64)
+                )
+            )
+        if bitmap:
+            # force a bitmap container: > 4096 positions in one slot
+            pos = np.unique(
+                rng.integers(0, 65536, 9000).astype(np.uint16)
+            )
+            fr.storage.put_container(2, Container.from_positions(pos))
+        return h, fr
+
+    def _build(self, fr, rows_p, n_shards=4):
+        from pilosa_tpu.ops.blocks import WORDS_PER_SHARD, pack_fragment
+
+        shape = (n_shards, rows_p, WORDS_PER_SHARD)
+        b = sparse.ChunkedStackBuilder(None, shape)
+        b.feed_fragment(fr, rows_p)
+        b.skip((n_shards - 1) * rows_p * WORDS_PER_SHARD)
+        out = np.asarray(b.finish())
+        ref = np.zeros(shape, dtype=np.uint32)
+        ref[0] = pack_fragment(fr, n_rows=rows_p)
+        return b, out, ref
+
+    def test_array_containers_roundtrip(self, rng, small_chunks):
+        h, fr = self._fragment(rng)
+        try:
+            before = _counter("stack_container_chunks_total")
+            b, out, ref = self._build(fr, 8)
+            np.testing.assert_array_equal(out, ref)
+            assert _counter("stack_container_chunks_total") > before
+            assert 0 < b._wire_bytes < b._dense_bytes
+        finally:
+            h.close()
+
+    def test_run_and_bitmap_containers_roundtrip(self, rng, small_chunks):
+        h, fr = self._fragment(rng, runs=True, bitmap=True)
+        try:
+            runs_before = _counter("stack_container_runs_total")
+            b, out, ref = self._build(fr, 8)
+            np.testing.assert_array_equal(out, ref)
+            assert _counter("stack_container_runs_total") > runs_before
+        finally:
+            h.close()
+
+    def test_disabled_tier_matches_and_ships_dense(self, rng, small_chunks,
+                                                   monkeypatch):
+        h, fr = self._fragment(rng, runs=True)
+        try:
+            monkeypatch.setattr(sparse, "CONTAINER_TIER_ENABLED", False)
+            before = _counter("stack_container_chunks_total")
+            _, out, ref = self._build(fr, 8)
+            np.testing.assert_array_equal(out, ref)
+            assert _counter("stack_container_chunks_total") == before
+        finally:
+            h.close()
+
+    def test_not_warm_falls_back_dense(self, rng, small_chunks, monkeypatch):
+        # Close the warm-gate: container chunks must materialize dense
+        # (correct, just not container-wired) instead of compiling
+        # inline on the cold path.
+        h, fr = self._fragment(rng)
+        try:
+            monkeypatch.setattr(sparse, "container_progs_ready",
+                                lambda device: False)
+            before = _counter("stack_container_chunks_total")
+            _, out, ref = self._build(fr, 8)
+            np.testing.assert_array_equal(out, ref)
+            assert _counter("stack_container_chunks_total") == before
+        finally:
+            h.close()
+
+    def test_pending_bytes_bound_drains_early(self, rng, small_chunks,
+                                              monkeypatch):
+        # ADVICE r5 #2: with a tiny in-flight bound, the builder must
+        # fold pending chunks into the accumulator mid-build instead of
+        # holding every chunk's buffers until finish().
+        monkeypatch.setattr(sparse, "MAX_PENDING_BYTES", 1 << 12)
+        host = rng.integers(0, 2**32, size=(6, 8, 512), dtype=np.uint32)
+        drains_before = _counter("stack_pending_drains_total")
+        b = sparse.ChunkedStackBuilder(None, host.shape)
+        b.feed(host.reshape(-1))
+        assert _counter("stack_pending_drains_total") > drains_before
+        assert b._pending_bytes <= (1 << 12) + sparse.CHUNK_WORDS * 4
+        out = b.finish()
+        np.testing.assert_array_equal(np.asarray(out), host)
+
+    def test_skip_regions_are_zero(self, rng, small_chunks):
+        from pilosa_tpu.ops.blocks import WORDS_PER_SHARD
+
+        shape = (3, 8, 512)
+        b = sparse.ChunkedStackBuilder(None, shape)
+        slab = rng.integers(0, 2**32, size=8 * 512, dtype=np.uint32)
+        b.feed(slab)
+        b.skip(8 * 512)
+        b.feed(slab)
+        out = np.asarray(b.finish())
+        np.testing.assert_array_equal(out[0].reshape(-1), slab)
+        assert not out[1].any()
+        np.testing.assert_array_equal(out[2].reshape(-1), slab)
 
 
 class TestStackedBlocksSparseBuild:

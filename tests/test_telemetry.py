@@ -312,6 +312,197 @@ class TestVersionWalkCounters:
             holder.close()
 
 
+class TestJournalCompleteFreshness:
+    """ISSUE r7 tentpole: the pair, TopN, and GroupN serving tiers must
+    route epoch freshness through the journal-backed _epoch_versions —
+    under point-write churn their version_walk_total{kind=full} stays
+    FLAT while kind=journal pays exactly the dirty set."""
+
+    N_SHARDS = 6
+    ROWS = 4
+
+    def _tpu(self):
+        return pytest.importorskip(
+            "pilosa_tpu.exec.tpu",
+            reason="device backend needs jax.shard_map",
+            exc_type=ImportError,
+        )
+
+    def _build(self, holder, fields=("f", "g")):
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        idx = holder.create_index("i")
+        rng = np.random.default_rng(23)
+        for fname in fields:
+            f = idx.create_field(fname)
+            for shard in range(self.N_SHARDS):
+                cols = (
+                    np.unique(
+                        rng.integers(0, SHARD_WIDTH, 300, dtype=np.uint64)
+                    )
+                    + shard * SHARD_WIDTH
+                )
+                f.import_bits(
+                    rng.integers(0, self.ROWS, cols.size, dtype=np.uint64),
+                    cols,
+                )
+
+    def _set_stmt(self, rng, field="f"):
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        shard = int(rng.integers(0, self.N_SHARDS))
+        col = shard * SHARD_WIDTH + int(rng.integers(0, SHARD_WIDTH))
+        return f"Set({col}, {field}={int(rng.integers(0, self.ROWS))})"
+
+    def _walks(self, tier):
+        return {
+            kind: (
+                counter_sum(f'version_walk_total{{kind="{kind}",tier="{tier}"}}'),
+                counter_sum(
+                    f'version_walk_shards_total{{kind="{kind}",tier="{tier}"}}'
+                ),
+            )
+            for kind in ("full", "journal")
+        }
+
+    def test_pair_churn_walks_journal_backed(self):
+        tpu = self._tpu()
+        from pilosa_tpu.pql import parse_string
+
+        holder = Holder(None).open()
+        try:
+            self._build(holder)
+            be = tpu.TPUBackend(holder)
+            ex = Executor(holder, backend=be)
+            oracle = Executor(holder)
+            shards = list(range(self.N_SHARDS))
+            queries = [
+                "Count(Intersect(Row(f=1), Row(g=2)))",
+                "Count(Union(Row(f=0), Row(g=3)))",
+            ]
+            calls = [parse_string(q).calls[0].children[0] for q in queries]
+            be.count_batch("i", calls, shards)  # warm: sweep + full walks
+            w0 = self._walks("pair")
+            rng = np.random.default_rng(11)
+            epochs = 5
+            for _ in range(epochs):
+                ex.execute("i", self._set_stmt(rng))
+                got = be.count_batch("i", calls, shards)
+                want = [oracle.execute("i", f"{q}")[0] for q in queries]
+                assert got == want
+            w1 = self._walks("pair")
+            # Zero full walks under churn — the acceptance bar.
+            assert w1["full"] == w0["full"]
+            # Each epoch walks both pair sides through the journal; only
+            # f's one dirtied shard pays a locked read.
+            assert w1["journal"][0] - w0["journal"][0] == 2 * epochs
+            assert w1["journal"][1] - w0["journal"][1] == epochs
+        finally:
+            holder.close()
+
+    def test_topn_churn_walks_journal_backed(self):
+        tpu = self._tpu()
+        holder = Holder(None).open()
+        try:
+            self._build(holder, fields=("f",))
+            be = tpu.TPUBackend(holder)
+            ex = Executor(holder, backend=be)
+            oracle = Executor(holder)
+            shards = list(range(self.N_SHARDS))
+            be.topn_field("i", "f", shards, 0)  # warm
+            w0 = self._walks("topn")
+            rng = np.random.default_rng(13)
+            epochs = 5
+            for _ in range(epochs):
+                ex.execute("i", self._set_stmt(rng))
+                got = ex.execute("i", "TopN(f, n=8)")
+                want = oracle.execute("i", "TopN(f, n=8)")
+                assert got == want
+            w1 = self._walks("topn")
+            assert w1["full"] == w0["full"]
+            assert w1["journal"][0] - w0["journal"][0] == epochs
+            assert w1["journal"][1] - w0["journal"][1] == epochs
+        finally:
+            holder.close()
+
+    def test_groupn_churn_walks_journal_backed(self):
+        tpu = self._tpu()
+        holder = Holder(None).open()
+        try:
+            self._build(holder, fields=("f", "g", "h"))
+            be = tpu.TPUBackend(holder)
+            ex = Executor(holder, backend=be)
+            oracle = Executor(holder)
+            q = "GroupBy(Rows(f), Rows(g), Rows(h))"
+            assert ex.execute("i", q) == oracle.execute("i", q)  # warm
+            w0 = self._walks("groupn")
+            rng = np.random.default_rng(29)
+            epochs = 4
+            for _ in range(epochs):
+                ex.execute("i", self._set_stmt(rng))
+                assert ex.execute("i", q) == oracle.execute("i", q)
+            w1 = self._walks("groupn")
+            assert w1["full"] == w0["full"]
+            # Three fields walked per epoch; one dirtied shard total.
+            assert w1["journal"][0] - w0["journal"][0] == 3 * epochs
+            assert w1["journal"][1] - w0["journal"][1] == epochs
+        finally:
+            holder.close()
+
+    def test_epoch_versions_differential_vs_live(self):
+        """Journal-derived versions must equal the full locked walk in
+        every regime: journal-covered epochs, evicted windows, and
+        structural (new-fragment) events."""
+        tpu = self._tpu()
+        from pilosa_tpu.core.view import VIEW_STANDARD
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        holder = Holder(None).open()
+        try:
+            self._build(holder)
+            be = tpu.TPUBackend(holder)
+            ex = Executor(holder, backend=be)
+            f = be._field("i", "f")
+            shards_t = tuple(range(self.N_SHARDS))
+            rng = np.random.default_rng(31)
+
+            def snap():
+                v = f.view(VIEW_STANDARD)
+                return be._live_versions(f, shards_t), v.generation
+
+            # journal-covered: a few point writes
+            vers_old, gen_old = snap()
+            for _ in range(3):
+                ex.execute("i", self._set_stmt(rng))
+            assert be._epoch_versions(
+                f, shards_t, VIEW_STANDARD, vers_old, gen_old
+            ) == be._live_versions(f, shards_t)
+
+            # evicted window: more writes than the journal retains
+            from pilosa_tpu.core.view import View
+
+            vers_old, gen_old = snap()
+            for _ in range(View.JOURNAL_MAX + 8):
+                ex.execute("i", self._set_stmt(rng))
+            assert be._epoch_versions(
+                f, shards_t, VIEW_STANDARD, vers_old, gen_old
+            ) == be._live_versions(f, shards_t)
+
+            # structural event: a write creating a NEW shard's fragment
+            vers_old, gen_old = snap()
+            ex.execute(
+                "i", f"Set({self.N_SHARDS * SHARD_WIDTH + 7}, f=1)"
+            )
+            shards_t2 = tuple(range(self.N_SHARDS + 1))
+            live = be._live_versions(f, shards_t2)
+            assert be._epoch_versions(
+                f, shards_t2, VIEW_STANDARD,
+                vers_old + (None,), gen_old
+            ) == live
+        finally:
+            holder.close()
+
+
 class TestBenchCaptureProof:
     def test_post_retries_once_on_reset(self, server):
         """The r5 failure shape: ONE mid-run connection reset must cost a
