@@ -260,6 +260,62 @@ def walk_delta(before: dict, after: dict) -> dict:
     }
 
 
+#: Counter families embedded per leg in the BENCH JSON (ISSUE r8): every
+#: checkpoint carries the registry deltas its leg produced, so the perf
+#: trajectory ships its own attribution (peer RPC health, walk kinds,
+#: wire-tier engagement) instead of one end-of-run blob.
+LEG_COUNTER_FAMILIES = (
+    "peer_rpc_errors_total",
+    "peer_rpc_retries_total",
+    "version_walk_total",
+    "stack_container_",
+    "stack_sparse_",
+    "stack_pending_drains_total",
+    "stack_incremental_",
+    "stack_update_bytes_total",
+    "hbm_page_",
+    "http_connection_aborts_total",
+    "trace_spans_dropped_total",
+)
+
+
+def leg_counter_snapshot() -> dict:
+    """Current values of the embedded counter families (full series
+    names, tags included). In-process registry read: the bench server
+    and the direct-backend legs share global_stats."""
+    snap = global_stats.snapshot()["counters"]
+    return {
+        k: v for k, v in snap.items() if k.startswith(LEG_COUNTER_FAMILIES)
+    }
+
+
+def leg_metrics_delta(before: dict) -> tuple[dict, dict]:
+    """({'counters': nonzero deltas since `before`, 'hbm': current
+    residency gauges incl. the per-tier split}, after-snapshot) for one
+    completed leg. The caller reuses the returned after-snapshot as the
+    next leg's baseline — re-snapshotting would drop any increment that
+    lands between the two reads (the HTTP leg's server threads share
+    global_stats) from BOTH legs' deltas."""
+    snap = global_stats.snapshot()
+    after = {
+        k: v
+        for k, v in snap["counters"].items()
+        if k.startswith(LEG_COUNTER_FAMILIES)
+    }
+    deltas = {
+        k: round(v - before.get(k, 0.0))
+        for k, v in after.items()
+        if v - before.get(k, 0.0) > 0
+    }
+    hbm = {
+        k: v
+        for k, v in snap["gauges"].items()
+        if k.startswith(("hbm_resident_bytes", "hbm_evictions_total",
+                         "tpu_resident_bytes"))
+    }
+    return {"counters": deltas, "hbm": hbm}, after
+
+
 def build_index(h: Holder):
     """The timed build: the 1B-column bitmap index (f, g, h) — the same
     content as rounds 1-4, so build_seconds stays comparable. Column
@@ -773,13 +829,27 @@ def main():
         except OSError:
             pass
 
+    leg_snap = [leg_counter_snapshot()]
+    backend_ref = [None]  # set once the device backend exists
+
     def checkpoint(leg: str, **kv) -> None:
         """Capture-proof artifact (VERDICT r5 next-round #1b): rewrite
         the accumulated results after EVERY completed leg — a crash in
         leg N+1 leaves legs 1..N parseable in BENCH_partial.json (and
-        on stderr) instead of a parsed=null artifact."""
+        on stderr) instead of a parsed=null artifact. Each checkpoint
+        also embeds the leg's counter deltas + current HBM tier gauges
+        (ISSUE r8: the numbers carry their own attribution)."""
+        if backend_ref[0] is not None:
+            # Refresh the HBM residency/tier gauges from the live block
+            # store so every leg's snapshot carries CURRENT tier bytes,
+            # not the last scrape's.
+            from pilosa_tpu.utils.monitor import RuntimeMonitor
+
+            RuntimeMonitor(backend=backend_ref[0]).poll_once()
         out.update(kv)
         out["legs_done"].append(leg)
+        delta, leg_snap[0] = leg_metrics_delta(leg_snap[0])
+        out.setdefault("leg_metrics", {})[leg] = delta
         blob = json.dumps(out)
         write_artifact(blob)
         print(blob, file=sys.stderr, flush=True)
@@ -813,6 +883,7 @@ def main():
     from pilosa_tpu.exec.tpu import TPUBackend
 
     be = TPUBackend(h)
+    backend_ref[0] = be
     warm_ok = _wait_sparse_warm(be.blocks.device)
     cold_s, cold_dense_s, cont_counters = bench_cold_build(h, be)
     checkpoint(

@@ -196,6 +196,9 @@ class HTTPBroadcaster:
                 json_payload = JSONSerializer().marshal(msg)
             if json_payload == payload:
                 raise  # frame WAS JSON; nothing better to offer
+        from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
+
+        count_rpc_retry(peer_label(node), "send_message")
         self.client.send_message(node, json_payload)
         if node_id is not None:
             self._json_peers.add(node_id)

@@ -11,11 +11,14 @@ HTTP-status failure so the scatter-gather layer can retry replicas.
 from __future__ import annotations
 
 import json
+import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Optional, Sequence, Union
 
 from pilosa_tpu.cluster.topology import URI, Node
+from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.utils.tracing import global_tracer
 
 
@@ -32,6 +35,43 @@ def _uri_str(uri: Union[URI, Node, str]) -> str:
     if isinstance(uri, Node):
         uri = uri.uri
     return str(uri)
+
+
+def peer_label(uri: Union[URI, Node, str]) -> str:
+    """host:port tag value for per-peer RPC series. Node ids would read
+    better but the client routinely dials bare URIs (resize sources,
+    rejoin announces) where no id exists; host:port is the one identity
+    every call site has."""
+    u = _uri_str(uri)
+    _, _, hostport = u.partition("://")
+    return hostport or u
+
+
+# Per-peer in-flight request counts behind the peer_rpc_inflight gauge:
+# the client is shared across serving threads, so the counter lives at
+# module scope under one lock and each _do publishes the new value.
+_inflight_lock = threading.Lock()
+_inflight: dict[str, int] = {}
+
+
+def _track_inflight(peer: str, delta: int) -> None:
+    with _inflight_lock:
+        n = _inflight.get(peer, 0) + delta
+        _inflight[peer] = n
+        # Published INSIDE the lock: otherwise two racing updates can
+        # publish in inverted order and pin the gauge at a stale nonzero
+        # value — the exact stuck-peer signature operators alert on.
+        global_stats.with_tags(f"peer:{peer}").gauge("peer_rpc_inflight", n)
+
+
+def count_rpc_retry(peer: str, method: str) -> None:
+    """One retargeted/re-sent peer RPC (scatter-gather re-split onto a
+    replica, schema-repair re-query, wire renegotiation). The client
+    itself never retries — the layers above do — so they report here to
+    keep every peer_rpc_* series in one vocabulary."""
+    global_stats.with_tags(f"peer:{peer}", f"method:{method}").count(
+        "peer_rpc_retries_total"
+    )
 
 
 def _ts_epoch(t) -> int:
@@ -65,8 +105,16 @@ class InternalClient:
         body: Optional[bytes] = None,
         content_type: str = "application/json",
         raw: bool = False,
+        op: str = "",
     ):
         url = _uri_str(uri) + path
+        # Per-peer, per-method RPC telemetry (ISSUE r8 tentpole 2): the
+        # first signal for "replica N is degraded". op is the client
+        # method name (query_node, block_data, ...) — the path would
+        # explode series cardinality with per-index/shard values.
+        peer = peer_label(uri)
+        op = op or method
+        stats = global_stats.with_tags(f"peer:{peer}", f"method:{op}")
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
@@ -79,26 +127,36 @@ class InternalClient:
         if span is not None:
             for k, v in span.inject_headers().items():
                 req.add_header(k, v)
+        _track_inflight(peer, +1)
+        t0 = time.perf_counter()
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self.ssl_context
-            ) as resp:
-                data = resp.read()
-        except urllib.error.HTTPError as e:
-            detail = ""
-            err_code = ""
             try:
-                detail = e.read().decode("utf-8", "replace")
-                err_code = json.loads(detail).get("code", "")
-            except Exception:
-                pass
-            raise ClientError(
-                f"{method} {url}: status {e.code}: {detail}",
-                status=e.code,
-                code=err_code,
-            ) from e
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise ClientError(f"{method} {url}: {e}") from e
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self.ssl_context
+                ) as resp:
+                    data = resp.read()
+            except urllib.error.HTTPError as e:
+                detail = ""
+                err_code = ""
+                try:
+                    detail = e.read().decode("utf-8", "replace")
+                    err_code = json.loads(detail).get("code", "")
+                except Exception:
+                    pass
+                stats.with_tags(f"class:{e.code // 100}xx").count(
+                    "peer_rpc_errors_total"
+                )
+                raise ClientError(
+                    f"{method} {url}: status {e.code}: {detail}",
+                    status=e.code,
+                    code=err_code,
+                ) from e
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                stats.with_tags("class:transport").count("peer_rpc_errors_total")
+                raise ClientError(f"{method} {url}: {e}") from e
+        finally:
+            stats.timing("peer_rpc_seconds", time.perf_counter() - t0)
+            _track_inflight(peer, -1)
         if raw:
             return data
         if not data:
@@ -106,6 +164,7 @@ class InternalClient:
         try:
             return json.loads(data)
         except json.JSONDecodeError as e:
+            stats.with_tags("class:decode").count("peer_rpc_errors_total")
             raise ClientError(f"{method} {url}: invalid JSON response: {e}") from e
 
     # -- queries (reference http/client.go QueryNode :268) -----------------
@@ -126,7 +185,8 @@ class InternalClient:
             params.append("remote=true")
         if params:
             path += "?" + "&".join(params)
-        out = self._do("POST", uri, path, query.encode(), content_type="text/plain")
+        out = self._do("POST", uri, path, query.encode(), content_type="text/plain",
+                       op="query_node")
         if "error" in out:
             raise ClientError(out["error"])
         return out
@@ -135,20 +195,20 @@ class InternalClient:
 
     def create_index(self, uri, index: str, options: Optional[dict] = None) -> None:
         body = json.dumps({"options": options or {}}).encode()
-        self._do("POST", uri, f"/index/{index}", body)
+        self._do("POST", uri, f"/index/{index}", body, op="create_index")
 
     def create_field(self, uri, index: str, field: str, options: Optional[dict] = None) -> None:
         body = json.dumps({"options": options or {}}).encode()
-        self._do("POST", uri, f"/index/{index}/field/{field}", body)
+        self._do("POST", uri, f"/index/{index}/field/{field}", body, op="create_field")
 
     def schema(self, uri) -> dict:
-        return self._do("GET", uri, "/schema")
+        return self._do("GET", uri, "/schema", op="schema")
 
     def status(self, uri) -> dict:
-        return self._do("GET", uri, "/status")
+        return self._do("GET", uri, "/status", op="status")
 
     def max_shards(self, uri) -> dict:
-        return self._do("GET", uri, "/internal/shards/max")
+        return self._do("GET", uri, "/internal/shards/max", op="max_shards")
 
     # -- imports (reference http/client.go Import/ImportRoaring) -----------
 
@@ -168,7 +228,8 @@ class InternalClient:
             views=[ImportRoaringRequestView(name, data) for name, data in views.items()],
         )
         path = f"/index/{index}/field/{field}/import-roaring/{shard}?remote=true"
-        self._do("POST", uri, path, req.to_bytes(), content_type="application/x-protobuf")
+        self._do("POST", uri, path, req.to_bytes(), content_type="application/x-protobuf",
+                 op="import_roaring")
 
     def import_bits(self, uri, index: str, field: str, shard: int,
                     row_ids: Sequence[int], column_ids: Sequence[int],
@@ -186,7 +247,8 @@ class InternalClient:
         path = f"/index/{index}/field/{field}/import?remote=true"
         if clear:
             path += "&clear=true"
-        self._do("POST", uri, path, req.to_bytes(), content_type="application/x-protobuf")
+        self._do("POST", uri, path, req.to_bytes(),
+                 content_type="application/x-protobuf", op="import_bits")
 
     def import_values(self, uri, index: str, field: str, shard: int,
                       column_ids: Sequence[int], values: Sequence[int],
@@ -200,7 +262,8 @@ class InternalClient:
         path = f"/index/{index}/field/{field}/import?remote=true"
         if clear:
             path += "&clear=true"
-        self._do("POST", uri, path, req.to_bytes(), content_type="application/x-protobuf")
+        self._do("POST", uri, path, req.to_bytes(),
+                 content_type="application/x-protobuf", op="import_values")
 
     # -- fragment sync (reference http/client.go:591-780) ------------------
 
@@ -208,6 +271,7 @@ class InternalClient:
         out = self._do(
             "GET", uri,
             f"/internal/fragment/blocks?index={index}&field={field}&view={view}&shard={shard}",
+            op="fragment_blocks",
         )
         return [(int(b["id"]), int(b["checksum"])) for b in out.get("blocks", [])]
 
@@ -217,6 +281,7 @@ class InternalClient:
             f"/internal/fragment/block/data?index={index}&field={field}&view={view}"
             f"&shard={shard}&block={block}",
             raw=True,
+            op="block_data",
         )
 
     def retrieve_shard(self, uri, index: str, field: str, view: str, shard: int) -> bytes:
@@ -226,13 +291,15 @@ class InternalClient:
             "GET", uri,
             f"/internal/fragment/data?index={index}&field={field}&view={view}&shard={shard}",
             raw=True,
+            op="retrieve_shard",
         )
 
     def field_state(self, uri, index: str, field: str) -> dict:
         """Peer field state: view names + available shards (anti-entropy
         discovery; the reference ships this in NodeStatus gossip)."""
         return self._do(
-            "GET", uri, f"/internal/field/state?index={index}&field={field}"
+            "GET", uri, f"/internal/field/state?index={index}&field={field}",
+            op="field_state",
         )
 
     # -- attr sync (reference attr.go Blocks/BlockData) --------------------
@@ -241,21 +308,21 @@ class InternalClient:
         path = f"/internal/attr/blocks?index={index}"
         if field:
             path += f"&field={field}"
-        out = self._do("GET", uri, path)
+        out = self._do("GET", uri, path, op="attr_blocks")
         return [(int(b["id"]), int(b["checksum"])) for b in out.get("blocks", [])]
 
     def attr_block_data(self, uri, index: str, field: Optional[str], block: int) -> dict:
         path = f"/internal/attr/block/data?index={index}&block={block}"
         if field:
             path += f"&field={field}"
-        out = self._do("GET", uri, path)
+        out = self._do("GET", uri, path, op="attr_block_data")
         return {int(k): v for k, v in out.get("attrs", {}).items()}
 
     # -- control plane -----------------------------------------------------
 
     def send_message(self, uri, payload: bytes) -> None:
         self._do("POST", uri, "/internal/cluster/message", payload,
-                 content_type="application/octet-stream")
+                 content_type="application/octet-stream", op="send_message")
 
     def export_csv_shard(self, uri, index: str, field: str, shard: int) -> str:
         """One shard's CSV from the node that holds it (whole-field
@@ -266,6 +333,7 @@ class InternalClient:
             "GET", uri,
             f"/export?index={quote(index)}&field={quote(field)}&shard={shard}",
             raw=True,
+            op="export_csv_shard",
         )
         return raw.decode()
 
@@ -273,12 +341,33 @@ class InternalClient:
 
     def translate_keys(self, uri, index: str, field: str, keys: Sequence[str]) -> list[int]:
         body = json.dumps({"index": index, "field": field, "keys": list(keys)}).encode()
-        out = self._do("POST", uri, "/internal/translate/keys", body)
+        out = self._do("POST", uri, "/internal/translate/keys", body,
+                       op="translate_keys")
         return [int(v) for v in out.get("ids", [])]
 
     def translate_data(self, uri, index: str, field: str = "", offset: int = 0) -> list:
         out = self._do(
             "GET", uri,
             f"/internal/translate/data?index={index}&field={field}&offset={offset}",
+            op="translate_data",
         )
         return out.get("entries", [])
+
+    # -- observability plane (ISSUE r8) ------------------------------------
+
+    def node_traces(self, uri, trace_id: str) -> list[dict]:
+        """One node's local spans for a trace — the per-node leg of
+        /debug/traces/<id> distributed assembly."""
+        out = self._do("GET", uri, f"/internal/traces/{trace_id}",
+                       op="node_traces")
+        return out.get("spans", [])
+
+    def metrics_text(self, uri) -> str:
+        """One node's raw prometheus exposition — the federation scrape
+        behind /metrics/cluster."""
+        return self._do("GET", uri, "/metrics", raw=True,
+                        op="metrics_text").decode("utf-8", "replace")
+
+    def debug_vars(self, uri) -> dict:
+        """One node's expvar-style registry dump (/debug/cluster leg)."""
+        return self._do("GET", uri, "/debug/vars", op="debug_vars")

@@ -135,7 +135,17 @@ class Cluster:
 
     def set_state(self, state: str) -> None:
         with self._state_lock:
+            changed = state != self._state
             self._state = state
+        if changed:
+            # NORMAL<->DEGRADED<->RESIZING transitions on /metrics: a
+            # wedged resize shows up as a RESIZING transition with no
+            # matching NORMAL, next to a flatlined migration gauge.
+            from pilosa_tpu.utils.stats import global_stats
+
+            global_stats.with_tags(f"state:{state}").count(
+                "cluster_state_transitions_total"
+            )
 
     def is_coordinator(self) -> bool:
         return self.local_node.is_coordinator
@@ -254,7 +264,16 @@ class Cluster:
         if not nodes:
             nodes = list(self.topology.nodes)
         ch: "queue.Queue[_MapResponse]" = queue.Queue()
-        self._launch(ch, nodes, index, shards, c, map_fn, reduce_fn, opt)
+        # The caller's active span (executor.Execute / the HTTP span) is
+        # captured HERE because the mapper legs run on fresh threads whose
+        # thread-local span stacks are empty — without handing the parent
+        # over, the client would find no active span and the trace would
+        # die at the node boundary (ISSUE r8 tentpole 1).
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        parent_span = global_tracer.active_span()
+        self._launch(ch, nodes, index, shards, c, map_fn, reduce_fn, opt,
+                     parent_span)
 
         result = None
         got_any = False
@@ -271,9 +290,13 @@ class Cluster:
             if resp.err is not None:
                 # Filter the failed node, re-split its shards across the
                 # remaining replicas (reference :2497-2507).
+                from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
+
+                count_rpc_retry(peer_label(resp.node), "query_node")
                 nodes = [n for n in nodes if n.id != resp.node.id]
                 try:
-                    self._launch(ch, nodes, index, resp.shards, c, map_fn, reduce_fn, opt)
+                    self._launch(ch, nodes, index, resp.shards, c, map_fn,
+                                 reduce_fn, opt, parent_span)
                 except ShardUnavailableError:
                     raise resp.err
                 continue
@@ -299,17 +322,37 @@ class Cluster:
             m.setdefault(owner.id, (owner, []))[1].append(shard)
         return m
 
-    def _launch(self, ch, nodes, index, shards, c, map_fn, reduce_fn, opt) -> None:
+    def _launch(self, ch, nodes, index, shards, c, map_fn, reduce_fn, opt,
+                parent_span=None) -> None:
         groups = self._shards_by_node(nodes, index, shards)
         for node, node_shards in groups.values():
             t = threading.Thread(
                 target=self._map_node,
-                args=(ch, node, node_shards, index, c, map_fn, reduce_fn, opt),
+                args=(ch, node, node_shards, index, c, map_fn, reduce_fn, opt,
+                      parent_span),
                 daemon=True,
             )
             t.start()
 
-    def _map_node(self, ch, node, node_shards, index, c, map_fn, reduce_fn, opt) -> None:
+    def _map_node(self, ch, node, node_shards, index, c, map_fn, reduce_fn, opt,
+                  parent_span=None) -> None:
+        # Re-establish the trace context on this worker thread: one child
+        # span per scatter-gather leg, tagged with the target node, so a
+        # slow leg is directly visible in the assembled cross-node tree
+        # (and remote legs inject X-Trace-Id via the client).
+        span = None
+        if parent_span is not None:
+            from pilosa_tpu.utils.tracing import global_tracer
+
+            span = global_tracer.start_span(
+                "cluster.mapShards", headers=parent_span.inject_headers()
+            )
+            # targetNode, NOT node: the node tag means "where this span
+            # RAN" to the trace assembler (origin attribution + the
+            # cross-node clock-skew check), and this span runs on the
+            # coordinator regardless of which peer the leg targets.
+            span.set_tag("targetNode", node.id)
+            span.set_tag("shards", len(node_shards))
         resp = _MapResponse(node=node, shards=node_shards)
         try:
             if node.id == self.local_node.id:
@@ -324,6 +367,11 @@ class Cluster:
                 resp.result = self._remote_exec(node, index, c, node_shards)
         except Exception as e:  # transport or peer error -> retried upstream
             resp.err = e
+            if span is not None:
+                span.set_tag("error", str(e)[:200])
+        finally:
+            if span is not None:
+                span.finish()
         ch.put(resp)
 
     def _remote_exec(self, node, index, c, shards):
@@ -351,6 +399,9 @@ class Cluster:
                 raise
             self._repair_attempted[repair_key] = time.monotonic()
             self._push_state_to(node, index)
+            from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
+
+            count_rpc_retry(peer_label(node), "query_node")
             out = self.client.query_node(
                 node, index, c.to_string(), shards=shards, remote=True
             )
@@ -394,8 +445,20 @@ class Cluster:
         results: list[Any] = [None] * len(peers)
         errs: list[Exception] = []
         lock = threading.Lock()
+        # Same cross-thread trace handoff as map_shards: replica writes
+        # run on fresh threads, so the parent span is captured here.
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        parent_span = global_tracer.active_span()
 
         def send(i, node):
+            span = None
+            if parent_span is not None:
+                span = global_tracer.start_span(
+                    "cluster.replicaWrite",
+                    headers=parent_span.inject_headers(),
+                )
+                span.set_tag("targetNode", node.id)
             try:
                 out = self.client.query_node(
                     node, index, pql,
@@ -407,6 +470,9 @@ class Cluster:
             except Exception as e:
                 with lock:
                     errs.append(e)
+            finally:
+                if span is not None:
+                    span.finish()
 
         threads = [
             threading.Thread(target=send, args=(i, n), daemon=True)

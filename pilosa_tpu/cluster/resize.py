@@ -35,6 +35,7 @@ from pilosa_tpu.cluster.topology import (
     Topology,
 )
 from pilosa_tpu.utils.logger import NopLogger
+from pilosa_tpu.utils.stats import global_stats
 
 
 class ResizeError(Exception):
@@ -153,6 +154,11 @@ class Resizer:
         job = self._job_id
         self._active_job = job
         self._new_nodes = new_topo.nodes
+        # Counted the moment the job is armed, not after start succeeds:
+        # a start that fails mid-delivery runs abort() (counting
+        # resize_jobs_aborted_total), and started >= completed + aborted
+        # must hold for any jobs-in-flight dashboard expression.
+        global_stats.count("resize_jobs_started_total")
         instructions = self._build_instructions(old_topo, new_topo, removed)
         # DOWN members cannot follow instructions or report completion —
         # waiting on them (or fail-fasting on their freeze delivery)
@@ -223,6 +229,7 @@ class Resizer:
             self.log.printf("resize: job %d failed to start: %s", job, e)
             self.abort()
             raise
+        global_stats.gauge("resize_pending_nodes", len(self._pending_nodes))
         self._arm_timeout(job)
         return job
 
@@ -374,7 +381,14 @@ class Resizer:
                 if f is not None:
                     for s in shards:
                         f.add_available_shard(int(s))
-        for src in msg.get("sources", []):
+        # Shard-migration progress gauges (ISSUE r8): a wedged resize is
+        # a flatlined resize_migration_sources_done under a nonzero
+        # _total, instead of silence. Totals are per-instruction (they
+        # reset when the next job's instruction arrives).
+        sources = msg.get("sources", [])
+        global_stats.gauge("resize_migration_sources_total", len(sources))
+        global_stats.gauge("resize_migration_sources_done", 0)
+        for n_done, src in enumerate(sources):
             index, field_name = src["index"], src["field"]
             shard, from_uri = int(src["shard"]), src["from"]
             idx = holder.index(index) if holder else None
@@ -397,6 +411,13 @@ class Resizer:
                     continue  # fragment absent in this view
                 f.import_roaring(shard, data, view_name=view_name)
             f.add_available_shard(shard)
+            global_stats.count("resize_fragments_fetched_total")
+            global_stats.gauge("resize_migration_sources_done", n_done + 1)
+        # Unconditional final set: sources skipped at the tail (field not
+        # held locally) must not leave _done below _total forever — that
+        # is the wedged-resize signature and would be a standing false
+        # alarm on a job that completed fine.
+        global_stats.gauge("resize_migration_sources_done", len(sources))
         self._needs_clean = True
 
     # -- coordinator: completion tracking (reference cluster.go:1413) ------
@@ -414,6 +435,7 @@ class Resizer:
                     msg.get("node"), msg.get("error"),
                 )
             self._pending_nodes.discard(msg.get("node"))
+            global_stats.gauge("resize_pending_nodes", len(self._pending_nodes))
             if self._pending_nodes or self._new_nodes is None:
                 return
             new_nodes = self._new_nodes
@@ -424,6 +446,10 @@ class Resizer:
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
+        # Counted at the decision point, BEFORE the status broadcast: an
+        # observer that sees the cluster flip to NORMAL must already see
+        # the completion on /metrics.
+        global_stats.count("resize_jobs_completed_total")
         # Flip the whole cluster to the new topology atomically via one
         # status broadcast; receivers clean unowned fragments. Recipients
         # are old∪new members (send_sync would miss the joiner/leaver
@@ -452,6 +478,9 @@ class Resizer:
         with self._lock:
             if only_job is not None and self._active_job != only_job:
                 return  # job completed/was replaced while we decided
+            if self._active_job is not None:
+                global_stats.count("resize_jobs_aborted_total")
+            global_stats.gauge("resize_pending_nodes", 0)
             self._pending_nodes = set()
             self._new_nodes = None
             self._active_job = None

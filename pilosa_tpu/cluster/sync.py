@@ -30,6 +30,16 @@ from typing import Optional
 from pilosa_tpu.cluster.client import ClientError
 from pilosa_tpu.cluster.topology import NODE_STATE_DOWN, NODE_STATE_READY
 from pilosa_tpu.utils.logger import NopLogger
+from pilosa_tpu.utils.stats import global_stats
+
+
+def _count_transition(node_id: str, to_state: str) -> None:
+    """Membership state-transition counter (ISSUE r8): a flapping peer
+    shows up as a climbing DOWN/READY pair on /metrics instead of only
+    as interleaved log lines."""
+    global_stats.with_tags(f"peer:{node_id}", f"to:{to_state}").count(
+        "cluster_node_state_transitions_total"
+    )
 
 # Consecutive probe failures before a peer is declared down
 # (the reference re-checks a leave event before acting, cluster.go:65).
@@ -441,10 +451,14 @@ class FailureDetector:
                 if node.state == NODE_STATE_DOWN:
                     node.state = NODE_STATE_READY
                     self.log.printf("node %s is back up", node.id)
+                    _count_transition(node.id, NODE_STATE_READY)
                     self._disseminate(node.id, NODE_STATE_READY)
                     self._heal_returning_node(node)
                 self._merge_peer_view(node, st)
             else:
+                global_stats.with_tags(f"peer:{node.id}").count(
+                    "cluster_probe_failures_total"
+                )
                 self._fails[node.id] = self._fails.get(node.id, 0) + 1
                 if (
                     self._fails[node.id] >= self.confirm_down
@@ -452,6 +466,7 @@ class FailureDetector:
                 ):
                     node.state = NODE_STATE_DOWN
                     self.log.printf("node %s marked down", node.id)
+                    _count_transition(node.id, NODE_STATE_DOWN)
                     self._disseminate(node.id, NODE_STATE_DOWN)
         # Cluster state follows membership (reference determineClusterState
         # cluster.go:571): any down node + replication -> DEGRADED.
@@ -510,6 +525,7 @@ class FailureDetector:
                         "node %s marked down (peer %s's observation)",
                         nid, peer.id,
                     )
+                    _count_transition(nid, NODE_STATE_DOWN)
                     self._disseminate(nid, NODE_STATE_DOWN)
         peer_coord = next(
             (nd.get("id") for nd in st.get("nodes", []) if nd.get("isCoordinator")),
@@ -585,6 +601,7 @@ class FailureDetector:
         self.log.printf(
             "coordinator %s is down: promoting self (%s)", coord.id, successor.id
         )
+        global_stats.count("cluster_coordinator_promotions_total")
         from pilosa_tpu.cluster import broadcast as bc
 
         for n in topo.nodes:

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -59,6 +60,7 @@ from pilosa_tpu.ops.blocks import (
     ROW_PAD,
     WORDS_PER_SHARD,
     _padded_rows,
+    fragment_tier_words,
     pack_fragment,
     pack_row,
     pack_rows,
@@ -132,6 +134,13 @@ class _StackedBlocks:
         # key -> (fingerprint, device array, rows_p, per-shard versions).
         self._entries: dict[tuple, tuple[tuple, object, int, Optional[tuple]]] = {}
         self.evictions = 0
+        # Per-entry HBM ledger (ISSUE r8 tentpole 4): resident bytes
+        # split by representation tier (dense / array-container /
+        # run-container source), upload epoch, access count, last-access
+        # time. Keys mirror _entries; served at /debug/hbm sorted by
+        # coldness and rolled up as hbm_resident_bytes{tier} gauges.
+        self._ledger: dict[tuple, dict] = {}
+        self._upload_epoch = 0
         # One compiled in-place slice writer per stack shape (traced shard
         # index, so any dirty shard reuses the same program).
         self._update_fns: dict = {}
@@ -192,14 +201,31 @@ class _StackedBlocks:
                 stale, shards, min_rows, frags, vers, rows_p, s_pad
             )
             if updated is not None:
-                return updated, rows_p, vers
+                # tiers=None: the ledger keeps the previous split (the
+                # splice touched O(dirty) shards; re-walking EVERY
+                # container for attribution would re-add exactly the
+                # O(all-shards) host work the incremental path removes —
+                # the mix re-trues on the next full rebuild).
+                return updated, rows_p, vers, None
             nbytes = s_pad * rows_p * WORDS_PER_SHARD * 4
             if self.max_bytes is not None and nbytes > self.max_bytes:
                 # Stack can never be resident under the budget: the caller
                 # falls back to row paging or the CPU oracle instead of
                 # blowing HBM. Not cached (None entries are cheap to
                 # recompute and must not evict real stacks).
-                return None, rows_p, vers
+                return None, rows_p, vers, None
+            # Ledger tier attribution, full builds only: which source
+            # containers back the resident words (independent of the
+            # WIRE tier each chunk chose — the ledger answers "what
+            # representation mix is this HBM holding", the wire counters
+            # answer "what did the upload cost"). O(containers), paid
+            # only where the pack itself is already O(everything).
+            tiers = [0, 0]
+            for fr in frags.values():
+                if fr is not None:
+                    a, r = fragment_tier_words(fr, rows_p)
+                    tiers[0] += a
+                    tiers[1] += r
             shape = (s_pad, rows_p, WORDS_PER_SHARD)
             if self.mesh is None and (nbytes // 4) >= MIN_CHUNKED_WORDS:
                 # Streaming packed upload (VERDICT r4 #1): shard slabs
@@ -247,7 +273,7 @@ class _StackedBlocks:
                     jax.device_put(slabs0, self.device),
                     jax.device_put(ix, self.device),
                 )
-            return arr, rows_p, vers
+            return arr, rows_p, vers, tiers
 
         return self._cached_build(key, fingerprint, build)
 
@@ -342,7 +368,7 @@ class _StackedBlocks:
                     host[i, 0] = pack_row(fr, row_id)
             global_stats.count("hbm_page_uploads_total")
             global_stats.count("hbm_page_bytes_total", host.nbytes)
-            return self._put(host), 1, None
+            return self._put(host), 1, None, None
 
         return self._cached_build(key, fingerprint, build)[0]
 
@@ -362,16 +388,20 @@ class _StackedBlocks:
         """Shared hit/latch/build/evict protocol for stack and row-page
         entries. build(stale) receives the stale entry for this key (or
         None) so it can refresh incrementally, and returns
-        (device_array_or_None, rows_p, shard_versions); a None array
-        means 'cannot be resident' and is returned uncached. Concurrent
-        misses for one key build once (losers wait on the winner's
-        latch, then re-check)."""
+        (device_array_or_None, rows_p, shard_versions, tier_words); a
+        None array means 'cannot be resident' and is returned uncached.
+        Concurrent misses for one key build once (losers wait on the
+        winner's latch, then re-check)."""
         while True:
             with self._lock:
                 cached = self._entries.get(key)
                 if cached is not None and cached[0] == fingerprint:
                     # LRU touch.
                     self._entries[key] = self._entries.pop(key)
+                    led = self._ledger.get(key)
+                    if led is not None:
+                        led["access_count"] += 1
+                        led["last_access"] = time.time()
                     return cached[1], cached[2]
                 latch = self._building.get(key)
                 if latch is None:
@@ -381,17 +411,51 @@ class _StackedBlocks:
             # its fingerprint usually matches ours (same live fragments).
             latch.wait()
         try:
-            arr, rows_p, vers = build(cached)
+            arr, rows_p, vers, tiers = build(cached)
             if arr is None:
                 return None, rows_p
             with self._lock:
                 self._entries.pop(key, None)
                 self._entries[key] = (fingerprint, arr, rows_p, vers)
+                self._ledger_upload(key, arr, tiers)
                 self._evict(keep=key)
             return arr, rows_p
         finally:
             with self._lock:
                 self._building.pop(key).set()
+
+    def _ledger_upload(self, key: tuple, arr, tiers) -> None:
+        """Record a (re)upload in the HBM ledger (caller holds _lock).
+        Access stats survive re-uploads of the same key — coldness is a
+        property of the serving pattern, not of the write churn that
+        forced the refresh. tiers=None with an unchanged byte size keeps
+        the previous tier split (incremental splices don't re-attribute;
+        the mix re-trues on the next full rebuild); otherwise the bytes
+        default to the dense tier."""
+        nbytes = int(np.prod(arr.shape)) * 4
+        self._upload_epoch += 1
+        led = self._ledger.get(key)
+        if led is None:
+            led = {"access_count": 0, "uploads": 0}
+            self._ledger[key] = led
+        if tiers is None and led.get("bytes") == nbytes and "tier_bytes" in led:
+            tier_bytes = led["tier_bytes"]
+        else:
+            array_b = min(int(tiers[0]) * 4, nbytes) if tiers else 0
+            run_b = min(int(tiers[1]) * 4, nbytes - array_b) if tiers else 0
+            tier_bytes = {
+                "dense": nbytes - array_b - run_b,
+                "array": array_b,
+                "run": run_b,
+            }
+        led.update(
+            bytes=nbytes,
+            tier_bytes=tier_bytes,
+            upload_epoch=self._upload_epoch,
+        )
+        led["uploads"] += 1
+        led["access_count"] += 1
+        led["last_access"] = time.time()
 
     def peek(self, index: str, field_name: str,
              view_name: str = VIEW_STANDARD):
@@ -411,7 +475,9 @@ class _StackedBlocks:
         with self._lock:
             target = max(0, self.max_bytes - nbytes)
             while self.resident_bytes() > target and self._entries:
-                self._entries.pop(next(iter(self._entries)))
+                victim = next(iter(self._entries))
+                self._entries.pop(victim)
+                self._ledger.pop(victim, None)
                 self.evictions += 1
 
     def _evict(self, keep: tuple) -> None:
@@ -420,15 +486,61 @@ class _StackedBlocks:
         while self.resident_bytes() > self.max_bytes and len(self._entries) > 1:
             victim = next(k for k in self._entries if k != keep)
             self._entries.pop(victim)
+            self._ledger.pop(victim, None)
             self.evictions += 1
 
     def resident_bytes(self) -> int:
         with self._lock:
             return sum(int(np.prod(e[1].shape)) * 4 for e in self._entries.values())
 
+    def tier_bytes(self) -> dict[str, int]:
+        """Resident bytes by representation tier; the dict sums exactly
+        to resident_bytes() (each ledger entry's tiers sum to its dense
+        device footprint)."""
+        out = {"dense": 0, "array": 0, "run": 0}
+        with self._lock:
+            for key in self._entries:
+                led = self._ledger.get(key)
+                if led is None:
+                    continue
+                for t, b in led["tier_bytes"].items():
+                    out[t] += b
+        return out
+
+    def ledger(self) -> list[dict]:
+        """The per-entry HBM ledger, coldest first — i.e. the LRU
+        eviction-candidate order (served at /debug/hbm). _entries is the
+        LRU (oldest-touched iterates first), so the listing order IS the
+        order _evict would take victims."""
+        now = time.time()
+        out = []
+        with self._lock:
+            for key, (_, arr, rows_p, _) in self._entries.items():
+                led = self._ledger.get(key)
+                if led is None:
+                    continue
+                ent = {
+                    "index": key[0],
+                    "field": key[1],
+                    "view": key[2],
+                    "bytes": led["bytes"],
+                    "tierBytes": dict(led["tier_bytes"]),
+                    "rows": rows_p,
+                    "uploadEpoch": led["upload_epoch"],
+                    "uploads": led["uploads"],
+                    "accessCount": led["access_count"],
+                    "lastAccess": led["last_access"],
+                    "idleSeconds": round(now - led["last_access"], 3),
+                }
+                if len(key) > 3 and key[3] == "row":
+                    ent["row"] = key[4]
+                out.append(ent)
+        return out
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._ledger.clear()
 
 
 class _PairEntry:

@@ -80,6 +80,30 @@ def pack_fragment(frag, n_rows: Optional[int] = None) -> np.ndarray:
     return arr
 
 
+def fragment_tier_words(frag, n_rows: int) -> tuple[int, int]:
+    """(array_words, run_words): how many of this fragment's resident
+    device words trace back to array / run roaring containers — the
+    representation-tier attribution behind the HBM ledger (ISSUE r8,
+    after the Chambi/Lemire observation that the container mix is the
+    dominant cost driver). Each container owns a fixed
+    _WORDS_PER_CONTAINER span of the dense device slab; everything else
+    (bitmap containers, empty space) counts as the dense tier. O(keys)
+    — negligible next to the pack it attributes."""
+    array_w = run_w = 0
+    storage = frag.storage
+    for key in storage.keys():
+        c = storage.container(key)
+        if c is None or c.n == 0:
+            continue
+        if key // _CONTAINERS_PER_ROW >= n_rows:
+            continue
+        if c.typ == "array":
+            array_w += _WORDS_PER_CONTAINER
+        elif c.typ == "run":
+            run_w += _WORDS_PER_CONTAINER
+    return array_w, run_w
+
+
 def unpack_row(words: np.ndarray) -> np.ndarray:
     """uint32[WORDS] -> sorted shard-relative column positions."""
     bits = np.unpackbits(words.view(np.uint8), bitorder="little")

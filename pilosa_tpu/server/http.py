@@ -83,6 +83,35 @@ def _profiler():
         return _PPROF
 
 
+def _retag_prometheus(text: str, node_id: str) -> list[str]:
+    """Re-tag one node's prometheus exposition with node=<id> as the
+    FIRST label (federation semantics: every series in /metrics/cluster
+    is attributable to its origin; series that already carry labels keep
+    them). A pre-existing node= label (e.g. a member's own
+    cluster_scrape_failures_total{node=...}) is renamed exported_node=
+    — duplicate label names are illegal in the exposition format and
+    would make Prometheus reject the whole federated scrape. Comment/
+    blank lines are dropped — the merged pane re-groups series anyway."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, sep, value = line.rpartition(" ")
+        if not sep:
+            continue
+        brace = series.find("{")
+        if brace < 0:
+            series = f'{series}{{node="{node_id}"}}'
+        else:
+            tags = series[brace + 1 :]
+            # Anchored at a label-name start: a bare substring replace
+            # would also mangle exported_node= on double federation.
+            tags = re.sub(r'(^|,)node="', r'\1exported_node="', tags)
+            series = series[: brace + 1] + f'node="{node_id}",' + tags
+        out.append(f"{series} {value}")
+    return out
+
+
 def route(method: str, pattern: str):
     compiled = re.compile("^" + pattern + "$")
 
@@ -468,6 +497,13 @@ class _Handler(BaseHTTPRequestHandler):
                 span = global_tracer.start_span(
                     f"http.{fn_name}", headers=self.headers
                 )
+                # Origin node on the span itself: cross-node assembly
+                # attributes by this tag, independent of which node's
+                # ring served the span to the assembler.
+                try:
+                    span.set_tag("node", self._local_node_id())
+                except Exception:  # noqa: BLE001 — tagging is best-effort
+                    pass
                 try:
                     with stats.timer("http_request_duration_seconds"):
                         getattr(self, fn_name)(**match.groupdict())
@@ -703,16 +739,26 @@ class _Handler(BaseHTTPRequestHandler):
         self.api.recalculate_caches()
         self._reply({"success": True})
 
+    def _refresh_device_gauges(self) -> None:
+        """Surface device-residency gauges at scrape time (HBM policy) —
+        shared by /metrics and the /metrics/cluster local leg so a bare
+        server (no RuntimeMonitor poller) still exports fresh values."""
+        from pilosa_tpu.utils.monitor import publish_hbm_gauges
+        from pilosa_tpu.utils.stats import global_stats
+
+        backend = getattr(self.api.executor, "backend", None)
+        blocks = getattr(backend, "blocks", None)
+        if blocks is None:
+            return
+        global_stats.gauge("tpu_resident_bytes", blocks.resident_bytes())
+        global_stats.gauge("tpu_stack_evictions", blocks.evictions)
+        publish_hbm_gauges(blocks)
+
     @route("GET", r"/metrics")
     def handle_metrics(self):
         from pilosa_tpu.utils.stats import global_stats
 
-        # Surface device-residency gauges at scrape time (HBM policy).
-        backend = getattr(self.api.executor, "backend", None)
-        blocks = getattr(backend, "blocks", None)
-        if blocks is not None:
-            global_stats.gauge("tpu_resident_bytes", blocks.resident_bytes())
-            global_stats.gauge("tpu_stack_evictions", blocks.evictions)
+        self._refresh_device_gauges()
         self._reply(global_stats.prometheus_text(), content_type="text/plain; version=0.0.4")
 
     @route("GET", r"/debug/queries")
@@ -794,7 +840,264 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._reply(diagnostics_snapshot(self.api.holder))
 
+    # -- cluster observability plane (ISSUE r8) ----------------------------
+
+    def _local_node_id(self) -> str:
+        cluster = self.api.cluster
+        if cluster is not None:
+            return cluster.node_id
+        return f"{self.api.local_host}:{self.api.local_port}"
+
+    def _cluster_members(self) -> list[tuple[str, object, bool]]:
+        """(node_id, uri, is_local) for every cluster member, local node
+        first; a single unclustered server is a one-member cluster."""
+        cluster = self.api.cluster
+        if cluster is None:
+            return [(self._local_node_id(), None, True)]
+        local_id = cluster.node_id
+        out = [(local_id, None, True)]
+        for n in cluster.topology.nodes:
+            if n.id != local_id:
+                out.append((n.id, n, False))
+        return out
+
+    def _scrape_client(self, default_timeout: float = 3.0):
+        """Short-timeout client for cluster fan-outs: a downed node must
+        read as a scrape failure, not hang the whole pane for the peer
+        client's 30 s data-plane timeout. ?timeout= overrides (validated
+        and clamped to [0.1, 30] — a garbage or zero timeout must be a
+        400 / a working scrape, not a PANIC 500 or all-peers-down)."""
+        from pilosa_tpu.cluster.client import InternalClient
+
+        raw = self.query.get("timeout", default_timeout)
+        try:
+            timeout = float(raw)
+        except ValueError:
+            raise APIError(f"invalid timeout: {raw!r}") from None
+        timeout = min(max(timeout, 0.1), 30.0)
+        cluster = self.api.cluster
+        ssl_ctx = cluster.client.ssl_context if cluster is not None else None
+        return InternalClient(timeout=timeout, ssl_context=ssl_ctx)
+
+    def _fan_out_members(self, local_fn, remote_fn):
+        """Scrape every member CONCURRENTLY; returns
+        [(node_id, payload | ClientError, seconds)] in member order.
+        Sequential scraping would make the pane's latency the SUM of
+        per-peer timeouts — with several nodes down it would go dark
+        exactly when it is needed; threads bound it at ~one timeout."""
+        import concurrent.futures as cf
+
+        from pilosa_tpu.cluster.client import ClientError
+
+        members = self._cluster_members()
+
+        def leg(node_id, uri, is_local):
+            t0 = time.perf_counter()
+            try:
+                out = local_fn() if is_local else remote_fn(uri)
+            except ClientError as e:
+                out = e
+            return node_id, out, time.perf_counter() - t0
+
+        if len(members) == 1:
+            return [leg(*members[0])]
+        with cf.ThreadPoolExecutor(
+            max_workers=min(16, len(members))
+        ) as pool:
+            return [f.result() for f in
+                    [pool.submit(leg, *m) for m in members]]
+
+    @route("GET", r"/debug/traces/(?P<trace_id>[^/]+)")
+    def handle_debug_trace_tree(self, trace_id):
+        """Distributed trace assembly: fan out to every cluster node's
+        /internal/traces/<id>, merge the spans into one parent-linked
+        tree with per-node attribution, and note observed wall-clock skew
+        — one slow scatter-gather leg becomes directly visible instead of
+        dying in each node's local ring."""
+        from pilosa_tpu.cluster.client import ClientError
+        from pilosa_tpu.utils.stats import global_stats
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        client = self._scrape_client()
+        spans: list[dict] = []
+        by_id: dict[str, dict] = {}
+        failures: list[dict] = []
+        legs = self._fan_out_members(
+            lambda: global_tracer.spans_for(trace_id),
+            lambda uri: client.node_traces(uri, trace_id),
+        )
+        for node_id, got, _dt in legs:
+            if isinstance(got, ClientError):
+                failures.append({"node": node_id, "error": str(got)})
+                global_stats.with_tags(f"node:{node_id}").count(
+                    "cluster_scrape_failures_total"
+                )
+                continue
+            for s in got:
+                if s["spanID"] in by_id:
+                    continue  # another node's ring already held it
+                # Origin attribution: a span's own node tag (set at
+                # creation by the HTTP dispatcher) beats scrape origin —
+                # the two only differ in in-process test clusters, where
+                # the rings are shared.
+                s["node"] = s.get("tags", {}).get("node", node_id)
+                by_id[s["spanID"]] = s
+                spans.append(s)
+        children: dict[str, list] = {}
+        roots = []
+        max_skew = 0.0
+        for s in spans:
+            pid = s.get("parentID")
+            parent = by_id.get(pid) if pid else None
+            if parent is None:
+                # Parent unknown: remote root (parent span still open or
+                # aged out of its ring) — keep it as a tree root rather
+                # than dropping the subtree.
+                roots.append(s)
+                continue
+            children.setdefault(pid, []).append(s)
+            if (
+                parent["node"] != s["node"]
+                and s.get("start") is not None
+                and parent.get("start") is not None
+                and s["start"] < parent["start"]
+            ):
+                # A child cannot start before its parent; on different
+                # nodes that reads as wall-clock skew of at least this.
+                max_skew = max(max_skew, parent["start"] - s["start"])
+
+        def render(s):
+            kids = sorted(
+                children.get(s["spanID"], ()), key=lambda c: c.get("start") or 0
+            )
+            out = dict(s)
+            out["children"] = [render(k) for k in kids]
+            return out
+
+        roots.sort(key=lambda s: s.get("start") or 0)
+        # Attributed node set (spans' own origin), not the scrape list:
+        # "which nodes did this trace touch" is the operator question.
+        nodes_seen = sorted({s["node"] for s in spans})
+        self._reply(
+            {
+                "traceID": trace_id,
+                "nodes": nodes_seen,
+                "spanCount": len(spans),
+                "clockSkewSecondsMin": round(max_skew, 6),
+                "scrapeFailures": failures,
+                "tree": [render(r) for r in roots],
+            }
+        )
+
+    @route("GET", r"/metrics/cluster")
+    def handle_metrics_cluster(self):
+        """Metrics federation: scrape every node's /metrics, re-tag each
+        series with node=<id>, and append per-node scrape health
+        (pilosa_cluster_scrape_up / _seconds) — one pane for the whole
+        cluster; a downed node is a scrape failure, never a hang."""
+        from pilosa_tpu.cluster.client import ClientError
+        from pilosa_tpu.utils.stats import global_stats
+
+        client = self._scrape_client()
+
+        def local_text() -> str:
+            self._refresh_device_gauges()
+            return global_stats.prometheus_text()
+
+        out: list[str] = []
+        for node_id, text, dt in self._fan_out_members(
+            local_text, client.metrics_text
+        ):
+            up = 1
+            if isinstance(text, ClientError):
+                text = ""
+                up = 0
+                global_stats.with_tags(f"node:{node_id}").count(
+                    "cluster_scrape_failures_total"
+                )
+            out.extend(_retag_prometheus(text, node_id))
+            out.append(f'pilosa_cluster_scrape_up{{node="{node_id}"}} {up}')
+            out.append(
+                f'pilosa_cluster_scrape_seconds{{node="{node_id}"}} {dt:.6f}'
+            )
+        self._reply("\n".join(out) + "\n",
+                    content_type="text/plain; version=0.0.4")
+
+    @route("GET", r"/debug/cluster")
+    def handle_debug_cluster(self):
+        """/debug/vars federation: every node's expvar-style registry
+        dump keyed by node id, with per-node scrape latency/failures —
+        the JSON twin of /metrics/cluster."""
+        from pilosa_tpu.cluster.client import ClientError
+        from pilosa_tpu.utils.stats import global_stats
+
+        client = self._scrape_client()
+
+        def local_vars() -> dict:
+            # Same shape handle_debug_vars serves remotely: the local
+            # member's entry must not be the one missing version/uptime.
+            out = {
+                "version": __version__,
+                "uptimeSeconds": round(time.time() - _START_TIME, 3),
+            }
+            out.update(global_stats.snapshot())
+            return out
+
+        nodes: dict[str, dict] = {}
+        for node_id, got, dt in self._fan_out_members(
+            local_vars, client.debug_vars
+        ):
+            ent: dict = {}
+            if isinstance(got, ClientError):
+                ent["up"] = False
+                ent["error"] = str(got)
+                global_stats.with_tags(f"node:{node_id}").count(
+                    "cluster_scrape_failures_total"
+                )
+            else:
+                ent["up"] = True
+                ent["vars"] = got
+            ent["scrapeMs"] = round(dt * 1e3, 3)
+            nodes[node_id] = ent
+        self._reply({"nodes": nodes})
+
+    @route("GET", r"/debug/hbm")
+    def handle_debug_hbm(self):
+        """The device HBM ledger: per-entry resident bytes split by
+        representation tier (dense / array-container / run-container
+        source), upload epoch, access counts — sorted coldest first,
+        i.e. the LRU eviction-candidate order."""
+        backend = getattr(self.api.executor, "backend", None)
+        blocks = getattr(backend, "blocks", None)
+        if blocks is None or not hasattr(blocks, "ledger"):
+            self._reply(
+                {"residentBytes": 0, "tierBytes": {}, "evictions": 0,
+                 "entries": []}
+            )
+            return
+        self._reply(
+            {
+                "residentBytes": blocks.resident_bytes(),
+                "tierBytes": blocks.tier_bytes(),
+                "evictions": blocks.evictions,
+                "entries": blocks.ledger(),
+            }
+        )
+
     # -- internal routes (reference http/handler.go:307-318) ---------------
+
+    @route("GET", r"/internal/traces/(?P<trace_id>[^/]+)")
+    def handle_internal_traces(self, trace_id):
+        """One node's local spans for a trace — the per-node leg the
+        coordinator's /debug/traces/<id> assembly scrapes."""
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        self._reply(
+            {
+                "node": self._local_node_id(),
+                "spans": global_tracer.spans_for(trace_id),
+            }
+        )
 
     @route("GET", r"/internal/shards/max")
     def handle_get_shards_max(self):

@@ -28,6 +28,23 @@ from pilosa_tpu.utils.stats import global_stats
 PROCESS_STARTED_AT = time.time()
 
 
+def publish_hbm_gauges(blocks, stats=None) -> None:
+    """HBM residency gauges — the untagged total plus the per-
+    representation-tier split from the block-store ledger (ISSUE r8:
+    the tier mix, not one scalar, is what an informed eviction policy
+    needs). The ONE publisher, shared by the RuntimeMonitor poll loop
+    and /metrics scrape-time refresh, so the invariant that the tagged
+    tier series sum exactly to the untagged total cannot drift between
+    two copies of this block."""
+    s = stats or global_stats
+    s.gauge("hbm_resident_bytes", blocks.resident_bytes())
+    s.gauge("hbm_evictions_total", blocks.evictions)
+    tiers = getattr(blocks, "tier_bytes", None)
+    if tiers is not None:
+        for tier, nbytes in tiers().items():
+            s.with_tags(f"tier:{tier}").gauge("hbm_resident_bytes", nbytes)
+
+
 def _rss_bytes() -> int:
     try:
         with open("/proc/self/statm") as f:
@@ -68,8 +85,7 @@ class RuntimeMonitor:
         collected = sum(st.get("collected", 0) for st in gc.get_stats())
         s.gauge("runtime_gc_collected_total", collected)
         if self.backend is not None:
-            s.gauge("hbm_resident_bytes", self.backend.blocks.resident_bytes())
-            s.gauge("hbm_evictions_total", self.backend.blocks.evictions)
+            publish_hbm_gauges(self.backend.blocks, s)
         if self.holder is not None:
             current = set()
             for name in list(self.holder.indexes):
@@ -109,6 +125,44 @@ class RuntimeMonitor:
             self._thread.join(timeout=5)
 
 
+def _device_inventory() -> dict:
+    """The jax device block for /debug/diagnostics (ISSUE r8 satellite):
+    platform, device count, and per-device memory stats where the
+    backend exposes them. Importing jax initializes the backend, which
+    is exactly what a server with a device backend already did; any
+    failure (no jax, no device) is reported instead of raised — a
+    diagnostics endpoint must never 500 over its own inventory."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        inv: dict = {
+            "platform": jax.default_backend(),
+            "device_count": len(devices),
+            "devices": [],
+        }
+        for d in devices:
+            ent = {
+                "id": d.id,
+                "platform": d.platform,
+                "kind": getattr(d, "device_kind", ""),
+            }
+            try:
+                mem = d.memory_stats()
+            except Exception:  # noqa: BLE001 — CPU devices have none
+                mem = None
+            if mem:
+                ent["memory_stats"] = {
+                    k: int(v)
+                    for k, v in mem.items()
+                    if isinstance(v, (int, float))
+                }
+            inv["devices"].append(ent)
+        return inv
+    except Exception as e:  # noqa: BLE001 — report, never raise
+        return {"error": str(e)}
+
+
 def diagnostics_snapshot(holder=None, started_at: Optional[float] = None) -> dict:
     """The reference's hourly diagnostics payload (diagnostics.go:42-260),
     served locally instead of phoned home (zero egress here)."""
@@ -120,6 +174,7 @@ def diagnostics_snapshot(holder=None, started_at: Optional[float] = None) -> dic
             "python": platform.python_version(),
             "cpus": os.cpu_count(),
         },
+        "jax": _device_inventory(),
         "uptime_seconds": round(
             time.time() - (started_at or PROCESS_STARTED_AT), 1
         ),

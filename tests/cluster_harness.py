@@ -138,7 +138,7 @@ class RewriteClient(InternalClient):
         self.rewrites = rewrites
 
     def _do(self, method, uri, path, body=None,
-            content_type="application/json", raw=False):
+            content_type="application/json", raw=False, **kw):
         from pilosa_tpu.cluster.client import _uri_str
 
         u = _uri_str(uri)
@@ -147,7 +147,7 @@ class RewriteClient(InternalClient):
         if mapped is not None:
             u = f"{scheme}://{mapped}"
         return super()._do(method, u, path, body=body,
-                           content_type=content_type, raw=raw)
+                           content_type=content_type, raw=raw, **kw)
 
 
 class ClusterNode:

@@ -1,0 +1,40 @@
+"""Tier-1 wiring for tools/check_metrics_docs.py (ISSUE r8 satellite):
+the metric catalogue in docs/observability.md can never rot — every
+emitted name must be documented and every documented name must exist."""
+
+import importlib.util
+import pathlib
+
+
+def _load_checker():
+    path = (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "tools"
+        / "check_metrics_docs.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_metrics_docs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metrics_docs_in_sync(capsys):
+    checker = _load_checker()
+    rc = checker.main()
+    out = capsys.readouterr().out
+    assert rc == 0, f"metric catalogue drift:\n{out}"
+
+
+def test_checker_catches_drift():
+    """The guard itself must be live: an emitted-but-undocumented name
+    and a documented-but-phantom name are both reported."""
+    checker = _load_checker()
+    src = checker.source_metrics()
+    doc_exact, doc_wild = checker.doc_tokens()
+    # Direction 1: a name only the source knows would be flagged.
+    fake = "definitely_not_documented_total"
+    assert fake not in doc_exact
+    assert not any(fake.startswith(w) for w in doc_wild)
+    # Direction 2: a name only the docs know would be flagged.
+    assert "peer_rpc_seconds" in src  # sanity: scan sees real emitters
+    assert "made_up_metric_total" not in src
