@@ -59,12 +59,19 @@ Round-7 legs (ISSUE r7):
   breakdown), so a serving tier that regresses to O(all-shards)
   freshness walks names itself in the artifact.
 
+Round-9 leg (ISSUE r9):
+- degraded_qps: a 2-node replica_n=2 harness cluster serves fan-outs
+  over HTTP while one replica link is blackholed mid-leg; reports the
+  healthy/degraded qps ratio with the breaker/hedge/deadline counter
+  deltas that attribute how the window survived (every degraded
+  response still the correct non-partial count, inside a 2 s budget).
+
 Env knobs: BENCH_SHARDS (default 954 = 1B cols), BENCH_ROWS (8),
 BENCH_DENSITY (0.05), BENCH_BATCH (256), BENCH_SECONDS (10),
 BENCH_LATENCY_N (30), BENCH_HTTP_CLIENTS (16),
 BENCH_HTTP_QUERIES_PER_REQ (16), BENCH_WRITE_RATES ("0,1,10,100"),
 BENCH_CHURN_SECONDS (8), BENCH_WARM_TIMEOUT (600),
-BENCH_PARTIAL_PATH (BENCH_partial.json).
+BENCH_DEGRADED_SECONDS (3), BENCH_PARTIAL_PATH (BENCH_partial.json).
 """
 
 import concurrent.futures
@@ -104,6 +111,7 @@ WRITE_RATES = [
 ]
 CHURN_SECONDS = float(os.environ.get("BENCH_CHURN_SECONDS", "8"))
 WARM_TIMEOUT = float(os.environ.get("BENCH_WARM_TIMEOUT", "600"))
+DEGRADED_SECONDS = float(os.environ.get("BENCH_DEGRADED_SECONDS", "3"))
 
 WORDS = SHARD_WIDTH // 32
 
@@ -276,6 +284,12 @@ LEG_COUNTER_FAMILIES = (
     "hbm_page_",
     "http_connection_aborts_total",
     "trace_spans_dropped_total",
+    # Resilience families (ISSUE r9): the degraded_qps leg's delta is
+    # the proof the rerouting (not a cache artifact) carried the window.
+    "peer_breaker_transitions_total",
+    "hedged_requests_total",
+    "deadline_exceeded_total",
+    "write_replica_unavailable_total",
 )
 
 
@@ -804,6 +818,75 @@ def bench_cpu(holder, parsed_queries) -> float:
     return n_done / dt
 
 
+def bench_degraded_qps() -> dict:
+    """Resilience leg (ISSUE r9): a 2-node replica_n=2 in-process cluster
+    serves Count fan-outs over its real HTTP surface; mid-leg the remote
+    peer's link is blackholed through the harness FaultProxy, and every
+    degraded-window response must still be the correct, non-partial
+    count inside a 2 s budget — hedged reads escape the straggler leg
+    until the breaker opens and routes around the peer entirely.
+
+    Returns healthy/degraded qps and their ratio; the checkpoint's
+    leg_metrics delta carries the breaker/hedge/deadline counters
+    (LEG_COUNTER_FAMILIES) that attribute HOW the window survived.
+    Self-contained: own holder, own cluster — the main bench index is
+    untouched."""
+    from tests.cluster_harness import FaultProxy, RewriteClient, TestCluster
+
+    with TestCluster(2, replica_n=2) as tc:
+        tc.create_index("deg")
+        tc.create_field("deg", "f")
+        topo = tc[0].cluster.topology
+        by_primary = {"node0": [], "node1": []}
+        for s in range(64):
+            by_primary[topo.shard_nodes("deg", s)[0].id].append(s)
+        # Two shards primaried on EACH node: every fan-out from node0 has
+        # a remote leg to aim the blackhole at, and a local one so the
+        # degraded result still exercises the reduce.
+        shards = by_primary["node0"][:2] + by_primary["node1"][:2]
+        cols = [s * SHARD_WIDTH + 7 for s in shards]
+        tc.query(0, "deg", " ".join(f"Set({c}, f=1)" for c in cols))
+        tc.await_shard_convergence("deg")
+
+        # Route node0's outbound through the proxy for BOTH windows, so
+        # healthy vs degraded differ only in the injected fault.
+        target = tc[1].node.uri
+        proxy = FaultProxy(target.host, target.port)
+        rc = RewriteClient(
+            {f"{target.host}:{target.port}": f"127.0.0.1:{proxy.port}"},
+            timeout=5.0,
+        )
+        tc[0].cluster.client = rc
+        tc[0].cluster.broadcaster.client = rc
+        tc[0].cluster.hedge_delay = 0.05
+        conn = BenchConn(
+            "127.0.0.1", tc[0].server.port, "/index/deg/query?timeout=2"
+        )
+        want = len(cols)
+
+        def window(seconds: float) -> float:
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                res = conn.post("Count(Row(f=1))")
+                assert res[0] == want, (res, want)
+                n += 1
+            return n / (time.perf_counter() - t0)
+
+        try:
+            healthy = window(DEGRADED_SECONDS)
+            proxy.mode = "blackhole"
+            degraded = window(DEGRADED_SECONDS)
+        finally:
+            conn.close()
+            proxy.close()
+    return {
+        "degraded_healthy_qps": round(healthy, 1),
+        "degraded_qps": round(degraded, 1),
+        "degraded_qps_ratio": round(degraded / healthy, 3) if healthy else None,
+    }
+
+
 def main():
     out: dict = {
         "partial": True,
@@ -995,6 +1078,7 @@ def main():
         http_connection_aborts=aborts,
         churn_version_walks=http_churn_walks,
     )
+    checkpoint("degraded_qps", **bench_degraded_qps())
 
     out.update(
         {

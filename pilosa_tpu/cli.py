@@ -73,6 +73,9 @@ def cmd_server(args) -> int:
     if cfg.long_query_time > 0:
         executor.long_query_time = cfg.long_query_time
     api = API(holder, executor)
+    # Default per-query budget for clients that send no ?timeout=
+    # (server/http.py opens the deadline scope at ingress).
+    api.query_timeout = cfg.query_timeout
 
     # TLS (reference server/tlsconfig.go): certificate+key serve HTTPS;
     # peers are dialed with a CA-verified (or skip-verify) context. A
@@ -89,15 +92,26 @@ def cmd_server(args) -> int:
         """Shared cluster bootstrap for both the static-hosts and --join
         paths: build the topology, attach seams, start daemons."""
         from pilosa_tpu.cluster import Cluster, InternalClient, Topology
+        from pilosa_tpu.cluster.breaker import BreakerRegistry
         from pilosa_tpu.cluster.sync import FailureDetector, SyncDaemon
 
         topo = Topology(topo_nodes, replica_n=cfg.cluster.replicas)
         local = topo.node_by_id(local_id)
         if local is None:
             return None
-        cluster = Cluster(local, topo, holder,
-                          client=InternalClient(timeout=cfg.client_timeout,
-                                                ssl_context=client_ssl))
+        cluster = Cluster(
+            local, topo, holder,
+            client=InternalClient(
+                timeout=cfg.client_timeout,
+                ssl_context=client_ssl,
+                retries=cfg.client_retries,
+                breakers=BreakerRegistry(
+                    threshold=cfg.breaker_threshold,
+                    cooldown=cfg.breaker_cooldown,
+                ),
+            ),
+        )
+        cluster.hedge_delay = cfg.hedge_delay
         cluster.logger = log
         cluster.attach(executor, api)
         api.cluster = cluster

@@ -11,24 +11,41 @@ HTTP-status failure so the scatter-gather layer can retry replicas.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
 import urllib.request
 from typing import Optional, Sequence, Union
 
+from pilosa_tpu.cluster.breaker import BreakerRegistry
 from pilosa_tpu.cluster.topology import URI, Node
+from pilosa_tpu.utils.deadline import current_deadline
 from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.utils.tracing import global_tracer
 
 
 class ClientError(Exception):
-    def __init__(self, msg: str, status: int = 0, code: str = ""):
+    def __init__(self, msg: str, status: int = 0, code: str = "",
+                 transport: bool = False):
         super().__init__(msg)
         self.status = status
         # Machine-readable error class from the peer's JSON error body
         # (e.g. "not-found"); empty when the body carried none.
         self.code = code
+        # True for dial/reset/timeout failures (no HTTP exchange
+        # completed): the class the breaker counts and the only class an
+        # idempotent-GET retry may act on — an HTTP error status is a
+        # peer DECISION and retrying it re-asks a question already
+        # answered.
+        self.transport = transport
+
+
+#: A transport timeout whose socket budget was at least this long counts
+#: as breaker evidence even when the query deadline set (truncated) the
+#: socket timeout: half a second of silence is the peer's fault, not the
+#: budget's. Below it, a deadline-squeezed timeout is the query's own.
+_FAIR_WINDOW = 0.5
 
 
 def _uri_str(uri: Union[URI, Node, str]) -> str:
@@ -89,13 +106,30 @@ def _ts_epoch(t) -> int:
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0, ssl_context=None):
+    def __init__(self, timeout: float = 30.0, ssl_context=None,
+                 retries: int = 1, breakers: Optional[BreakerRegistry] = None):
         self.timeout = timeout
         # ssl context for https:// peers (TLSConfig.client_context():
         # CA-verified or skip-verify); None = stdlib default validation.
         self.ssl_context = ssl_context
+        # Transport-error retries for idempotent GETs (fragment sync,
+        # status probes, federation scrapes): jittered backoff, bounded
+        # by `retries` extra attempts and the active deadline. POSTs are
+        # never retried here — the layers above own write retry policy.
+        self.retries = max(int(retries), 0)
+        # Per-peer circuit breakers: OWN instance per client (per node),
+        # never shared — see breaker.py on asymmetric partitions.
+        self.breakers = breakers if breakers is not None else BreakerRegistry()
 
     # -- plumbing ----------------------------------------------------------
+
+    def _connect_uri(self, uri: Union[URI, Node, str]) -> str:
+        """The URL actually dialed for a peer. Identity (peer_label: the
+        breaker key and every peer_rpc_* tag) is always derived from the
+        LOGICAL uri, not this — the test harness overrides this hook to
+        route one peer through a fault proxy without the proxy's port
+        leaking into the peer's telemetry or breaker state."""
+        return _uri_str(uri)
 
     def _do(
         self,
@@ -107,7 +141,42 @@ class InternalClient:
         raw: bool = False,
         op: str = "",
     ):
-        url = _uri_str(uri) + path
+        """One RPC with bounded jittered-backoff retries for idempotent
+        GETs on transport errors. Retries stop early when the peer's
+        breaker just opened (the peer is systemically failing — route to
+        a replica instead of burning budget here) or when the remaining
+        deadline no longer covers a backoff sleep plus a dial."""
+        attempts = self.retries + 1 if method == "GET" else 1
+        delay = 0.05
+        for attempt in range(attempts):
+            try:
+                return self._do_once(method, uri, path, body=body,
+                                     content_type=content_type, raw=raw, op=op)
+            except ClientError as e:
+                if not e.transport or attempt + 1 >= attempts:
+                    raise
+                peer = peer_label(uri)
+                if self.breakers.is_blocked(peer):
+                    raise
+                sleep = delay * (0.5 + random.random())
+                d = current_deadline()
+                if d is not None and d.remaining() <= sleep + 0.05:
+                    raise
+                count_rpc_retry(peer, op or method)
+                time.sleep(sleep)
+                delay = min(delay * 2, 1.0)
+
+    def _do_once(
+        self,
+        method: str,
+        uri: Union[URI, Node, str],
+        path: str,
+        body: Optional[bytes] = None,
+        content_type: str = "application/json",
+        raw: bool = False,
+        op: str = "",
+    ):
+        url = self._connect_uri(uri) + path
         # Per-peer, per-method RPC telemetry (ISSUE r8 tentpole 2): the
         # first signal for "replica N is degraded". op is the client
         # method name (query_node, block_data, ...) — the path would
@@ -127,12 +196,31 @@ class InternalClient:
         if span is not None:
             for k, v in span.inject_headers().items():
                 req.add_header(k, v)
+        # Deadline budget (ISSUE r9 tentpole 1): the socket timeout is
+        # min(client timeout, remaining budget), and the remaining budget
+        # (minus a skew margin) rides the request so the peer abandons a
+        # leg the coordinator has already given up on. An already-expired
+        # budget fails BEFORE dialing — dispatching work nobody will wait
+        # for only loads the peer.
+        deadline = current_deadline()
+        timeout = self.timeout
+        if deadline is not None:
+            if deadline.expired():
+                global_stats.with_tags("phase:peer_rpc").count(
+                    "deadline_exceeded_total"
+                )
+                raise ClientError(
+                    f"{method} {url}: deadline exceeded before dispatch",
+                    code="deadline-exceeded",
+                )
+            timeout = deadline.bound(timeout)
+            req.add_header("X-Pilosa-Deadline", deadline.header_value())
         _track_inflight(peer, +1)
         t0 = time.perf_counter()
         try:
             try:
                 with urllib.request.urlopen(
-                    req, timeout=self.timeout, context=self.ssl_context
+                    req, timeout=timeout, context=self.ssl_context
                 ) as resp:
                     data = resp.read()
             except urllib.error.HTTPError as e:
@@ -146,6 +234,9 @@ class InternalClient:
                 stats.with_tags(f"class:{e.code // 100}xx").count(
                     "peer_rpc_errors_total"
                 )
+                # An HTTP status is a live peer answering: transport is
+                # healthy, whatever the answer — close the breaker.
+                self.breakers.record_success(peer)
                 raise ClientError(
                     f"{method} {url}: status {e.code}: {detail}",
                     status=e.code,
@@ -153,7 +244,27 @@ class InternalClient:
                 ) from e
             except (urllib.error.URLError, OSError, TimeoutError) as e:
                 stats.with_tags("class:transport").count("peer_rpc_errors_total")
-                raise ClientError(f"{method} {url}: {e}") from e
+                # Breaker evidence — unless the failure is a timeout this
+                # request's own nearly-spent deadline manufactured: a
+                # tight budget must not open the breaker against a
+                # healthy-but-not-instant peer. A peer that stayed silent
+                # for a FAIR window (the full client timeout, or at least
+                # _FAIR_WINDOW of budget) is the peer's fault even when
+                # the deadline set the socket timeout — otherwise a
+                # blackholed peer under all-deadlined traffic would never
+                # open its breaker (every timeout fires exactly at budget
+                # expiry) and every query would keep paying a doomed leg.
+                if (
+                    timeout >= min(self.timeout, _FAIR_WINDOW)
+                    or deadline is None
+                    or deadline.remaining() > 0.01
+                ):
+                    self.breakers.record_failure(peer)
+                raise ClientError(
+                    f"{method} {url}: {e}", transport=True
+                ) from e
+            else:
+                self.breakers.record_success(peer)
         finally:
             stats.timing("peer_rpc_seconds", time.perf_counter() - t0)
             _track_inflight(peer, -1)

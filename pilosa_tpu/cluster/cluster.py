@@ -18,7 +18,9 @@ the Executor:
 
 from __future__ import annotations
 
+import itertools
 import queue
+import random
 import time
 import threading
 
@@ -58,6 +60,11 @@ class _MapResponse:
     shards: list[int]
     result: Any = None
     err: Optional[Exception] = None
+    # Leg identity for hedged reads: the gather loop accepts a response
+    # only while its shard set is still unreduced, so a hedge's loser is
+    # discarded instead of double-reduced.
+    leg: int = 0
+    attempt: int = 1
 
 
 class Cluster:
@@ -86,8 +93,10 @@ class Cluster:
         self.logger = None
         # Control messages that failed to broadcast; retried by the sync
         # daemon (ADVICE r1: a dropped DDL/shard broadcast must not be
-        # silently lost).
-        self._pending_msgs: list[Message] = []
+        # silently lost). Entries are [msg, attempts, next_due]: a
+        # message that keeps failing backs off exponentially (capped,
+        # jittered) instead of re-hammering dead peers every sync pass.
+        self._pending_msgs: list[list] = []
         self._pending_lock = threading.Lock()
         # Schema-repair throttle per (node, index): a query naming a
         # genuinely nonexistent field must not trigger a schema push +
@@ -97,6 +106,15 @@ class Cluster:
         # through); cleared on membership change or successful repair.
         self._repair_attempted: dict[tuple[str, str], float] = {}
         self.repair_retry_interval: float = 30.0
+        # Hedged shard reads (ISSUE r9 tentpole 3): a remote leg that
+        # hasn't answered after this many seconds is re-launched at the
+        # next live replica, first result wins. 0 disables. The CLI wires
+        # the `hedge-delay` config; the default here is off so direct
+        # Cluster constructions (tests, embedders) opt in explicitly.
+        self.hedge_delay: float = 0.0
+        # Monotonic leg ids for the hedged gather (shared across
+        # concurrent map_shards calls; uniqueness is all that matters).
+        self._leg_ids = itertools.count(1)
 
     # -- wiring ------------------------------------------------------------
 
@@ -226,25 +244,50 @@ class Cluster:
             status=self.node_status(),
         )
         deadline = time.monotonic() + timeout
-        last_announce = 0.0
+        # Failed announces retry on capped jittered exponential backoff
+        # (ISSUE r9 satellite — the fixed interval hammered a coordinator
+        # that was mid-restart exactly when it could least absorb it):
+        # 0.25 s doubling to a cap, each interval jittered 0.5-1.5x so a
+        # fleet of rebooting joiners doesn't retry in lockstep. A
+        # successful send re-asserts at the steady announce_every pace
+        # (membership may still take a resize to land) and resets the
+        # backoff.
+        backoff = 0.25
+        cap = max(4 * announce_every, 8.0)
+        next_announce = 0.0
+        attempt = 0
         while time.monotonic() < deadline:
             member = any(
                 n.id == self.local_node.id for n in self.topology.nodes
             ) and len(self.topology.nodes) > 1
             if member and self.state() == STATE_NORMAL:
-                self._log("joined cluster: %d nodes", len(self.topology.nodes))
+                self._log(
+                    "joined cluster: %d nodes (%d announce attempts)",
+                    len(self.topology.nodes), attempt,
+                )
                 return True
-            if time.monotonic() - last_announce >= announce_every:
-                last_announce = time.monotonic()
+            if time.monotonic() >= next_announce:
+                attempt += 1
                 try:
                     # Through the broadcaster so the announce gets the
                     # per-peer JSON wire fallback too — a JSON-only
                     # coordinator mid-rolling-upgrade must still accept
                     # a new build's join (code review r4).
                     self.broadcaster.send_to(coordinator_uri, msg)
+                    backoff = 0.25
+                    interval = announce_every
                 except Exception as e:  # noqa: BLE001 — keep re-announcing
-                    self._log("join announce failed (will retry): %s", e)
+                    interval = backoff
+                    backoff = min(backoff * 2, cap)
+                    self._log(
+                        "join announce attempt %d failed (retry in ~%.2fs): %s",
+                        attempt, interval, e,
+                    )
+                next_announce = time.monotonic() + interval * (
+                    0.5 + random.random()
+                )
             time.sleep(0.05)
+        self._log("join timed out after %d announce attempts", attempt)
         return False
 
     def nodes_json(self) -> list[dict]:
@@ -255,58 +298,205 @@ class Cluster:
 
     # -- mapReduce (reference executor.go:2460-2613) -----------------------
 
-    def map_shards(self, index, shards, c, map_fn, reduce_fn, opt):
-        # Nodes the failure detector marked DOWN are skipped up front so
-        # queries route straight to replicas instead of eating a timeout.
+    def _routable_nodes(self, index, shards):
+        """Scatter-gather candidates: DOWN nodes are skipped up front, and
+        so are peers whose circuit breaker is open (ISSUE r9 tentpole 2)
+        — both route traffic straight to replicas instead of eating a
+        timeout. Each filter is dropped again if it would orphan a shard:
+        availability beats the optimization."""
+        from pilosa_tpu.cluster.client import peer_label
         from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
 
-        nodes = [n for n in self.topology.nodes if n.state != NODE_STATE_DOWN]
-        if not nodes:
-            nodes = list(self.topology.nodes)
+        live = [n for n in self.topology.nodes if n.state != NODE_STATE_DOWN]
+        if not live:
+            live = list(self.topology.nodes)
+        breakers = getattr(self.client, "breakers", None)
+        if breakers is not None:
+            unblocked = [
+                n
+                for n in live
+                if n.id == self.local_node.id
+                or not breakers.is_blocked(peer_label(n))
+            ]
+            if unblocked and unblocked != live:
+                try:
+                    self._shards_by_node(unblocked, index, shards)
+                    return unblocked
+                except ShardUnavailableError:
+                    pass  # a blocked peer is some shard's only owner
+        return live
+
+    def map_shards(self, index, shards, c, map_fn, reduce_fn, opt):
+        from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
+        from pilosa_tpu.utils.deadline import current_deadline
+        from pilosa_tpu.utils.stats import global_stats
+
+        nodes = self._routable_nodes(index, shards)
         ch: "queue.Queue[_MapResponse]" = queue.Queue()
         # The caller's active span (executor.Execute / the HTTP span) is
         # captured HERE because the mapper legs run on fresh threads whose
         # thread-local span stacks are empty — without handing the parent
         # over, the client would find no active span and the trace would
-        # die at the node boundary (ISSUE r8 tentpole 1).
+        # die at the node boundary (ISSUE r8 tentpole 1). The active
+        # Deadline crosses the same thread boundary the same way.
         from pilosa_tpu.utils.tracing import global_tracer
 
         parent_span = global_tracer.active_span()
-        self._launch(ch, nodes, index, shards, c, map_fn, reduce_fn, opt,
-                     parent_span)
+        deadline = current_deadline()
+
+        # Hedged gather state: every launched leg is tracked until its
+        # shard set is reduced. `needed` is the set of shards still
+        # awaiting exactly one reduction; a response is accepted only if
+        # its whole shard set is still needed, so a hedge's loser — or a
+        # straggler whose shards a hedge already covered — is discarded
+        # instead of double-reduced.
+        inflight: dict[int, dict] = {}
+        needed: set[int] = set(shards)
+        hedged: set[int] = set()  # parent leg ids with a hedge in flight
+        # Parents no longer hedge-eligible: already hedged, or hedging
+        # was tried and no live alternate owns their shards. Tracked
+        # separately from `hedged` so an unhedgeable straggler stops
+        # driving the gather wait to zero (busy-poll) without ever being
+        # scored as a hedge win/loss.
+        hedge_done: set[int] = set()
+        scored: set[int] = set()  # hedged parents already counted won/lost
+
+        def launch(target_nodes, shard_list, attempt=1, parent=None):
+            groups = self._shards_by_node(target_nodes, index, shard_list)
+            for node, node_shards in groups.values():
+                leg = next(self._leg_ids)
+                inflight[leg] = {
+                    "node": node,
+                    "shards": node_shards,
+                    "t0": time.monotonic(),
+                    "attempt": attempt,
+                    "parent": parent if parent is not None else leg,
+                }
+                t = threading.Thread(
+                    target=self._map_node,
+                    args=(ch, leg, attempt, node, node_shards, index, c,
+                          map_fn, reduce_fn, opt, parent_span, deadline),
+                    daemon=True,
+                )
+                t.start()
+
+        launch(nodes, list(shards))
 
         result = None
         got_any = False
-        done = 0
-        while done < len(shards):
-            try:
-                resp = ch.get(timeout=self.client.timeout + 30)
-            except queue.Empty:
+        # The gather wait is budget-derived (ISSUE r9: was a flat
+        # client.timeout + 30): the deadline governs when one is active,
+        # and the old cap stays as the no-deadline backstop — every
+        # remote leg's socket timeout already ends below it.
+        hard_cap = time.monotonic() + self.client.timeout + 30
+        while needed:
+            if deadline is not None:
+                deadline.check("gather")
+            now = time.monotonic()
+            wait = hard_cap - now
+            if deadline is not None:
+                wait = min(wait, deadline.remaining())
+            if self.hedge_delay > 0:
+                for rec in inflight.values():
+                    if (
+                        rec["attempt"] == 1
+                        and rec["parent"] not in hedge_done
+                        and rec["node"].id != self.local_node.id
+                    ):
+                        wait = min(wait, rec["t0"] + self.hedge_delay - now)
+            if now >= hard_cap:
                 # A worker hung past the client timeout; surface as a
                 # routable 5xx instead of an unhandled traceback (ADVICE r1).
                 raise ShardUnavailableError(
                     f"query timed out waiting for shard results ({index})"
                 ) from None
+            try:
+                resp = ch.get(timeout=max(wait, 0.001))
+            except queue.Empty:
+                self._maybe_hedge(
+                    launch, inflight, needed, hedged, hedge_done, nodes
+                )
+                continue
+            rec = inflight.pop(resp.leg, {"attempt": resp.attempt,
+                                          "parent": resp.leg})
             if resp.err is not None:
-                # Filter the failed node, re-split its shards across the
-                # remaining replicas (reference :2497-2507).
-                from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
-
+                # Re-split the failed leg's still-needed shards across
+                # the remaining replicas (reference :2497-2507). Shards a
+                # hedge already reduced need no retry — and shards a
+                # SIBLING attempt of the same parent still has in flight
+                # (the primary of a failed hedge, or the hedge of a
+                # failed primary) are already covered: re-splitting them
+                # would duplicate the dispatch, and raising would abort a
+                # query the sibling may still answer. Only shards no
+                # sibling covers re-split (or raise).
+                covered: set[int] = set()
+                for r in inflight.values():
+                    if r["parent"] == rec["parent"]:
+                        covered.update(r["shards"])
+                still = [
+                    s for s in resp.shards if s in needed and s not in covered
+                ]
+                if not still:
+                    continue
                 count_rpc_retry(peer_label(resp.node), "query_node")
                 nodes = [n for n in nodes if n.id != resp.node.id]
                 try:
-                    self._launch(ch, nodes, index, resp.shards, c, map_fn,
-                                 reduce_fn, opt, parent_span)
+                    launch(nodes, still, attempt=rec["attempt"],
+                           parent=rec["parent"])
                 except ShardUnavailableError:
                     raise resp.err
                 continue
+            if not set(resp.shards) <= needed:
+                # A sibling attempt already reduced part of this shard
+                # set: the loser of a hedge race. Any shard of it still
+                # needed is covered by an in-flight sibling (hedges cover
+                # the straggler's full shard set), so dropping the whole
+                # response is safe and the only way not to double-count.
+                continue
+            needed.difference_update(resp.shards)
+            if rec["parent"] in hedged and rec["parent"] not in scored:
+                scored.add(rec["parent"])
+                won = "hedge" if rec["attempt"] > 1 else "primary"
+                global_stats.with_tags(f"won:{won}").count(
+                    "hedged_requests_total"
+                )
             if got_any:
                 result = reduce_fn(result, resp.result)
             else:
                 result = resp.result
                 got_any = True
-            done += len(resp.shards)
         return result
+
+    def _maybe_hedge(self, launch, inflight, needed, hedged, hedge_done,
+                     nodes) -> None:
+        """Re-launch every straggler remote leg's shards at the next live
+        replica (first result wins; see the needed-set accounting above).
+        A leg with no alternate owner for its shards is marked done (so
+        the gather stops waking up for it) and left to its socket
+        timeout — the error path re-splits what it can. Eligibility is
+        keyed by PARENT id: a re-split leg carries its original parent,
+        and hedging it twice would storm duplicate legs."""
+        if self.hedge_delay <= 0:
+            return
+        now = time.monotonic()
+        for rec in list(inflight.values()):
+            if (
+                rec["attempt"] != 1
+                or rec["parent"] in hedge_done
+                or rec["node"].id == self.local_node.id
+                or now - rec["t0"] < self.hedge_delay
+                or not any(s in needed for s in rec["shards"])
+            ):
+                continue
+            alternates = [n for n in nodes if n.id != rec["node"].id]
+            try:
+                launch(alternates, [s for s in rec["shards"] if s in needed],
+                       attempt=2, parent=rec["parent"])
+            except ShardUnavailableError:
+                hedge_done.add(rec["parent"])  # nowhere to hedge: stop waking
+                continue
+            hedge_done.add(rec["parent"])
+            hedged.add(rec["parent"])
 
     def _shards_by_node(self, nodes: Sequence[Node], index: str, shards: Sequence[int]):
         m: dict[str, tuple[Node, list[int]]] = {}
@@ -322,24 +512,17 @@ class Cluster:
             m.setdefault(owner.id, (owner, []))[1].append(shard)
         return m
 
-    def _launch(self, ch, nodes, index, shards, c, map_fn, reduce_fn, opt,
-                parent_span=None) -> None:
-        groups = self._shards_by_node(nodes, index, shards)
-        for node, node_shards in groups.values():
-            t = threading.Thread(
-                target=self._map_node,
-                args=(ch, node, node_shards, index, c, map_fn, reduce_fn, opt,
-                      parent_span),
-                daemon=True,
-            )
-            t.start()
-
-    def _map_node(self, ch, node, node_shards, index, c, map_fn, reduce_fn, opt,
-                  parent_span=None) -> None:
+    def _map_node(self, ch, leg, attempt, node, node_shards, index, c,
+                  map_fn, reduce_fn, opt, parent_span=None,
+                  deadline=None) -> None:
         # Re-establish the trace context on this worker thread: one child
         # span per scatter-gather leg, tagged with the target node, so a
         # slow leg is directly visible in the assembled cross-node tree
-        # (and remote legs inject X-Trace-Id via the client).
+        # (and remote legs inject X-Trace-Id via the client). The
+        # caller's Deadline is re-activated the same way so the client
+        # bounds and propagates the remaining budget.
+        from pilosa_tpu.utils.deadline import deadline_scope
+
         span = None
         if parent_span is not None:
             from pilosa_tpu.utils.tracing import global_tracer
@@ -353,18 +536,22 @@ class Cluster:
             # coordinator regardless of which peer the leg targets.
             span.set_tag("targetNode", node.id)
             span.set_tag("shards", len(node_shards))
-        resp = _MapResponse(node=node, shards=node_shards)
+            if attempt > 1:
+                span.set_tag("hedge", attempt)
+        resp = _MapResponse(node=node, shards=node_shards, leg=leg,
+                            attempt=attempt)
         try:
-            if node.id == self.local_node.id:
-                result = None
-                first = True
-                for shard in node_shards:
-                    v = map_fn(shard)
-                    result = v if first else reduce_fn(result, v)
-                    first = False
-                resp.result = result
-            else:
-                resp.result = self._remote_exec(node, index, c, node_shards)
+            with deadline_scope(deadline):
+                if node.id == self.local_node.id:
+                    result = None
+                    first = True
+                    for shard in node_shards:
+                        v = map_fn(shard)
+                        result = v if first else reduce_fn(result, v)
+                        first = False
+                    resp.result = result
+                else:
+                    resp.result = self._remote_exec(node, index, c, node_shards)
         except Exception as e:  # transport or peer error -> retried upstream
             resp.err = e
             if span is not None:
@@ -446,10 +633,13 @@ class Cluster:
         errs: list[Exception] = []
         lock = threading.Lock()
         # Same cross-thread trace handoff as map_shards: replica writes
-        # run on fresh threads, so the parent span is captured here.
+        # run on fresh threads, so the parent span — and the active
+        # Deadline — are captured here.
+        from pilosa_tpu.utils.deadline import current_deadline, deadline_scope
         from pilosa_tpu.utils.tracing import global_tracer
 
         parent_span = global_tracer.active_span()
+        deadline = current_deadline()
 
         def send(i, node):
             span = None
@@ -460,11 +650,12 @@ class Cluster:
                 )
                 span.set_tag("targetNode", node.id)
             try:
-                out = self.client.query_node(
-                    node, index, pql,
-                    shards=shards.get(node.id) if shards else None,
-                    remote=True,
-                )
+                with deadline_scope(deadline):
+                    out = self.client.query_node(
+                        node, index, pql,
+                        shards=shards.get(node.id) if shards else None,
+                        remote=True,
+                    )
                 rs = out.get("results", [])
                 results[i] = rs[0] if rs else None
             except Exception as e:
@@ -486,25 +677,50 @@ class Cluster:
             raise errs[0]
         return results
 
+    def _peer_unwritable(self, n: Node) -> bool:
+        """A replica the write path skips: DOWN, or circuit-broken (an
+        open breaker is treated exactly like DOWN — the write routes to
+        the remaining replicas and anti-entropy repairs the peer when its
+        breaker closes)."""
+        if n.state == NODE_STATE_DOWN:
+            return True
+        breakers = getattr(self.client, "breakers", None)
+        if breakers is None:
+            return False
+        from pilosa_tpu.cluster.client import peer_label
+
+        return breakers.is_blocked(peer_label(n))
+
+    def _no_live_replica(self, index: str, shard: int) -> ClientError:
+        """All replicas of a shard are unwritable: fail LOUDLY — a
+        silently dropped write is unrepairable (no replica ever held it).
+        Counted so an operator sees the rejection rate, not just client
+        complaints."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats.with_tags(f"index:{index}").count(
+            "write_replica_unavailable_total"
+        )
+        return ClientError(
+            f"every replica of shard {shard} is down; write not applied",
+            code="replicas-unavailable",
+        )
+
     def route_write(self, index: str, c, shard: int, local_fn: Callable[[], Any]):
         """Apply a single-shard write on every replica; OR the changed
         flags (reference executeSetBitField: ret = changed on any node)."""
         replicas = self.topology.shard_nodes(index, shard)
-        # DOWN replicas are skipped (reads already skip them in
-        # map_shards); anti-entropy delivers the write when they return —
-        # but ONLY if at least one live replica takes it now. All
-        # replicas down must fail loudly: a silently dropped write is
-        # unrepairable (no replica ever held it).
+        # DOWN or circuit-broken replicas are skipped (reads already skip
+        # them in map_shards); anti-entropy delivers the write when they
+        # return — but ONLY if at least one live replica takes it now.
         peers = [
             n
             for n in replicas
-            if n.id != self.local_node.id and n.state != NODE_STATE_DOWN
+            if n.id != self.local_node.id and not self._peer_unwritable(n)
         ]
         local_is_replica = any(n.id == self.local_node.id for n in replicas)
         if replicas and not peers and not local_is_replica:
-            raise ClientError(
-                f"every replica of shard {shard} is down; write not applied"
-            )
+            raise self._no_live_replica(index, shard)
         ret = None
         if local_is_replica:
             ret = local_fn()
@@ -527,14 +743,12 @@ class Cluster:
         for shard in shards:
             reps = self.topology.shard_nodes(index, shard)
             if reps and all(
-                n.state == NODE_STATE_DOWN and n.id != self.local_node.id
+                self._peer_unwritable(n) and n.id != self.local_node.id
                 for n in reps
             ):
                 # No live replica for THIS shard: fail loudly — a
                 # silently skipped shard write is unrepairable.
-                raise ClientError(
-                    f"every replica of shard {shard} is down; write not applied"
-                )
+                raise self._no_live_replica(index, shard)
             for node in reps:
                 by_node.setdefault(node.id, (node, []))[1].append(shard)
         ret = None
@@ -544,7 +758,8 @@ class Cluster:
                 r = local_fn(shard)
                 ret = r if ret is None else (bool(ret) or bool(r))
         peers = [
-            node for node, _ in by_node.values() if node.state != NODE_STATE_DOWN
+            node for node, _ in by_node.values()
+            if not self._peer_unwritable(node)
         ]
         pinned = {node.id: ss for node, ss in by_node.values()}
         for r in self._parallel_peer_writes(peers, index, c.to_string(), pinned):
@@ -592,14 +807,35 @@ class Cluster:
             self.broadcaster.send_sync(msg)
         except RuntimeError as e:
             self._log("broadcast failed (queued for retry): %s", e)
-            with self._pending_lock:
-                self._pending_msgs.append(msg)
+            self._queue_pending(msg, attempts=1)
+
+    def _queue_pending(self, msg: Message, attempts: int) -> None:
+        """First failure retries at the very next flush; repeated
+        failures back off exponentially (jittered 0.5-1.5x, capped at
+        60 s) so a long-dead peer costs one send per cap interval, not
+        one per queued message per sync pass."""
+        if attempts <= 1:
+            due = 0.0
+        else:
+            base = min(0.5 * (2 ** (attempts - 1)), 60.0)
+            due = time.monotonic() + base * (0.5 + random.random())
+        with self._pending_lock:
+            self._pending_msgs.append([msg, attempts, due])
 
     def flush_pending_broadcasts(self) -> None:
+        now = time.monotonic()
         with self._pending_lock:
-            pending, self._pending_msgs = self._pending_msgs, []
-        for msg in pending:
-            self._send_or_queue(msg)
+            due = [e for e in self._pending_msgs if e[2] <= now]
+            self._pending_msgs = [e for e in self._pending_msgs if e[2] > now]
+        for msg, attempts, _ in due:
+            try:
+                self.broadcaster.send_sync(msg)
+            except RuntimeError as e:
+                self._log(
+                    "broadcast retry attempt %d failed (backing off): %s",
+                    attempts + 1, e,
+                )
+                self._queue_pending(msg, attempts + 1)
 
     # -- message receive (reference server.go receiveMessage :569) ---------
 

@@ -34,6 +34,7 @@ from pilosa_tpu.exec.result import (
 from pilosa_tpu.pql import Call, Condition, Query, parse_string
 from pilosa_tpu.pql.ast import is_reserved_arg
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.deadline import check_deadline
 from pilosa_tpu.utils.qprofile import profile_scope
 from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.utils.tracing import global_tracer
@@ -108,6 +109,12 @@ class Executor:
         prof,
     ) -> list[Any]:
         opt = opt or ExecOptions()
+        # Deadline checks sit at the same phase boundaries QueryProfile
+        # names (ISSUE r9 tentpole 1): work not yet started is the part
+        # worth abandoning — on a remote node these fire against the
+        # budget the coordinator propagated, so an abandoned query's legs
+        # stop instead of completing for nobody.
+        check_deadline("parse")
         if isinstance(query, str):
             with prof.phase("parse"):
                 query = parse_string(query)
@@ -146,6 +153,7 @@ class Executor:
                     ):
                         run += 1
                 if run > 1 or (run == 1 and self.batcher is not None):
+                    check_deadline("plan")
                     batch = calls[i : i + run]
                     stats.count("query_Count_total", run)
                     if not opt.remote:
@@ -166,6 +174,7 @@ class Executor:
                     i += run
                     continue
                 call = calls[i]
+                check_deadline("plan")
                 stats.count(f"query_{call.name}_total")
                 # Remote (peer-issued) requests arrive pre-translated and
                 # are returned raw; translation happens only at the
@@ -173,9 +182,11 @@ class Executor:
                 if not opt.remote and (translate or call.has_str_args):
                     with prof.phase("key_translate"):
                         call = self._translate_call(idx, call)
+                check_deadline("device_dispatch")
                 with self.tracer.start_span(f"executor.execute{call.name}"):
                     result = self.execute_call(index, call, shards, opt)
                 if not opt.remote:
+                    check_deadline("key_translate")
                     with prof.phase("key_translate"):
                         result = self._translate_result(idx, call, result)
                 results.append(result)

@@ -58,6 +58,10 @@ class API:
         self.local_host = "localhost"
         self.local_port = 10101
         self.local_scheme = "http"
+        # Default per-query deadline in seconds when the client supplies
+        # neither ?timeout= nor X-Pilosa-Deadline (config query-timeout).
+        # 0 = no default budget.
+        self.query_timeout = 0.0
 
     def _validate_state(self, method: str) -> None:
         if self.cluster is None or method in _STATE_EXEMPT:
@@ -91,6 +95,7 @@ class API:
         )
         from pilosa_tpu.cluster.client import ClientError
         from pilosa_tpu.cluster.cluster import ShardUnavailableError
+        from pilosa_tpu.utils.deadline import DeadlineExceeded
 
         from pilosa_tpu.exec.cpu import NotFoundError as ExecNotFound
 
@@ -101,9 +106,22 @@ class API:
         except (ParseError, QueryError, ValueError) as e:
             raise APIError(str(e)) from e
         except ShardUnavailableError as e:
-            raise APIError(str(e), status=503) from e
+            raise APIError(str(e), status=503, code="shard-unavailable") from e
+        except DeadlineExceeded as e:
+            # The query's budget ran out mid-execution: structured 504
+            # (the HTTP layer adds Retry-After) — the abandoned legs stop
+            # themselves via the propagated header.
+            raise APIError(str(e), status=504, code="deadline-exceeded") from e
         except ClientError as e:
-            raise APIError(f"remote node error: {e}", status=502) from e
+            code = getattr(e, "code", "")
+            if code == "deadline-exceeded":
+                raise APIError(str(e), status=504, code=code) from e
+            if code == "replicas-unavailable":
+                # The loud-failure invariant surfacing: every replica of
+                # a written shard was down/circuit-broken.
+                raise APIError(str(e), status=503, code=code) from e
+            raise APIError(f"remote node error: {e}", status=502,
+                           code="peer-error") from e
         attr_sets: list[dict] = []
         if column_attrs and not exclude_columns:
             attr_sets = self._column_attr_sets(index, results)
@@ -124,8 +142,13 @@ class API:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns, remote=remote,
         )
+        from pilosa_tpu.utils.deadline import DeadlineExceeded, check_deadline
         from pilosa_tpu.utils.qprofile import current_profile
 
+        try:
+            check_deadline("serialize")
+        except DeadlineExceeded as e:
+            raise APIError(str(e), status=504, code="deadline-exceeded") from e
         with current_profile().phase("serialize"):
             out: dict[str, Any] = {
                 "results": [

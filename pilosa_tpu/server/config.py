@@ -93,6 +93,22 @@ class Config:
     # broadcasts). The SIGSTOP/partition tests lower it so hung-peer
     # retries happen in test time (reference Cluster.stuttering timeouts).
     client_timeout: float = 30.0
+    # -- data-plane resilience (ISSUE r9) ----------------------------------
+    # Default per-query deadline in seconds when the client supplies
+    # neither ?timeout= nor X-Pilosa-Deadline. 0 = no default budget.
+    query_timeout: float = 0.0
+    # Transport-error retries for idempotent peer GETs (fragment sync,
+    # probes, federation scrapes); jittered backoff between attempts.
+    client_retries: int = 1
+    # Per-peer circuit breaker: consecutive transport failures before the
+    # breaker opens, and the base cooldown (jittered, doubling per
+    # consecutive reopen up to 30x) before a half-open probe.
+    breaker_threshold: int = 3
+    breaker_cooldown: float = 1.0
+    # Hedged shard reads: a remote scatter-gather leg silent for this
+    # many seconds is re-launched at the next live replica (first result
+    # wins). 0 disables hedging.
+    hedge_delay: float = 0.25
     # HBM residency budget in bytes for the TPU backend's field stacks
     # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
     # via row paging instead of whole-stack residency.
@@ -144,6 +160,11 @@ class Config:
             "preheat": self.preheat,
             "max-hbm-bytes": self.max_hbm_bytes,
             "profile": {"port": self.profile_port},
+            "query-timeout": self.query_timeout,
+            "client-retries": self.client_retries,
+            "breaker-threshold": self.breaker_threshold,
+            "breaker-cooldown": self.breaker_cooldown,
+            "hedge-delay": self.hedge_delay,
         }
 
     @staticmethod
@@ -175,6 +196,11 @@ class Config:
             "preheat": "preheat",
             "client-timeout": "client_timeout",
             "max-hbm-bytes": "max_hbm_bytes",
+            "query-timeout": "query_timeout",
+            "client-retries": "client_retries",
+            "breaker-threshold": "breaker_threshold",
+            "breaker-cooldown": "breaker_cooldown",
+            "hedge-delay": "hedge_delay",
         }
         for k, attr in simple.items():
             if k in data:
@@ -214,6 +240,11 @@ class Config:
             pre + "PROFILE_PORT": ("profile_port", int),
             pre + "CLIENT_TIMEOUT": ("client_timeout", float),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
+            pre + "QUERY_TIMEOUT": ("query_timeout", float),
+            pre + "CLIENT_RETRIES": ("client_retries", int),
+            pre + "BREAKER_THRESHOLD": ("breaker_threshold", int),
+            pre + "BREAKER_COOLDOWN": ("breaker_cooldown", float),
+            pre + "HEDGE_DELAY": ("hedge_delay", float),
             pre + "TLS_CERTIFICATE": ("tls.certificate", str),
             pre + "TLS_KEY": ("tls.key", str),
             pre + "TLS_CA_CERTIFICATE": ("tls.ca_certificate", str),
@@ -245,6 +276,11 @@ class Config:
             f"preheat = {str(c.preheat).lower()}\n"
             f"client-timeout = {c.client_timeout}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
+            f"query-timeout = {c.query_timeout}\n"
+            f"client-retries = {c.client_retries}\n"
+            f"breaker-threshold = {c.breaker_threshold}\n"
+            f"breaker-cooldown = {c.breaker_cooldown}\n"
+            f"hedge-delay = {c.hedge_delay}\n"
             f"[profile]\nport = {c.profile_port}\n"
             "\n[tls]\n"
             f'certificate = "{c.tls.certificate}"\n'

@@ -456,7 +456,9 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise APIError(f"invalid JSON body: {e}") from e
 
-    def _reply(self, obj: Any, status: int = 200, content_type: str = "application/json") -> None:
+    def _reply(self, obj: Any, status: int = 200,
+               content_type: str = "application/json",
+               headers: Optional[dict] = None) -> None:
         if content_type == "application/json":
             data = (json.dumps(obj) + "\n").encode()
         elif isinstance(obj, bytes):
@@ -466,14 +468,37 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        if headers:
+            for k, v in headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
+    #: Machine-readable fallback `code` per status, so EVERY 4xx/5xx JSON
+    #: body out of this layer carries one (ISSUE r9 satellite — the peer
+    #: client already parses it, cluster/client.py) even when the raising
+    #: site predates structured codes. A site-specific code always wins.
+    _CODE_BY_STATUS = {
+        400: "bad-request",
+        404: "not-found",
+        409: "conflict",
+        413: "too-large",
+        500: "internal",
+        501: "not-implemented",
+        502: "bad-gateway",
+        503: "unavailable",
+        504: "deadline-exceeded",
+    }
+
     def _error(self, msg: str, status: int = 400, code: str = "") -> None:
-        body = {"error": msg}
-        if code:
-            body["code"] = code
-        self._reply(body, status=status)
+        body = {
+            "error": msg,
+            "code": code or self._CODE_BY_STATUS.get(status, f"http-{status}"),
+        }
+        # 503/504 are retryable-by-contract: tell the client when
+        # (ISSUE r9 satellite). 1 s is the breaker/hedge recovery scale.
+        headers = {"Retry-After": "1"} if status in (503, 504) else None
+        self._reply(body, status=status, headers=headers)
 
     def _dispatch(self, method: str) -> None:
         parsed = urlparse(self.path)
@@ -600,8 +625,36 @@ class _Handler(BaseHTTPRequestHandler):
         self.api.delete_field(index, field)
         self._reply({"success": True})
 
+    def _request_deadline(self):
+        """The request's Deadline, or None (no budget). Precedence:
+        X-Pilosa-Deadline (the internal propagation header — a remote leg
+        must inherit the coordinator's remaining budget, never restart a
+        full client budget), then ?timeout= (the public knob), then the
+        server's query-timeout config default."""
+        from pilosa_tpu.utils.deadline import Deadline
+
+        raw = self.headers.get("X-Pilosa-Deadline")
+        if raw is None:
+            raw = self.query.get("timeout")
+        if raw is not None:
+            try:
+                return Deadline.parse(raw)
+            except ValueError:
+                raise APIError(f"invalid timeout: {raw!r}") from None
+        default = getattr(self.api, "query_timeout", 0.0)
+        return Deadline(default) if default and default > 0 else None
+
     @route("POST", r"/index/(?P<index>[^/]+)/query")
     def handle_post_query(self, index):
+        # The deadline scope opens HERE — at HTTP receipt, like the query
+        # profile — so the budget covers the whole serving path through
+        # response serialization (ISSUE r9 tentpole 1).
+        from pilosa_tpu.utils.deadline import deadline_scope
+
+        with deadline_scope(self._request_deadline()):
+            self._serve_query(index)
+
+    def _serve_query(self, index):
         body = self._body()
         ctype = (self.headers.get("Content-Type") or "").split(";")[0]
         if ctype == "application/x-protobuf":

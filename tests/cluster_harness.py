@@ -37,28 +37,57 @@ class FaultProxy:
       BadStatusLine mid-exchange), then auto-revert to 'pass' so a
       retry with a fresh connection succeeds. The single-transient
       fault shape bench.py's capture-proof post() retry covers.
+    - mode 'latency': pass, but delay the connection by latency_s
+      before the first byte moves — the slow-but-healthy replica shape
+      hedged reads exist for (ISSUE r9).
+    - mode 'drop': each connection independently dies with probability
+      drop_p (instant close), else passes — flaky-link shape for the
+      client's idempotent-GET retry.
+
+    close() joins the accept loop and closes every piped connection it
+    spawned, so a chaos suite cycling many proxies cannot exhaust fds
+    (ISSUE r9 satellite — the old close leaked established pipes until
+    their peers hung up).
     """
 
     def __init__(self, target_host: str, target_port: int):
         self.target = (target_host, target_port)
         self.mode = "pass"
+        self.latency_s = 0.2  # mode 'latency' delay
+        self.drop_p = 0.5  # mode 'drop' per-connection death probability
         self._srv = socket.socket()
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", 0))
         self._srv.listen(32)
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
+        # Every socket this proxy owns (accepted + upstream), so close()
+        # can tear them down instead of leaking them to the peers' whim.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
 
+    def _track(self, sock) -> None:
+        with self._conns_lock:
+            self._conns.add(sock)
+
+    def _untrack(self, sock) -> None:
+        with self._conns_lock:
+            self._conns.discard(sock)
+
     def _accept_loop(self) -> None:
+        import random as _random
+
         while not self._stop.is_set():
             try:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
             mode = self.mode
-            if mode == "refuse":
+            if mode == "refuse" or (
+                mode == "drop" and _random.random() < self.drop_p
+            ):
                 conn.close()
                 continue
             if mode == "reset_once":
@@ -73,11 +102,13 @@ class FaultProxy:
                 )
                 conn.close()
                 continue
+            self._track(conn)
             threading.Thread(
                 target=self._serve, args=(conn, mode), daemon=True
             ).start()
 
     def _serve(self, conn: socket.socket, mode: str) -> None:
+        up = None
         try:
             if mode == "blackhole":
                 conn.settimeout(0.2)
@@ -90,10 +121,17 @@ class FaultProxy:
                     except OSError:
                         return
                 return
+            if mode == "latency":
+                # Hold the whole connection before any byte moves: the
+                # dialer's connect() already succeeded, so this reads as
+                # a slow peer, not a dead one.
+                if self._stop.wait(self.latency_s):
+                    return
             try:
                 up = socket.create_connection(self.target, timeout=5)
             except OSError:
                 return  # target gone: behaves like refuse
+            self._track(up)
 
             def pipe(src, dst):
                 try:
@@ -115,39 +153,62 @@ class FaultProxy:
             t.start()
             pipe(conn, up)
             t.join(timeout=5)
-            up.close()
         finally:
+            if up is not None:
+                self._untrack(up)
+                up.close()
+            self._untrack(conn)
             conn.close()
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown() before close(): closing a listening socket from
+        # another thread does NOT unblock a thread parked in accept() on
+        # Linux — shutdown does, so the accept loop exits immediately
+        # instead of the join below eating its whole timeout.
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
             pass
+        # Shut down (then close) the piped sockets: like the listener
+        # above, close() alone leaves a pipe() thread parked in recv()
+        # forever — shutdown unblocks it so it runs its cleanup path and
+        # untracks itself.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for s in conns:
+            for op in (lambda: s.shutdown(socket.SHUT_RDWR), s.close):
+                try:
+                    op()
+                except OSError:
+                    pass
+        self._thread.join(timeout=5)
 
 
 class RewriteClient(InternalClient):
     """InternalClient that dials selected peers through a FaultProxy:
     rewrites is the {'host:port': 'host:proxyport'} connection map. Node
     identity (URIs, ids) is untouched — only THIS node's outbound
-    connections move, which is what makes the partition asymmetric."""
+    connections move, which is what makes the partition asymmetric.
+    Rewrites happen at the dial hook, so peer_rpc_* tags and the circuit
+    breaker stay keyed by the peer's REAL host:port — exactly what the
+    routing layers (_routable_nodes, route_write*) look up."""
 
-    def __init__(self, rewrites: dict, timeout: float = 0.5):
-        super().__init__(timeout=timeout)
+    def __init__(self, rewrites: dict, timeout: float = 0.5, **kw):
+        super().__init__(timeout=timeout, **kw)
         self.rewrites = rewrites
 
-    def _do(self, method, uri, path, body=None,
-            content_type="application/json", raw=False, **kw):
-        from pilosa_tpu.cluster.client import _uri_str
-
-        u = _uri_str(uri)
+    def _connect_uri(self, uri) -> str:
+        u = super()._connect_uri(uri)
         scheme, _, hostport = u.partition("://")
         mapped = self.rewrites.get(hostport)
         if mapped is not None:
-            u = f"{scheme}://{mapped}"
-        return super()._do(method, u, path, body=body,
-                           content_type=content_type, raw=raw, **kw)
+            return f"{scheme}://{mapped}"
+        return u
 
 
 class ClusterNode:

@@ -42,6 +42,16 @@ def pytest_configure(config):
         "tpu: opt-in tests that require the real TPU chip "
         "(PILOSA_TPU_TEST_TPU=1 pytest -m tpu; run solo)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (FaultProxy blackhole/latency/drop "
+        "in the in-process cluster harness); fast, bounded-timeout chaos "
+        "stays in tier-1 — anything slow carries `slow` too",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (`-m 'not slow'`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -211,7 +211,10 @@ class TestPeerRpcMetrics:
     def test_error_classes_counted(self):
         from pilosa_tpu.cluster.client import ClientError, InternalClient
 
-        client = InternalClient(timeout=0.5)
+        # retries=0: this asserts PER-ATTEMPT error counting; the
+        # idempotent-GET retry (ISSUE r9) would legitimately dial — and
+        # count — a second transport error.
+        client = InternalClient(timeout=0.5, retries=0)
         before = _counter("peer_rpc_errors_total")
         with pytest.raises(ClientError):
             client.status("http://127.0.0.1:1")  # nothing listens on :1

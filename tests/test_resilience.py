@@ -1,0 +1,671 @@
+"""Round-9 data-plane resilience (ISSUE r9): end-to-end deadlines,
+per-peer circuit breaker, hedged shard reads, bounded idempotent-GET
+retries, machine-readable error codes, loud-write invariants, and the
+FaultProxy fault modes that exercise them — all in the in-process
+2-node harness, bounded-timeout (tier-1, `chaos` marked where faults
+are injected)."""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cluster.breaker import (
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    BreakerRegistry,
+)
+from pilosa_tpu.cluster.client import ClientError, InternalClient, peer_label
+from pilosa_tpu.cluster.topology import NODE_STATE_DOWN, NODE_STATE_READY
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+)
+from pilosa_tpu.utils.stats import global_stats
+from tests.cluster_harness import FaultProxy, RewriteClient, TestCluster
+
+
+def _counter(name_prefix: str) -> float:
+    snap = global_stats.snapshot()["counters"]
+    return sum(v for k, v in snap.items() if k.startswith(name_prefix))
+
+
+def _gauge(series: str):
+    return global_stats.snapshot()["gauges"].get(series)
+
+
+def _http_query(cn, index: str, pql: str, params: str = ""):
+    """POST through the real HTTP surface (the deadline scope and the
+    structured-error envelope live there, not in api.query). Returns
+    (status, headers, body-dict) for success AND error responses."""
+    url = f"http://127.0.0.1:{cn.server.port}/index/{index}/query{params}"
+    req = urllib.request.Request(url, data=pql.encode(), method="POST")
+    req.add_header("Content-Type", "text/plain")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _frag(cn, index, field, shard):
+    v = cn.holder.index(index).field(field).view(VIEW_STANDARD)
+    return v.fragment(shard) if v is not None else None
+
+
+def _proxied(tc, i: int, j: int, timeout: float = 5.0) -> FaultProxy:
+    """Route node i's outbound to node j through a fresh FaultProxy
+    (asymmetric: every other direction stays direct)."""
+    target = tc[j].node.uri
+    proxy = FaultProxy(target.host, target.port)
+    rc = RewriteClient(
+        {f"{target.host}:{target.port}": f"127.0.0.1:{proxy.port}"},
+        timeout=timeout,
+    )
+    tc[i].cluster.client = rc
+    tc[i].cluster.broadcaster.client = rc
+    return proxy
+
+
+def _shards_by_primary(tc, index: str, node_id: str, upto: int = 16):
+    topo = tc[0].cluster.topology
+    return [
+        s for s in range(upto)
+        if topo.shard_nodes(index, s)[0].id == node_id
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Deadline unit semantics
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_parse_rejects_garbage_and_nonpositive(self):
+        with pytest.raises(ValueError):
+            Deadline.parse("soon")
+        with pytest.raises(ValueError):
+            Deadline.parse("0")
+        with pytest.raises(ValueError):
+            Deadline.parse("-3")
+        with pytest.raises(ValueError):
+            # NaN satisfies neither <= 0 nor > 0: must 400, not produce
+            # a budget whose every check() raises (review r9).
+            Deadline.parse("nan")
+        assert Deadline.parse("2").budget == 2.0
+
+    def test_parse_caps_abusive_budgets(self):
+        from pilosa_tpu.utils.deadline import MAX_TIMEOUT
+
+        assert Deadline.parse("999999999").budget == MAX_TIMEOUT
+
+    def test_check_counts_phase_on_expiry(self):
+        before = _counter("deadline_exceeded_total")
+        d = Deadline(0.001)
+        time.sleep(0.005)
+        with pytest.raises(DeadlineExceeded) as ei:
+            d.check("gather")
+        assert ei.value.phase == "gather"
+        snap = global_stats.snapshot()["counters"]
+        assert snap.get('deadline_exceeded_total{phase="gather"}', 0) >= 1
+        assert _counter("deadline_exceeded_total") == before + 1
+
+    def test_bound_clamps_to_remaining_with_floor(self):
+        d = Deadline(0.5)
+        assert d.bound(30.0) <= 0.5
+        time.sleep(0.01)
+        assert d.bound(30.0) > 0  # never 0: stdlib reads 0 as non-blocking
+        expired = Deadline(0.001)
+        time.sleep(0.005)
+        assert expired.bound(30.0) == pytest.approx(0.001)
+
+    def test_scope_keeps_tighter_deadline(self):
+        tight = Deadline(0.2)
+        loose = Deadline(60.0)
+        with deadline_scope(tight):
+            with deadline_scope(loose):
+                # An inner layer must not LOOSEN the request budget.
+                assert current_deadline() is tight
+            assert current_deadline() is tight
+        assert current_deadline() is None
+
+    def test_scope_none_is_no_budget(self):
+        with deadline_scope(None):
+            assert current_deadline() is None
+            check_deadline("parse")  # no-op, must not raise
+
+    def test_header_value_subtracts_skew_margin(self):
+        from pilosa_tpu.utils.deadline import SKEW_MARGIN
+
+        d = Deadline(1.0)
+        assert float(d.header_value()) <= 1.0 - SKEW_MARGIN + 0.01
+
+
+# ---------------------------------------------------------------------------
+# Breaker unit semantics
+# ---------------------------------------------------------------------------
+
+
+class TestBreaker:
+    def test_threshold_consecutive_failures_open(self):
+        reg = BreakerRegistry(threshold=3, cooldown=10.0)
+        reg.record_failure("p:1")
+        reg.record_failure("p:1")
+        assert reg.state("p:1") == STATE_CLOSED
+        assert not reg.is_blocked("p:1")
+        reg.record_failure("p:1")
+        assert reg.state("p:1") == STATE_OPEN
+        assert reg.is_blocked("p:1")
+
+    def test_success_resets_consecutive_count(self):
+        reg = BreakerRegistry(threshold=2, cooldown=10.0)
+        reg.record_failure("p:1")
+        reg.record_success("p:1")  # not CONSECUTIVE anymore
+        reg.record_failure("p:1")
+        assert reg.state("p:1") == STATE_CLOSED
+
+    def test_cooldown_relaxes_to_half_open_then_closes(self):
+        reg = BreakerRegistry(threshold=1, cooldown=0.02, max_cooldown=0.02)
+        reg.record_failure("p:1")
+        assert reg.is_blocked("p:1")
+        deadline = time.time() + 2
+        while reg.is_blocked("p:1") and time.time() < deadline:
+            time.sleep(0.005)
+        assert reg.state("p:1") == STATE_HALF_OPEN
+        reg.record_success("p:1")  # the probe RPC succeeded
+        assert reg.state("p:1") == STATE_CLOSED
+        assert not reg.is_blocked("p:1")
+
+    def test_half_open_probe_failure_reopens_with_doubled_cooldown(
+        self, monkeypatch
+    ):
+        # Pin the jitter factor at 1.0 so the doubling is observable
+        # directly (the production windows overlap at their extremes).
+        import pilosa_tpu.cluster.breaker as brk
+
+        monkeypatch.setattr(brk.random, "random", lambda: 0.5)
+        reg = BreakerRegistry(threshold=1, cooldown=0.02, max_cooldown=60.0)
+        reg.record_failure("p:1")
+        b = reg._peers["p:1"]
+        first_cool = b.open_until - time.monotonic()
+        b.open_until = 0.0  # force cooldown expiry
+        assert not reg.is_blocked("p:1")  # relaxed to half-open
+        reg.record_failure("p:1")  # the probe failed
+        assert reg.state("p:1") == STATE_OPEN
+        second_cool = b.open_until - time.monotonic()
+        assert second_cool > first_cool
+        assert second_cool == pytest.approx(0.04, abs=0.01)
+        assert b.reopen_count == 2
+
+    def test_state_gauge_and_transition_counters(self):
+        before = _counter("peer_breaker_transitions_total")
+        reg = BreakerRegistry(threshold=1, cooldown=30.0)
+        reg.record_failure("gauge-peer:9")
+        assert _gauge('peer_breaker_state{peer="gauge-peer:9"}') == 2
+        reg.record_success("gauge-peer:9")
+        assert _gauge('peer_breaker_state{peer="gauge-peer:9"}') == 0
+        snap = global_stats.snapshot()["counters"]
+        assert snap.get(
+            'peer_breaker_transitions_total{peer="gauge-peer:9",to="open"}', 0
+        ) >= 1
+        assert snap.get(
+            'peer_breaker_transitions_total{peer="gauge-peer:9",to="closed"}', 0
+        ) >= 1
+        assert _counter("peer_breaker_transitions_total") == before + 2
+
+
+# ---------------------------------------------------------------------------
+# Client: bounded idempotent-GET retries
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestClientRetries:
+    def test_get_retries_transient_reset_and_succeeds(self):
+        with TestCluster(1) as tc:
+            target = tc[0].node.uri
+            proxy = FaultProxy(target.host, target.port)
+            try:
+                client = InternalClient(timeout=2.0, retries=1)
+                uri = f"http://127.0.0.1:{proxy.port}"
+                before = _counter("peer_rpc_retries_total")
+                proxy.mode = "reset_once"  # kills exactly one connection
+                out = client.status(uri)
+                assert isinstance(out, dict) and out
+                assert _counter("peer_rpc_retries_total") == before + 1
+            finally:
+                proxy.close()
+
+    def test_post_is_never_retried(self):
+        # reset_once auto-reverts to pass: if the POST retried, the retry
+        # would SUCCEED — so a raised error proves no second attempt.
+        with TestCluster(1) as tc:
+            target = tc[0].node.uri
+            proxy = FaultProxy(target.host, target.port)
+            try:
+                client = InternalClient(timeout=2.0, retries=3)
+                proxy.mode = "reset_once"
+                with pytest.raises(ClientError) as ei:
+                    client.send_message(
+                        f"http://127.0.0.1:{proxy.port}", b"{}"
+                    )
+                assert ei.value.transport
+            finally:
+                proxy.close()
+
+    def test_nearly_spent_deadline_preempts_retry(self):
+        client = InternalClient(timeout=1.0, retries=3)
+        before = _counter("peer_rpc_retries_total")
+        with deadline_scope(Deadline(0.03)):
+            with pytest.raises(ClientError):
+                client.status("http://127.0.0.1:1")  # nothing listens
+        # The remaining budget could not cover a backoff sleep + dial:
+        # no retry was attempted.
+        assert _counter("peer_rpc_retries_total") == before
+
+    def test_drop_mode_raises_transport_error(self):
+        with TestCluster(1) as tc:
+            target = tc[0].node.uri
+            proxy = FaultProxy(target.host, target.port)
+            try:
+                proxy.drop_p = 1.0
+                proxy.mode = "drop"
+                client = InternalClient(timeout=1.0, retries=1)
+                with pytest.raises(ClientError) as ei:
+                    client.status(f"http://127.0.0.1:{proxy.port}")
+                assert ei.value.transport
+                proxy.drop_p = 0.0  # p=0 passes everything
+                assert client.status(f"http://127.0.0.1:{proxy.port}")
+            finally:
+                proxy.close()
+
+
+# ---------------------------------------------------------------------------
+# FaultProxy hygiene (satellite: fd-leak regression)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultProxyHygiene:
+    def test_close_reaps_piped_connections(self):
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(4)
+        proxy = FaultProxy("127.0.0.1", listener.getsockname()[1])
+        conn = socket.create_connection(("127.0.0.1", proxy.port), timeout=2)
+        conn.sendall(b"hello")
+        upstream, _ = listener.accept()
+        assert upstream.recv(5) == b"hello"  # the pipe is live
+        proxy.close()
+        # close() must join the accept loop and tear down the piped
+        # sockets itself — the old close left them to the peers' whim.
+        assert not proxy._thread.is_alive()
+        deadline = time.time() + 2
+        while proxy._conns and time.time() < deadline:
+            time.sleep(0.01)
+        assert not proxy._conns
+        # The far ends observe the teardown promptly.
+        upstream.settimeout(2)
+        assert upstream.recv(100) == b""
+        conn.close()
+        upstream.close()
+        listener.close()
+
+    def test_close_unblocks_blackholed_connection(self):
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(4)
+        proxy = FaultProxy("127.0.0.1", listener.getsockname()[1])
+        proxy.mode = "blackhole"
+        conn = socket.create_connection(("127.0.0.1", proxy.port), timeout=2)
+        conn.sendall(b"GET / HTTP/1.1\r\n\r\n")
+        time.sleep(0.05)  # let _serve enter its blackhole loop
+        t0 = time.time()
+        proxy.close()
+        assert time.time() - t0 < 3  # join did not hang on the blackhole
+        assert not proxy._thread.is_alive()
+        conn.close()
+        listener.close()
+
+    def test_proxy_cycling_does_not_leak_fds(self):
+        import os
+
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(16)
+        port = listener.getsockname()[1]
+        accepted = []
+
+        def cycle():
+            proxy = FaultProxy("127.0.0.1", port)
+            c = socket.create_connection(("127.0.0.1", proxy.port), timeout=2)
+            c.sendall(b"x")
+            up, _ = listener.accept()
+            accepted.append(up)
+            up.recv(1)
+            proxy.close()
+            c.close()
+            up.close()
+
+        cycle()  # warm allocators/thread stacks before measuring
+        base = len(os.listdir("/proc/self/fd"))
+        for _ in range(10):
+            cycle()
+        time.sleep(0.2)
+        grown = len(os.listdir("/proc/self/fd")) - base
+        # Pre-fix each cycle leaked 2 established sockets (proxy-side
+        # conn + upstream) = 20 fds over 10 cycles; allow unrelated noise.
+        assert grown <= 6, grown
+        listener.close()
+
+
+# ---------------------------------------------------------------------------
+# Structured error codes + Retry-After (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestErrorCodes:
+    def test_every_error_body_carries_a_code(self):
+        with TestCluster(1) as tc:
+            port = tc[0].server.port
+            # 404 from a route that predates structured codes.
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/index/nope", timeout=10
+                )
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                assert json.loads(e.read())["code"] == "not-found"
+            # 400 from a garbage ?timeout=.
+            status, _, body = _http_query(
+                tc[0], "nope", "Count(Row(f=1))", "?timeout=never"
+            )
+            assert status == 400
+            assert body["code"] == "bad-request"
+
+    def test_deadline_exceeded_is_504_with_retry_after(self):
+        with TestCluster(1) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            tc.query(0, "i", "Set(1, f=1)")
+            # A 1 µs budget is always spent by the first phase check.
+            status, headers, body = _http_query(
+                tc[0], "i", "Count(Row(f=1))", "?timeout=0.000001"
+            )
+            assert status == 504
+            assert body["code"] == "deadline-exceeded"
+            assert headers.get("Retry-After") == "1"
+
+    def test_generous_timeout_serves_normally(self):
+        with TestCluster(2, replica_n=2) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 3 for s in range(4)]
+            tc.query(0, "i", " ".join(f"Set({c}, f=1)" for c in cols))
+            status, _, body = _http_query(
+                tc[0], "i", "Count(Row(f=1))", "?timeout=30"
+            )
+            assert status == 200
+            assert body["results"][0] == len(cols)
+
+
+# ---------------------------------------------------------------------------
+# Loud-write invariant (satellite): no live replica => structured failure
+# ---------------------------------------------------------------------------
+
+
+class TestLoudWriteInvariant:
+    def test_route_write_all_replicas_down_is_structured_503(self):
+        with TestCluster(3, replica_n=1) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            topo = tc[0].cluster.topology
+            shard = next(
+                s for s in range(64)
+                if topo.shard_nodes("i", s)[0].id == "node2"
+            )
+            topo.node_by_id("node2").state = NODE_STATE_DOWN
+            before = _counter("write_replica_unavailable_total")
+            status, headers, body = _http_query(
+                tc[0], "i", f"Set({shard * SHARD_WIDTH + 1}, f=1)"
+            )
+            assert status == 503
+            assert body["code"] == "replicas-unavailable"
+            assert headers.get("Retry-After") == "1"
+            assert _counter("write_replica_unavailable_total") == before + 1
+
+    def test_route_write_shards_all_replicas_down_is_loud(self):
+        with TestCluster(3, replica_n=1) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            topo = tc[0].cluster.topology
+            mine = _shards_by_primary(tc, "i", "node0", 64)[0]
+            theirs = next(
+                s for s in range(64)
+                if topo.shard_nodes("i", s)[0].id == "node2"
+            )
+            tc.query(0, "i", f"Set({mine * SHARD_WIDTH + 1}, f=2)")
+            tc.query(0, "i", f"Set({theirs * SHARD_WIDTH + 1}, f=2)")
+            topo.node_by_id("node2").state = NODE_STATE_DOWN
+            before = _counter("write_replica_unavailable_total")
+            # Multi-shard replicated write (ClearRow): one of its shards
+            # has zero live replicas -> the WHOLE write fails loudly.
+            status, _, body = _http_query(tc[0], "i", "ClearRow(f=2)")
+            assert status == 503
+            assert body["code"] == "replicas-unavailable"
+            assert _counter("write_replica_unavailable_total") == before + 1
+
+    def test_open_breaker_counts_as_down_for_writes(self):
+        with TestCluster(2, replica_n=1) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            shard = _shards_by_primary(tc, "i", "node1", 64)[0]
+            peer = peer_label(tc[1].node.uri)
+            breakers = tc[0].cluster.client.breakers
+            for _ in range(breakers.threshold):
+                breakers.record_failure(peer)
+            assert breakers.is_blocked(peer)
+            # node1 is READY in the topology — only its breaker is open —
+            # yet the sole-replica write must still fail loudly rather
+            # than eat a timeout or silently drop.
+            status, _, body = _http_query(
+                tc[0], "i", f"Set({shard * SHARD_WIDTH + 1}, f=1)"
+            )
+            assert status == 503
+            assert body["code"] == "replicas-unavailable"
+
+    def test_skipped_down_replica_write_lands_and_repairs(self):
+        with TestCluster(2, replica_n=2) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            tc.query(0, "i", "Set(1, f=1)")  # shard 0 exists everywhere
+            tc.await_shard_convergence("i")
+            topo = tc[0].cluster.topology
+            topo.node_by_id("node1").state = NODE_STATE_DOWN
+            col = 7
+            out = tc.query(0, "i", f"Set({col}, f=1)")
+            assert out["results"][0] is True  # landed on the live replica
+            assert _frag(tc[0], "i", "f", 0).row(1).includes_column(col)
+            assert not _frag(tc[1], "i", "f", 0).row(1).includes_column(col)
+            # The replica returns: anti-entropy repairs the skipped write.
+            topo.node_by_id("node1").state = NODE_STATE_READY
+            tc.sync_all()
+            assert _frag(tc[1], "i", "f", 0).row(1).includes_column(col)
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: breaker + hedge + deadline in the 2-node harness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestChaosAcceptance:
+    def _load(self, tc):
+        """Populate two shards primaried on EACH node (deterministic via
+        the shared hasher), so every fan-out from node0 has a remote leg
+        to node1 — the leg the faults are aimed at."""
+        tc.create_index("i")
+        tc.create_field("i", "f")
+        shards = (
+            _shards_by_primary(tc, "i", "node0", 64)[:2]
+            + _shards_by_primary(tc, "i", "node1", 64)[:2]
+        )
+        assert len(shards) == 4
+        cols = [s * SHARD_WIDTH + 5 for s in shards]
+        tc.query(0, "i", " ".join(f"Set({c}, f=1)" for c in cols))
+        tc.await_shard_convergence("i")
+        return cols
+
+    def test_blackholed_replica_hedge_completes_within_deadline(self):
+        with TestCluster(2, replica_n=2) as tc:
+            cols = self._load(tc)
+            proxy = _proxied(tc, 0, 1, timeout=5.0)
+            tc[0].cluster.hedge_delay = 0.2
+            try:
+                proxy.mode = "blackhole"
+                before = _counter("hedged_requests_total")
+                t0 = time.time()
+                status, _, body = _http_query(
+                    tc[0], "i", "Count(Row(f=1))", "?timeout=2"
+                )
+                elapsed = time.time() - t0
+                # Correct, NON-partial result, inside the budget: the
+                # straggler leg was re-launched at the local replica.
+                assert status == 200
+                assert body["results"][0] == len(cols)
+                assert elapsed < 2.0, elapsed
+                snap = global_stats.snapshot()["counters"]
+                assert snap.get(
+                    'hedged_requests_total{won="hedge"}', 0
+                ) >= 1
+                assert _counter("hedged_requests_total") > before
+            finally:
+                proxy.close()
+
+    def test_breaker_opens_and_routes_around_dead_peer(self):
+        with TestCluster(2, replica_n=2) as tc:
+            cols = self._load(tc)
+            proxy = _proxied(tc, 0, 1, timeout=5.0)
+            peer = peer_label(tc[1].node.uri)
+            breakers = tc[0].cluster.client.breakers
+            try:
+                proxy.mode = "refuse"
+                # Each query's node1 leg fails instantly and re-splits to
+                # the local replica — queries keep answering while the
+                # consecutive failures accumulate to the threshold.
+                for _ in range(breakers.threshold):
+                    _, _, body = _http_query(tc[0], "i", "Count(Row(f=1))")
+                    assert body["results"][0] == len(cols)
+                assert breakers.state(peer) == STATE_OPEN
+                assert _gauge(f'peer_breaker_state{{peer="{peer}"}}') == 2
+                # With the breaker open the peer is skipped up front:
+                # the query never pays a dial, so it is fast AND correct.
+                t0 = time.time()
+                status, _, body = _http_query(
+                    tc[0], "i", "Count(Row(f=1))", "?timeout=2"
+                )
+                assert status == 200
+                assert body["results"][0] == len(cols)
+                assert time.time() - t0 < 1.0
+            finally:
+                proxy.close()
+
+    def test_breaker_half_open_probe_recovers(self):
+        with TestCluster(2, replica_n=2) as tc:
+            cols = self._load(tc)
+            proxy = _proxied(tc, 0, 1, timeout=5.0)
+            rc = tc[0].cluster.client
+            rc.breakers = BreakerRegistry(
+                threshold=1, cooldown=0.05, max_cooldown=0.05
+            )
+            peer = peer_label(tc[1].node.uri)
+            try:
+                proxy.mode = "refuse"
+                _http_query(tc[0], "i", "Count(Row(f=1))")
+                assert rc.breakers.state(peer) == STATE_OPEN
+                # Heal the link; the jittered cooldown (≤ 75 ms) relaxes
+                # the breaker to HALF_OPEN, the next query is the probe,
+                # and its success closes the breaker.
+                proxy.mode = "pass"
+                deadline = time.time() + 2
+                while rc.breakers.is_blocked(peer) and time.time() < deadline:
+                    time.sleep(0.01)
+                assert rc.breakers.state(peer) == STATE_HALF_OPEN
+                _, _, body = _http_query(tc[0], "i", "Count(Row(f=1))")
+                assert body["results"][0] == len(cols)
+                assert rc.breakers.state(peer) == STATE_CLOSED
+            finally:
+                proxy.close()
+
+    def test_remote_node_observes_propagated_deadline_and_aborts(self):
+        with TestCluster(2, replica_n=1) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            shard = _shards_by_primary(tc, "i", "node1", 64)[0]
+            tc.query(0, "i", f"Set({shard * SHARD_WIDTH + 1}, f=1)")
+            # Slow down node1's per-call execution past the propagated
+            # budget: the FIRST call overruns, and the phase check at the
+            # SECOND call's boundary must abort the leg remotely.
+            orig = tc[1].executor.execute_call
+
+            def slow(index, call, shards, opt):
+                time.sleep(0.4)
+                return orig(index, call, shards, opt)
+
+            tc[1].executor.execute_call = slow
+            snap0 = global_stats.snapshot()["counters"]
+
+            def remote_aborts() -> float:
+                # The coordinator's own expiries land on gather/peer_rpc;
+                # these phases can only have fired on the REMOTE node,
+                # inside the scope it opened from X-Pilosa-Deadline.
+                snap = global_stats.snapshot()["counters"]
+                return sum(
+                    snap.get(f'deadline_exceeded_total{{phase="{p}"}}', 0)
+                    - snap0.get(f'deadline_exceeded_total{{phase="{p}"}}', 0)
+                    for p in ("parse", "plan", "device_dispatch", "serialize")
+                )
+
+            status, _, body = _http_query(
+                tc[0], "i", "Row(f=1) Row(f=1)", "?timeout=0.3"
+            )
+            assert status in (502, 504)
+            assert body["code"] in ("deadline-exceeded", "peer-error")
+            # The remote aborted at an EXECUTOR phase boundary rather than
+            # completing abandoned work; its leg outlives the
+            # coordinator's 504 by ~the overrun, so poll.
+            deadline = time.time() + 3
+            while remote_aborts() < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert remote_aborts() >= 1
+
+    def test_gather_wait_is_budget_derived(self):
+        """A blackholed sole-owner leg with no hedge/replica escape must
+        surface as deadline-exceeded WITHIN the budget — not after the
+        old flat client.timeout + 30 gather wait."""
+        with TestCluster(2, replica_n=1) as tc:
+            tc.create_index("i")
+            tc.create_field("i", "f")
+            shard = _shards_by_primary(tc, "i", "node1", 64)[0]
+            tc.query(0, "i", f"Set({shard * SHARD_WIDTH + 1}, f=1)")
+            proxy = _proxied(tc, 0, 1, timeout=30.0)
+            try:
+                proxy.mode = "blackhole"
+                t0 = time.time()
+                status, _, body = _http_query(
+                    tc[0], "i", "Count(Row(f=1))", "?timeout=1"
+                )
+                elapsed = time.time() - t0
+                assert status in (502, 504)
+                assert elapsed < 5.0, elapsed
+            finally:
+                proxy.close()

@@ -43,7 +43,7 @@ DYNAMIC_FAMILIES = {
 METRIC_SUFFIXES = (
     "_total", "_seconds", "_bytes", "_pending", "_done",
     "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
-    "_shards", "_evictions", "_rederives",
+    "_shards", "_evictions", "_rederives", "_state",
 )
 
 _CALL_RE = re.compile(
