@@ -82,6 +82,7 @@ import re
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -186,11 +187,15 @@ class BenchConn:
 
 
 def parse_prometheus(text: str) -> dict:
-    """'name{tags} value' lines -> {full series name: float}."""
+    """'name{tags} value' lines -> {full series name: float}. A bucket
+    line's trailing exemplar (' # {trace_id=...} v') is stripped first —
+    rpartition on the raw line would read the exemplar value as the
+    sample."""
     out = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        line = line.partition(" # ")[0]
         name, _, val = line.rpartition(" ")
         try:
             out[name] = float(val)
@@ -235,6 +240,44 @@ def phase_means_ms(metrics_text: str, baseline: tuple = None) -> dict:
         for p in sums
         if counts.get(p)
     }
+
+
+def hist_quantiles_ms(family: str, baseline: Optional[dict] = None,
+                      tag: str = "") -> Optional[dict]:
+    """Server-side p50/p95/p99/p999 (ms, bucket-interpolated) of one
+    histogram family from the in-process registry, merged across
+    matching series and diffed against a leg-start
+    global_stats.histogram_snapshot() baseline (ISSUE r10 satellite).
+    Recorded NEXT TO each leg's client-measured numbers so client/server
+    disagreement — queueing in the client, a stalled reader, clock
+    weirdness — is itself a diagnostic instead of an invisible bias.
+    None when the leg produced no matching observations."""
+    from pilosa_tpu.utils.stats import (
+        QUANTILE_LABELS,
+        bucket_quantile,
+        merge_buckets,
+        series_matches,
+    )
+
+    snap = global_stats.histogram_snapshot()
+    merged = None
+    for name, ent in snap.items():
+        if not series_matches(name, family):
+            continue
+        if tag and tag not in name:
+            continue
+        b = list(ent["buckets"])
+        if baseline is not None and name in baseline:
+            base = baseline[name]["buckets"]
+            b = [max(0.0, x - y) for x, y in zip(b, base)]
+        merged = b if merged is None else merge_buckets(merged, b)
+    if merged is None or sum(merged) <= 0:
+        return None
+    out: dict = {"count": int(sum(merged))}
+    for label, q in QUANTILE_LABELS:
+        v = bucket_quantile(merged, q)
+        out[label + "_ms"] = round(v * 1e3, 3) if v is not None else None
+    return out
 
 
 def walk_totals() -> dict:
@@ -569,13 +612,18 @@ def bench_tpu_single(be, queries) -> tuple[float, float, dict, float]:
 
 
 def bench_topn(be) -> float:
-    """Exact TopN over the whole field: p50 of LATENCY_N runs."""
+    """Exact TopN over the whole field: p50 of LATENCY_N runs. Each run
+    is profiled (call="TopN") so the leg's server-side histogram
+    quantiles exist next to the client-side p50."""
+    from pilosa_tpu.utils.qprofile import profile_scope
+
     shards = list(range(SHARDS))
     be.topn_field("bench", "f", shards, 10)  # warm
     lat = []
     for _ in range(max(5, LATENCY_N // 3)):
         t0 = time.perf_counter()
-        be.topn_field("bench", "f", shards, 10)
+        with profile_scope(index="bench", call="TopN"):
+            be.topn_field("bench", "f", shards, 10)
         lat.append(time.perf_counter() - t0)
     lat.sort()
     return lat[len(lat) // 2]
@@ -616,6 +664,7 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     # breakdown must cover only what the serving path did from here on
     # (the warm request's compile outlier is also excluded).
     phase_base = phase_totals(warm.get_text("/metrics"))
+    hist_base = global_stats.histogram_snapshot()
 
     wcol = [0]  # distinct column per write: every Set is a real mutation
 
@@ -704,6 +753,12 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     # JSON carries the serving-path breakdown, not a guess.
     metrics_text = warm.get_text("/metrics")
     http_phase_ms = phase_means_ms(metrics_text, baseline=phase_base)
+    # Server-side request-latency distribution for the whole leg, from
+    # the serving histogram (per REQUEST, like http_phase_per_request_ms)
+    # — the number the client-side p50 is checked against.
+    http_server_ms = hist_quantiles_ms(
+        "http_request_duration_seconds", hist_base, tag='route="post_query"'
+    )
     # The abort counter carries route/method tags: sum every series.
     aborts = int(sum(
         v for k, v in parse_prometheus(metrics_text).items()
@@ -713,7 +768,7 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     srv.close()
     return (
         qps_at_rate, achieved_rate, lat[len(lat) // 2], http_phase_ms,
-        aborts, churn_walks,
+        aborts, churn_walks, http_server_ms,
     )
 
 
@@ -1013,6 +1068,7 @@ def main():
     # drift artifact (VERDICT r4 #8 — the honest number is p50 minus a
     # floor captured under the same network conditions).
     rtt_floor_adjacent = measure_rtt_floor()
+    single_hist_base = global_stats.histogram_snapshot()
     p50, p99, single_phase_ms, single_mean_s = bench_tpu_single(be, queries)
     # Over-floor attribution: the phases sum to ~the whole query (the
     # readback phase carries the floor), so named-phase coverage of the
@@ -1037,9 +1093,22 @@ def main():
         single_query_p99_ms=round(p99 * 1e3, 2),
         single_query_phase_ms=single_phase_ms,
         single_query_attributed_pct=attributed_pct,
+        # Server-side distribution of the same leg (query_seconds
+        # histogram delta, quantile-interpolated): disagreement with the
+        # client-measured p50/p99 above is itself a diagnostic.
+        single_query_server_ms=hist_quantiles_ms(
+            "query_seconds", single_hist_base, tag='call="Count"'
+        ),
     )
+    topn_hist_base = global_stats.histogram_snapshot()
     topn_p50 = bench_topn(be)
-    checkpoint("topn", topn_p50_ms=round(topn_p50 * 1e3, 2))
+    checkpoint(
+        "topn",
+        topn_p50_ms=round(topn_p50 * 1e3, 2),
+        topn_server_ms=hist_quantiles_ms(
+            "query_seconds", topn_hist_base, tag='call="TopN"'
+        ),
+    )
     # GroupBy BEFORE the churn legs: its cold figure is the h-stack
     # pack + upload + tri-program compile — measured after churn it
     # also absorbed a full f-stack rebuild (hundreds of dirtied shards)
@@ -1050,6 +1119,7 @@ def main():
         groupby_3field_cold_s=round(groupby_cold_s, 2),
         groupby_3field_warm_ms=round(groupby_warm_s * 1e3, 1),
     )
+    mm_hist_base = global_stats.histogram_snapshot()
     mm_ro, mm_churn, mm_wrate, mm_walks = bench_minmax_churn(h, be)
     checkpoint(
         "minmax_churn",
@@ -1058,10 +1128,11 @@ def main():
         minmax_churn_qps_ratio=round(mm_churn / mm_ro, 3) if mm_ro else None,
         minmax_write_rate_achieved=round(mm_wrate, 1),
         minmax_churn_version_walks=mm_walks,
+        minmax_server_ms=hist_quantiles_ms("query_seconds", mm_hist_base),
     )
     (
         qps_at_rate, achieved_rate, http_p50, http_phase_ms, aborts,
-        http_churn_walks,
+        http_churn_walks, http_server_ms,
     ) = bench_http(h, be, queries)
     http_qps = qps_at_rate.get("0", next(iter(qps_at_rate.values())))
     checkpoint(
@@ -1069,6 +1140,10 @@ def main():
         qps_at_write_rate=qps_at_rate,
         write_rate_achieved=achieved_rate,
         http_single_p50_ms=round(http_p50 * 1e3, 2),
+        # Per-REQUEST server-side distribution from the serving
+        # histogram — the client p50 above should sit inside it; a gap
+        # is client-side queueing or a stalled reader, now visible.
+        http_server_ms=http_server_ms,
         # Per-REQUEST means (one profile per request; requests carry 16
         # queries or batched writes) — named so it can't be misread as a
         # per-query figure against http_single_p50_ms.

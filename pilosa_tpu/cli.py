@@ -125,7 +125,13 @@ def cmd_server(args) -> int:
     daemons = []
     from pilosa_tpu.utils.monitor import RuntimeMonitor
 
-    daemons.append(RuntimeMonitor(holder, backend).start())
+    monitor = RuntimeMonitor(holder, backend)
+    # SLO objectives (config `slo`): the monitor's poll loop keeps the
+    # windowed histogram snapshots /debug/slo evaluates them against.
+    monitor.slo = cfg.slo
+    api.slo = cfg.slo
+    api.monitor = monitor
+    daemons.append(monitor.start())
     join_cluster_ref = None
     if getattr(args, "join", None):
         # Dynamic join (reference gossip join → listenForJoins

@@ -475,8 +475,11 @@ class InternalClient:
 
     def metrics_text(self, uri) -> str:
         """One node's raw prometheus exposition — the federation scrape
-        behind /metrics/cluster."""
-        return self._do("GET", uri, "/metrics", raw=True,
+        behind /metrics/cluster. ?exemplars=1 opts into the OpenMetrics
+        exemplar suffixes so the re-tagged per-node series keep their
+        trace links (the federation response strips them again for any
+        scraper that didn't opt in itself)."""
+        return self._do("GET", uri, "/metrics?exemplars=1", raw=True,
                         op="metrics_text").decode("utf-8", "replace")
 
     def debug_vars(self, uri) -> dict:

@@ -200,12 +200,36 @@ class Executor:
         stats.timing("execute_duration_seconds", elapsed)
         if elapsed > self.long_query_time and self.logger is not None:
             # reference api.go:1157 long-query log, now with the phase
-            # breakdown so a slow query arrives pre-diagnosed.
+            # breakdown so a slow query arrives pre-diagnosed, and the
+            # index's histogram p99 so the line says whether this is an
+            # outlier or the workload's new normal.
             self.logger.printf(
-                "%.3fs longQueryTime exceeded: %r [qid=%d %s]",
+                "%.3fs longQueryTime exceeded: %r [qid=%d %s%s]",
                 elapsed, query, prof.qid, prof.phase_summary(),
+                self._p99_context(index),
             )
         return results
+
+    def _p99_context(self, index: str) -> str:
+        """' p99=12.3ms' for the slow-query log: the index's interpolated
+        execute-duration p99 from the cumulative histogram — never from a
+        sample ring, so the context can't recency-bias toward the very
+        outlier being logged. Empty on any failure: the log line must
+        never be the thing that breaks."""
+        try:
+            from pilosa_tpu.utils.stats import bucket_quantile
+
+            snap = self.stats.histogram_snapshot()
+            key = f'execute_duration_seconds{{index="{index}"}}'
+            ent = snap.get(key)
+            if ent is None:
+                return ""
+            p99 = bucket_quantile(ent["buckets"], 0.99)
+            if p99 is None:
+                return ""
+            return f" p99={round(p99 * 1e3, 1)}ms"
+        except Exception:  # noqa: BLE001 — context is best-effort
+            return ""
 
     # ------------------------------------------------------------------
     # key translation (reference executor.go translateCalls :2615)

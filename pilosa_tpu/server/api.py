@@ -62,6 +62,13 @@ class API:
         # neither ?timeout= nor X-Pilosa-Deadline (config query-timeout).
         # 0 = no default budget.
         self.query_timeout = 0.0
+        # SLO objectives ([{metric, quantile, threshold_s, window_s}],
+        # config `slo`) and the RuntimeMonitor whose windowed histogram
+        # snapshots /debug/slo evaluates them against. The CLI wires
+        # both; a bare server lazily attaches an unstarted monitor on
+        # first /debug/slo scrape.
+        self.slo: list[dict] = []
+        self.monitor = None
 
     def _validate_state(self, method: str) -> None:
         if self.cluster is None or method in _STATE_EXEMPT:

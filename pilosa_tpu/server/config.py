@@ -7,6 +7,7 @@ reference's viper/pflag stack (reference docs/configuration.md:20-34).
 
 from __future__ import annotations
 
+import json
 import os
 import tomllib
 from dataclasses import dataclass, field
@@ -113,6 +114,55 @@ class Config:
     # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
     # via row paging instead of whole-stack residency.
     max_hbm_bytes: int = 0
+    # -- latency SLO objectives (ISSUE r10) --------------------------------
+    # Each objective: {metric, quantile, threshold_s, window_s} —
+    # "quantile of <metric> must stay under threshold_s seconds over
+    # window_s". Evaluated from windowed histogram snapshots at
+    # GET /debug/slo with fast-5m/slow-1h burn rates. TOML spelling is
+    # [[slo]] tables (keys metric / quantile / threshold / window); env
+    # PILOSA_TPU_SLO takes the same list as JSON.
+    slo: list = field(default_factory=list)
+
+    @staticmethod
+    def _normalize_slo(entries) -> list:
+        from pilosa_tpu.utils.stats import BUCKET_BOUNDS
+
+        out = []
+        for e in entries or ():
+            if not isinstance(e, dict) or not e.get("metric"):
+                raise ValueError(f"invalid slo objective: {e!r}")
+            q = float(e.get("quantile", 0.99))
+            thr = float(e.get("threshold_s", e.get("threshold", 1.0)))
+            win = float(e.get("window_s", e.get("window", 3600.0)))
+            # Range checks at config load, not at evaluation: `quantile
+            # = 99` (the percent-vs-fraction typo) would otherwise page
+            # forever with a ~1e9 burn rate instead of failing boot.
+            if not 0.0 < q < 1.0:
+                raise ValueError(
+                    f"slo quantile must be in (0, 1), got {q!r}: {e!r}"
+                )
+            if thr <= 0.0:
+                raise ValueError(f"slo threshold must be > 0: {e!r}")
+            # The histogram's top finite bound is the largest threshold
+            # the bucket CDF can evaluate: past it every observation in
+            # the +Inf bucket reads as compliant and the objective can
+            # never page — reject rather than silently never alert.
+            if thr > BUCKET_BOUNDS[-1]:
+                raise ValueError(
+                    f"slo threshold {thr}s exceeds the largest histogram "
+                    f"bucket bound ({BUCKET_BOUNDS[-1]:g}s): {e!r}"
+                )
+            if win <= 0.0:
+                raise ValueError(f"slo window must be > 0: {e!r}")
+            out.append(
+                {
+                    "metric": str(e["metric"]),
+                    "quantile": q,
+                    "threshold_s": thr,
+                    "window_s": win,
+                }
+            )
+        return out
 
     def _split_bind(self) -> tuple[str, int]:
         """Handles host:port, :port, bare host, [v6]:port, and bare IPv6."""
@@ -165,6 +215,7 @@ class Config:
             "breaker-threshold": self.breaker_threshold,
             "breaker-cooldown": self.breaker_cooldown,
             "hedge-delay": self.hedge_delay,
+            "slo": [dict(o) for o in self.slo],
         }
 
     @staticmethod
@@ -220,6 +271,8 @@ class Config:
         self.tls.key = t.get("key", self.tls.key)
         self.tls.ca_certificate = t.get("ca-certificate", self.tls.ca_certificate)
         self.tls.skip_verify = t.get("skip-verify", self.tls.skip_verify)
+        if "slo" in data:
+            self.slo = self._normalize_slo(data["slo"])
 
     def _apply_env(self, env: dict) -> None:
         pre = "PILOSA_TPU_"
@@ -245,6 +298,10 @@ class Config:
             pre + "BREAKER_THRESHOLD": ("breaker_threshold", int),
             pre + "BREAKER_COOLDOWN": ("breaker_cooldown", float),
             pre + "HEDGE_DELAY": ("hedge_delay", float),
+            pre + "SLO": (
+                "slo",
+                lambda v: Config._normalize_slo(json.loads(v)) if v else [],
+            ),
             pre + "TLS_CERTIFICATE": ("tls.certificate", str),
             pre + "TLS_KEY": ("tls.key", str),
             pre + "TLS_CA_CERTIFICATE": ("tls.ca_certificate", str),
@@ -281,7 +338,18 @@ class Config:
             f"breaker-threshold = {c.breaker_threshold}\n"
             f"breaker-cooldown = {c.breaker_cooldown}\n"
             f"hedge-delay = {c.hedge_delay}\n"
-            f"[profile]\nport = {c.profile_port}\n"
+            + "".join(
+                "\n[[slo]]\n"
+                # json.dumps: a tagged metric spelling like
+                # query_seconds{call="Count"} carries double quotes that
+                # must be escaped or the emitted TOML can't round-trip.
+                f'metric = {json.dumps(o["metric"])}\n'
+                f"quantile = {o['quantile']}\n"
+                f"threshold = {o['threshold_s']}\n"
+                f"window = {o['window_s']}\n"
+                for o in c.slo
+            )
+            + f"[profile]\nport = {c.profile_port}\n"
             "\n[tls]\n"
             f'certificate = "{c.tls.certificate}"\n'
             f'key = "{c.tls.key}"\n'

@@ -91,11 +91,14 @@ def _retag_prometheus(text: str, node_id: str) -> list[str]:
     cluster_scrape_failures_total{node=...}) is renamed exported_node=
     — duplicate label names are illegal in the exposition format and
     would make Prometheus reject the whole federated scrape. Comment/
-    blank lines are dropped — the merged pane re-groups series anyway."""
+    blank lines are dropped — the merged pane re-groups series anyway.
+    A histogram bucket's trailing `# {trace_id=...}` exemplar is split
+    off before the value parse and re-appended after the retag."""
     out = []
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        line, _, exemplar = line.partition(" # ")
         series, sep, value = line.rpartition(" ")
         if not sep:
             continue
@@ -108,7 +111,77 @@ def _retag_prometheus(text: str, node_id: str) -> list[str]:
             # would also mangle exported_node= on double federation.
             tags = re.sub(r'(^|,)node="', r'\1exported_node="', tags)
             series = series[: brace + 1] + f'node="{node_id}",' + tags
-        out.append(f"{series} {value}")
+        suffix = f" # {exemplar}" if exemplar else ""
+        out.append(f"{series} {value}{suffix}")
+    return out
+
+
+_HIST_LINE_RE = re.compile(
+    r"^(pilosa_[A-Za-z0-9_]+)_(bucket|sum|count)\{(.*)\} ([0-9.eE+-]+)$"
+)
+_LE_TAG_RE = re.compile(r'(?:^|,)le="([^"]+)"')
+
+
+def _merge_member_histograms(texts: list[str]) -> list[str]:
+    """Sum every member's histogram series into true cluster-wide
+    distributions, emitted with `node="_cluster"` as the first label
+    (next to — never instead of — the per-node re-tagged series).
+    Identical static bucket boundaries (utils/stats.py BUCKET_BOUNDS)
+    make the cumulative bucket vectors additive per `le`, so the merged
+    p99 is the quantile of the POOLED observations — the figure
+    averaging per-node p99s can never produce. Only families that emit
+    `_bucket` lines merge; a counter that merely ends in _count is
+    untouched."""
+    buckets: dict[tuple, dict[str, float]] = {}
+    sums: dict[tuple, float] = {}
+    counts: dict[tuple, float] = {}
+    for text in texts:
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            line = line.partition(" # ")[0]  # exemplars don't merge
+            m = _HIST_LINE_RE.match(line)
+            if m is None:
+                continue
+            family, kind, tags, value = m.groups()
+            try:
+                v = float(value)
+            except ValueError:
+                continue
+            if kind == "bucket":
+                le = _LE_TAG_RE.search(tags)
+                if le is None:
+                    continue
+                rest = _LE_TAG_RE.sub("", tags).strip(",")
+                key = (family, rest)
+                buckets.setdefault(key, {})
+                buckets[key][le.group(1)] = buckets[key].get(le.group(1), 0.0) + v
+            elif kind == "sum":
+                sums[(family, tags)] = sums.get((family, tags), 0.0) + v
+            else:
+                counts[(family, tags)] = counts.get((family, tags), 0.0) + v
+
+    def le_order(le: str) -> float:
+        return float("inf") if le == "+Inf" else float(le)
+
+    def fmt(v: float) -> str:
+        # Exact, not '%g': a 6-sig-digit render of a 1,234,567-count
+        # bucket would round adjacent cumulative buckets independently
+        # and break monotonicity (and counter-delta math downstream).
+        return str(int(v)) if v == int(v) else repr(v)
+
+    out = []
+    for (family, rest), les in sorted(buckets.items()):
+        prefix = f'node="_cluster"' + ("," + rest if rest else "")
+        for le in sorted(les, key=le_order):
+            out.append(
+                f'{family}_bucket{{{prefix},le="{le}"}} {fmt(les[le])}'
+            )
+        for kind, store in (("sum", sums), ("count", counts)):
+            if (family, rest) in store:
+                out.append(
+                    f"{family}_{kind}{{{prefix}}} {fmt(store[(family, rest)])}"
+                )
     return out
 
 
@@ -438,6 +511,17 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ----------------------------------------------------------
 
+    def _int_query(self, key: str, default: int) -> int:
+        """Integer query param or a structured 400 — garbage in a debug
+        URL must not surface as a PANIC 500."""
+        raw = self.query.get(key)
+        if raw is None:
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            raise APIError(f"invalid {key}: {raw!r}") from None
+
     def _body(self) -> bytes:
         if getattr(self, "_chunked_body", None) is not None:
             return self._chunked_body  # decoded eagerly in parse_request
@@ -694,6 +778,7 @@ class _Handler(BaseHTTPRequestHandler):
         with profile_scope(
             index=index, query=query if isinstance(query, str) else ""
         ) as prof:
+            prof.remote = remote
             if accept == "application/x-protobuf":
                 try:
                     data = self.api.query_proto(index, query, **kw)
@@ -807,26 +892,97 @@ class _Handler(BaseHTTPRequestHandler):
         global_stats.gauge("tpu_stack_evictions", blocks.evictions)
         publish_hbm_gauges(blocks)
 
+    def _exposition_reply(self, text: str) -> None:
+        """Serve prometheus exposition, gating exemplars: the
+        `# {trace_id=...}` suffix is OpenMetrics syntax and a text-0.0.4
+        parser (stock Prometheus without exemplar scraping) reads the
+        token after the value as a timestamp and fails the WHOLE scrape.
+        Exemplars are kept only when the scraper opts in via
+        `?exemplars=1` (the internal federation scrape, curl). The
+        content type is always text-0.0.4 — never the OpenMetrics one an
+        Accept header may ask for, because this exposition is NOT valid
+        OpenMetrics (counter sample names carry the family's `_total`;
+        a strict OM parser rejects the whole scrape as a name clash) and
+        claiming the type would break exactly the scrapers it courts."""
+        if self.query.get("exemplars") not in ("1", "true"):
+            text = "\n".join(
+                l.partition(" # ")[0] for l in text.splitlines()
+            ) + "\n"
+        self._reply(text, content_type="text/plain; version=0.0.4")
+
     @route("GET", r"/metrics")
     def handle_metrics(self):
         from pilosa_tpu.utils.stats import global_stats
 
         self._refresh_device_gauges()
-        self._reply(global_stats.prometheus_text(), content_type="text/plain; version=0.0.4")
+        self._exposition_reply(global_stats.prometheus_text())
 
     @route("GET", r"/debug/queries")
     def handle_debug_queries(self):
         """Recent + in-flight queries with per-phase breakdowns (the ring
         behind pilosa_tpu/utils/qprofile.py). ?n bounds the recent list.
         The operator's first stop for 'why is THIS query slow': phases,
-        version-walk counters, and errors per query, newest first."""
+        version-walk counters, and errors per query, newest first. The
+        `latency` block puts each recent query IN CONTEXT: per-call
+        p50/p95/p99/p999 interpolated from the cumulative query_seconds
+        histogram — a 40 ms query next to a 4 ms p99 is the outlier, a
+        40 ms query next to a 38 ms p99 is the workload."""
         from pilosa_tpu.utils.qprofile import global_query_ring
+        from pilosa_tpu.utils.stats import (
+            QUANTILE_LABELS,
+            bucket_quantile,
+            global_stats,
+        )
 
-        n = int(self.query.get("n", "50"))
+        n = self._int_query("n", 50)
+        latency: dict[str, dict] = {}
+        for name, ent in global_stats.histogram_snapshot().items():
+            m = re.fullmatch(r'query_seconds\{call="([^"]+)"\}', name)
+            if m is None:
+                continue
+            row: dict = {"count": ent["count"]}
+            for label, q in QUANTILE_LABELS:
+                v = bucket_quantile(ent["buckets"], q)
+                row[label + "Ms"] = round(v * 1e3, 3) if v is not None else None
+            latency[m.group(1)] = row
         self._reply(
             {
                 "inflight": global_query_ring.inflight(),
                 "recent": global_query_ring.recent(n),
+                "latency": latency,
+            }
+        )
+
+    @route("GET", r"/debug/slo")
+    def handle_debug_slo(self):
+        """SLO compliance + multi-window burn rates (utils/monitor.py
+        evaluate_slos): per objective, the current windowed quantile vs
+        its threshold, the fast-5m/slow-1h burn-rate pair, and trace
+        exemplars from over-threshold buckets — each resolvable at
+        /debug/traces/<traceID>. Objectives come from the server config
+        (`slo = [{metric, quantile, threshold_s, window_s}]`); the
+        answer an operator needs is "p99 query latency SLO burning 4x",
+        not a page of raw series."""
+        from pilosa_tpu.utils.monitor import (
+            SLO_FAST_WINDOW,
+            SLO_SLOW_WINDOW,
+            RuntimeMonitor,
+        )
+
+        mon = getattr(self.api, "monitor", None)
+        if mon is None:
+            # Bare server (no CLI-started poller): a lazily attached,
+            # unstarted monitor still accrues windowed snapshots on
+            # every /debug/slo scrape, so burn windows fill with use.
+            mon = RuntimeMonitor(self.api.holder)
+            mon.slo = list(getattr(self.api, "slo", []) or [])
+            self.api.monitor = mon
+        objectives = mon.slo or list(getattr(self.api, "slo", []) or [])
+        self._reply(
+            {
+                "objectives": mon.evaluate_slos(objectives),
+                "fastWindowS": SLO_FAST_WINDOW,
+                "slowWindowS": SLO_SLOW_WINDOW,
             }
         )
 
@@ -851,7 +1007,7 @@ class _Handler(BaseHTTPRequestHandler):
         jaeger; an inspection endpoint keeps the seam observable here)."""
         from pilosa_tpu.utils.tracing import global_tracer
 
-        n = int(self.query.get("n", "50"))
+        n = self._int_query("n", 50)
         self._reply({"spans": global_tracer.recent(n)})
 
     @route("GET", r"/debug/pprof/profile")
@@ -859,9 +1015,17 @@ class _Handler(BaseHTTPRequestHandler):
         """Go-pprof-style CPU profile (VERDICT r3 #3): sample every
         thread's stack for ?seconds (default 10), return top-N frames by
         cumulative samples. Two HTTP calls max to a hot answer; see
-        utils/profiler.py for why sampling, not cProfile."""
-        seconds = min(float(self.query.get("seconds", "10")), 300.0)
-        top = int(self.query.get("top", "30"))
+        utils/profiler.py for why sampling, not cProfile. ?seconds is
+        hard-capped at 60 and non-numeric input is a 400 — before the
+        clamp, `seconds=86400` pinned a handler thread for a day and
+        garbage was a PANIC 500."""
+        raw = self.query.get("seconds", "10")
+        try:
+            seconds = float(raw)
+        except ValueError:
+            raise APIError(f"invalid seconds: {raw!r}") from None
+        seconds = min(max(seconds, 0.1), 60.0)
+        top = self._int_query("top", 30)
         rep = _profiler().profile(seconds, top)
         if "error" in rep:
             # A manual start/stop session is active: same 409 contract as
@@ -882,7 +1046,7 @@ class _Handler(BaseHTTPRequestHandler):
         if not _profiler().running:
             self._error("profiler not running", status=409)
             return
-        self._reply(_profiler().stop(int(self.query.get("top", "30"))))
+        self._reply(_profiler().stop(self._int_query("top", 30)))
 
     @route("GET", r"/debug/diagnostics")
     def handle_debug_diagnostics(self):
@@ -1058,6 +1222,7 @@ class _Handler(BaseHTTPRequestHandler):
             return global_stats.prometheus_text()
 
         out: list[str] = []
+        member_texts: list[str] = []
         for node_id, text, dt in self._fan_out_members(
             local_text, client.metrics_text
         ):
@@ -1068,13 +1233,18 @@ class _Handler(BaseHTTPRequestHandler):
                 global_stats.with_tags(f"node:{node_id}").count(
                     "cluster_scrape_failures_total"
                 )
+            member_texts.append(text)
             out.extend(_retag_prometheus(text, node_id))
             out.append(f'pilosa_cluster_scrape_up{{node="{node_id}"}} {up}')
             out.append(
                 f'pilosa_cluster_scrape_seconds{{node="{node_id}"}} {dt:.6f}'
             )
-        self._reply("\n".join(out) + "\n",
-                    content_type="text/plain; version=0.0.4")
+        # Cluster-wide latency distributions: member bucket vectors are
+        # additive (shared static boundaries), so the merged series'
+        # interpolated quantiles describe the pooled traffic — the
+        # statistic no arithmetic on per-node p99 series can recover.
+        out.extend(_merge_member_histograms(member_texts))
+        self._exposition_reply("\n".join(out) + "\n")
 
     @route("GET", r"/debug/cluster")
     def handle_debug_cluster(self):

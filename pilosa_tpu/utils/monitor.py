@@ -19,13 +19,45 @@ import os
 import platform
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from pilosa_tpu import __version__
-from pilosa_tpu.utils.stats import global_stats
+from pilosa_tpu.utils.stats import (
+    BUCKET_BOUNDS,
+    bucket_fraction_le,
+    bucket_quantile,
+    global_stats,
+    merge_buckets,
+    series_matches,
+)
 
 # Single source of process uptime for gauges AND /debug/diagnostics.
 PROCESS_STARTED_AT = time.time()
+
+#: Multi-window burn-rate horizons (the classic fast/slow alert pair):
+#: the fast window catches a sudden burn before it torches the budget,
+#: the slow window keeps a brief blip from paging anyone.
+SLO_FAST_WINDOW = 300.0
+SLO_SLOW_WINDOW = 3600.0
+
+#: Windowed-snapshot housekeeping: at most one retained snapshot per
+#: _SNAP_MIN_INTERVAL (the poll loop runs every 10 s; finer grain buys
+#: nothing a 5 m window can see). Retention covers the LARGEST window
+#: any objective names (never less than the slow burn window) plus
+#: slack — a 4 h compliance window must find a 4 h-old baseline, not
+#: be silently truncated to the 1 h default.
+_SNAP_MIN_INTERVAL = 15.0
+_SNAP_RETENTION_SLACK = 120.0
+
+#: Histogram families always retained in the window ring even with no
+#: objective configured, so /debug/slo answers immediately after an
+#: objective is added instead of starting blind.
+_DEFAULT_SLO_FAMILIES = (
+    "query_seconds",
+    "http_request_duration_seconds",
+    "peer_rpc_seconds",
+)
 
 
 def publish_hbm_gauges(blocks, stats=None) -> None:
@@ -70,12 +102,195 @@ class RuntimeMonitor:
         self.backend = backend
         self.interval = interval
         self.started_at = PROCESS_STARTED_AT
+        #: SLO objectives ([{metric, quantile, threshold_s, window_s}]),
+        #: wired from server/config.py `slo` by the CLI; evaluated by
+        #: /debug/slo against the windowed snapshots below.
+        self.slo: list[dict] = []
+        # (unix time, {series name: bucket tuple}) ring — the windowed
+        # bucket snapshots burn-rate math diffs. Only latency families
+        # an objective can name are retained (cardinality bound).
+        self._hist_snaps: deque = deque()
+        self._snap_lock = threading.Lock()
         self._seen_indexes: set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    # -- SLO windowed snapshots + burn rates -------------------------------
+
+    def _slo_families(self) -> tuple[str, ...]:
+        extra = tuple(
+            str(o.get("metric", "")).split("{", 1)[0]
+            for o in self.slo
+            if o.get("metric")
+        )
+        return _DEFAULT_SLO_FAMILIES + extra
+
+    _series_matches = staticmethod(series_matches)
+
+    def record_histogram_snapshot(self, snap: Optional[dict] = None,
+                                  force: bool = False) -> None:
+        """Retain the current bucket vectors of every SLO-relevant
+        series. Called from the poll loop AND from /debug/slo scrapes,
+        so windows accrue even on a server without the poller thread."""
+        now = time.time()
+        with self._snap_lock:
+            if (
+                not force
+                and self._hist_snaps
+                and now - self._hist_snaps[-1][0] < _SNAP_MIN_INTERVAL
+            ):
+                # Gate BEFORE copying the registry: the poll loop runs
+                # every 10 s against a 15 s min interval, so without
+                # this early exit roughly every other poll would deep-
+                # copy every timing series only to throw the copy away.
+                return
+        families = self._slo_families()
+        if snap is None:
+            snap = global_stats.histogram_snapshot()
+        keep = {
+            name: tuple(ent["buckets"])
+            for name, ent in snap.items()
+            if any(self._series_matches(name, f) for f in families)
+        }
+        retention = max(
+            [SLO_SLOW_WINDOW]
+            + [float(o.get("window_s", 0) or 0) for o in self.slo]
+        ) + _SNAP_RETENTION_SLACK
+        with self._snap_lock:
+            if (
+                not force
+                and self._hist_snaps
+                and now - self._hist_snaps[-1][0] < _SNAP_MIN_INTERVAL
+            ):
+                return
+            self._hist_snaps.append((now, keep))
+            while self._hist_snaps and now - self._hist_snaps[0][0] > retention:
+                self._hist_snaps.popleft()
+
+    def _window_counts(self, metric: str, window_s: float,
+                       now_snap: dict) -> tuple[list[float], float]:
+        """(per-bucket observation counts within the trailing window,
+        actual seconds the window covers). The baseline is the newest
+        retained snapshot at least window_s old; a younger monitor
+        truncates the window to what it has actually seen — reported,
+        never silently widened."""
+        now = time.time()
+        current: Optional[list[float]] = None
+        for name, ent in now_snap.items():
+            if self._series_matches(name, metric):
+                b = ent["buckets"] if isinstance(ent, dict) else ent
+                current = list(b) if current is None else merge_buckets(current, b)
+        if current is None:
+            return [0.0] * (len(BUCKET_BOUNDS) + 1), 0.0
+        base: Optional[dict] = None
+        base_ts = None
+        with self._snap_lock:
+            for ts, keep in self._hist_snaps:
+                if now - ts >= window_s:
+                    base, base_ts = keep, ts
+                else:
+                    break
+            if base is None and self._hist_snaps:
+                base_ts, base = self._hist_snaps[0]
+        if base is None:
+            return current, now - self.started_at
+        base_counts: Optional[list[float]] = None
+        for name, b in base.items():
+            if self._series_matches(name, metric):
+                base_counts = (
+                    list(b) if base_counts is None
+                    else merge_buckets(base_counts, b)
+                )
+        if base_counts is None:
+            return current, now - base_ts
+        delta = [max(0.0, c - b) for c, b in zip(current, base_counts)]
+        return delta, now - base_ts
+
+    def evaluate_slos(self, objectives: Optional[list[dict]] = None) -> list[dict]:
+        """Current compliance + multi-window burn rate per objective —
+        the payload behind /debug/slo. Burn rate is the rate the error
+        budget is being spent: (share of observations over threshold) /
+        (1 - quantile); 1.0 burns the whole budget exactly over the
+        objective window, 4x torches it in a quarter of it. An
+        objective is `burning` only when BOTH the fast (5 m) and slow
+        (1 h) windows burn >1 — the standard multi-window rule that
+        suppresses both ancient history and sub-minute blips."""
+        objs = objectives if objectives is not None else self.slo
+        now_snap = global_stats.histogram_snapshot()
+        out = []
+        for o in objs:
+            metric = str(o.get("metric", ""))
+            q = float(o.get("quantile", 0.99))
+            thr = float(o.get("threshold_s", 1.0))
+            win = float(o.get("window_s", SLO_SLOW_WINDOW))
+            budget = max(1e-9, 1.0 - q)
+            ent: dict = {
+                "metric": metric,
+                "quantile": q,
+                "thresholdS": thr,
+                "windowS": win,
+                "errorBudget": budget,
+            }
+            counts, span = self._window_counts(metric, win, now_snap)
+            total = sum(counts)
+            qv = bucket_quantile(counts, q)
+            ent["observations"] = int(total)
+            ent["windowCoveredS"] = round(span, 1)
+            ent["currentQuantileS"] = (
+                round(qv, 6) if qv is not None else None
+            )
+            ent["compliant"] = qv is None or qv <= thr
+            for label, w in (("fast", SLO_FAST_WINDOW), ("slow", SLO_SLOW_WINDOW)):
+                wc, wspan = self._window_counts(metric, w, now_snap)
+                frac = bucket_fraction_le(wc, thr)
+                viol = None if frac is None else max(0.0, 1.0 - frac)
+                ent[f"burnRate_{label}"] = (
+                    None if viol is None else round(viol / budget, 3)
+                )
+                ent[f"violationShare_{label}"] = (
+                    None if viol is None else round(viol, 6)
+                )
+                ent[f"windowCoveredS_{label}"] = round(wspan, 1)
+            ent["burning"] = bool(
+                (ent["burnRate_fast"] or 0) > 1.0
+                and (ent["burnRate_slow"] or 0) > 1.0
+            )
+            # Trace exemplars from over-threshold buckets, newest first:
+            # the direct link from "this objective is burning" to
+            # /debug/traces/<id> of a query that burned it. Exemplars
+            # older than the objective window are dropped — cumulative
+            # buckets remember yesterday's outage forever, and pointing
+            # an operator at a long-evicted trace as evidence for a
+            # CURRENT burn is worse than no exemplar at all.
+            now = time.time()
+            exemplars = []
+            for name, se in now_snap.items():
+                if not self._series_matches(name, metric):
+                    continue
+                for ex in se.get("exemplars", ()):
+                    if ex["value"] > thr and now - ex["time"] <= win:
+                        exemplars.append(
+                            {
+                                "traceID": ex["trace_id"],
+                                "valueS": round(ex["value"], 6),
+                                "ageS": round(now - ex["time"], 1),
+                                "series": name,
+                            }
+                        )
+            exemplars.sort(key=lambda e: e["ageS"])
+            ent["exemplars"] = exemplars[:5]
+            out.append(ent)
+        # Retain the snapshot AFTER evaluating: on a poller-less server
+        # the very first scrape then falls back to cumulative-since-boot
+        # (windowCoveredS = uptime, honestly reported) instead of
+        # diffing the just-taken snapshot against itself and answering
+        # "0 observations" over hours of history.
+        self.record_histogram_snapshot(now_snap)
+        return out
+
     def poll_once(self) -> None:
         s = global_stats
+        self.record_histogram_snapshot()
         s.gauge("runtime_rss_bytes", _rss_bytes())
         s.gauge("runtime_threads", threading.active_count())
         s.gauge("runtime_open_fds", _open_fds())

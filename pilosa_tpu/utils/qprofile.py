@@ -68,7 +68,7 @@ class QueryProfile:
 
     __slots__ = (
         "qid", "index", "query", "call", "started_at", "_t0",
-        "phases", "counters", "error", "duration",
+        "phases", "counters", "error", "duration", "remote",
     )
 
     def __init__(self, index: str = "", query: str = "", call: str = ""):
@@ -78,6 +78,10 @@ class QueryProfile:
         # Set batches) would pin MBs per slot.
         self.query = query[:200]
         self.call = call
+        # True when this execution is a coordinator-dispatched peer leg
+        # (?remote=true): its phases still attribute, but it must NOT
+        # feed the whole-query latency series (see _export).
+        self.remote = False
         self.started_at = time.time()
         self._t0 = time.perf_counter()
         self.phases: dict[str, float] = {}
@@ -265,6 +269,18 @@ class profile_scope:
         from pilosa_tpu.utils.stats import global_stats
 
         call = p.call or "?"
+        # Whole-query latency distribution per call type: the series SLO
+        # objectives and /debug/queries quantiles read. Phases attribute
+        # WHERE time went; this one answers "what is the p99" — a
+        # question the per-phase series cannot (phases of one query land
+        # in different buckets). Remote peer legs are excluded: one
+        # distributed query must be ONE observation in the cluster-merged
+        # distribution (the coordinator's, which is what the user felt),
+        # not one per participating node diluted by fast leg samples.
+        if p.duration is not None and not p.remote:
+            global_stats.with_tags(f"call:{call}").timing(
+                "query_seconds", p.duration
+            )
         for name, secs in p.phases.items():
             global_stats.with_tags(f"call:{call}", f"phase:{name}").timing(
                 "query_phase_seconds", secs

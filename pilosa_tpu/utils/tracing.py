@@ -199,3 +199,17 @@ class NopTracer:
 
 
 global_tracer = Tracer()
+
+
+def _current_trace_id() -> Optional[str]:
+    """The active thread's trace id, if a span is open — the exemplar
+    hook stats.timing() consults so a histogram bucket can remember
+    which trace put an observation there. Registered as a provider
+    (stats cannot import tracing: tracing imports stats)."""
+    span = global_tracer.active_span()
+    return span.trace_id if span is not None else None
+
+
+from pilosa_tpu.utils import stats as _stats  # noqa: E402
+
+_stats.set_exemplar_provider(_current_trace_id)

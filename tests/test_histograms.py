@@ -1,0 +1,449 @@
+"""Round-10 latency-distribution plane (ISSUE r10): fixed-boundary
+mergeable histograms, cluster-merged quantiles from /metrics/cluster,
+SLO burn rates at /debug/slo, and trace exemplars linking a burning
+bucket to /debug/traces/<id>."""
+
+import json
+import random
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.stats import (
+    BUCKET_BOUNDS,
+    BUCKET_RATIO,
+    StatsClient,
+    bucket_fraction_le,
+    bucket_index,
+    bucket_quantile,
+    global_stats,
+    merge_buckets,
+)
+from pilosa_tpu.utils.tracing import Tracer, global_tracer
+from tests.cluster_harness import FaultProxy, RewriteClient, TestCluster
+
+
+def _get_json(uri: str, path: str) -> dict:
+    with urllib.request.urlopen(uri + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _get_text(uri: str, path: str) -> str:
+    with urllib.request.urlopen(uri + path, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _exact_quantile(samples: list, q: float) -> float:
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(len(s) * q))]
+
+
+def _within_one_bucket(estimated: float, exact: float) -> bool:
+    """The histogram promise: an interpolated quantile lands in the
+    exact value's bucket or an adjacent one."""
+    return abs(bucket_index(estimated) - bucket_index(exact)) <= 1
+
+
+class TestHistogramCore:
+    def test_buckets_cumulative_monotonic_and_exact_count_sum(self):
+        s = StatsClient()
+        random.seed(7)
+        samples = [random.lognormvariate(-5, 1.5) for _ in range(500)]
+        for v in samples:
+            s.timing("probe_seconds", v)
+        text = s.prometheus_text()
+        assert "# TYPE pilosa_probe_seconds histogram" in text
+        assert "# HELP pilosa_probe_seconds" in text
+        cums = []
+        for line in text.splitlines():
+            if line.startswith("pilosa_probe_seconds_bucket"):
+                cums.append(float(line.partition(" # ")[0].rsplit(" ", 1)[1]))
+        assert len(cums) == len(BUCKET_BOUNDS) + 1  # 31 finite + +Inf
+        assert cums == sorted(cums), "bucket counts must be cumulative"
+        assert cums[-1] == len(samples)
+        snap = s.snapshot()["timings"]["probe_seconds"]
+        assert snap["count"] == len(samples)
+        assert snap["sum"] == pytest.approx(sum(samples))
+
+    def test_series_never_vanishes_under_heavy_traffic(self):
+        """Ring-trim regression (ISSUE r10 satellite): the old 1024-ring
+        trimmed half its samples mid-stream; a series that drained
+        vanished from export and broke rate() continuity. Buckets are
+        cumulative: 5000 observations stay 5000."""
+        s = StatsClient()
+        for _ in range(5000):
+            s.timing("busy_seconds", 0.002)
+        assert "pilosa_busy_seconds_count 5000" in s.prometheus_text()
+        assert s.snapshot()["timings"]["busy_seconds"]["count"] == 5000
+
+    def test_quantiles_unbiased_by_recency(self):
+        """The old ring kept only the newest 1024 samples, so a burst of
+        slow queries owned the p50 regardless of the day's traffic. The
+        cumulative histogram weighs every observation once."""
+        s = StatsClient()
+        for _ in range(2000):
+            s.timing("mixed_seconds", 0.001)
+        for _ in range(20):
+            s.timing("mixed_seconds", 1.0)
+        snap = s.snapshot()["timings"]["mixed_seconds"]
+        assert snap["p50"] < 0.01  # 2000/2020 observations are ~1 ms
+        assert snap["p999"] > 0.1  # but the slow tail is still visible
+
+    def test_quantile_interpolation_vs_exact_known_samples(self):
+        s = StatsClient()
+        random.seed(42)
+        samples = [random.lognormvariate(-4, 1.0) for _ in range(4000)]
+        for v in samples:
+            s.timing("known_seconds", v)
+        snap = s.snapshot()["timings"]["known_seconds"]
+        for label, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99),
+                         ("p999", 0.999)):
+            exact = _exact_quantile(samples, q)
+            est = snap[label]
+            assert _within_one_bucket(est, exact), (label, est, exact)
+            # And never off by more than one bucket's multiplicative
+            # width squared (adjacent-bucket worst case).
+            assert exact / BUCKET_RATIO**2 <= est <= exact * BUCKET_RATIO**2
+
+    def test_merge_commutative_and_associative(self):
+        random.seed(3)
+        n = len(BUCKET_BOUNDS) + 1
+        a = [random.randrange(50) for _ in range(n)]
+        b = [random.randrange(50) for _ in range(n)]
+        c = [random.randrange(50) for _ in range(n)]
+        assert merge_buckets(a, b) == merge_buckets(b, a)
+        assert merge_buckets(merge_buckets(a, b), c) == merge_buckets(
+            a, merge_buckets(b, c)
+        )
+        # Quantiles of a merge are quantiles of the pooled population.
+        pooled = merge_buckets(a, b)
+        assert sum(pooled) == sum(a) + sum(b)
+
+    def test_exposition_merge_matches_pooled_quantile(self):
+        """The exposition-level merge (/metrics/cluster's helper) must
+        agree with the pooled sample set within one bucket width, and be
+        order-independent."""
+        from pilosa_tpu.server.http import _merge_member_histograms
+
+        na, nb = StatsClient(), StatsClient()
+        random.seed(9)
+        # Unequal counts so no tested rank lands exactly on the empty
+        # gap between the modes (there the CDF is flat and any value
+        # across the gap is an equally valid quantile).
+        sa = [random.uniform(0.0005, 0.005) for _ in range(700)]
+        sb = [random.uniform(0.02, 0.4) for _ in range(900)]
+        for v in sa:
+            na.timing("pool_seconds", v)
+        for v in sb:
+            nb.timing("pool_seconds", v)
+        ta, tb = na.prometheus_text(), nb.prometheus_text()
+        merged = _merge_member_histograms([ta, tb])
+        assert merged == _merge_member_histograms([tb, ta])
+        counts = _bucket_counts(merged, "pilosa_pool_seconds")
+        assert sum(counts) == len(sa) + len(sb)
+        for q in (0.5, 0.99):
+            est = bucket_quantile(counts, q)
+            exact = _exact_quantile(sa + sb, q)
+            assert _within_one_bucket(est, exact), (q, est, exact)
+
+    def test_fraction_le_interpolation(self):
+        counts = [0] * (len(BUCKET_BOUNDS) + 1)
+        # 100 observations uniform inside the bucket that contains 0.01
+        i = bucket_index(0.01)
+        counts[i] = 100
+        lo = BUCKET_BOUNDS[i - 1]
+        hi = BUCKET_BOUNDS[i]
+        mid = (lo + hi) / 2
+        frac = bucket_fraction_le(counts, mid)
+        assert frac == pytest.approx(0.5, abs=0.01)
+        assert bucket_fraction_le(counts, BUCKET_BOUNDS[-1]) == 1.0
+        assert bucket_fraction_le([0] * len(counts), 1.0) is None
+
+    def test_remote_leg_excluded_from_query_seconds(self):
+        """A coordinator-dispatched peer leg (?remote=true) must not
+        feed the whole-query latency series: one distributed query is
+        ONE observation in the cluster-merged distribution, not one per
+        participating node."""
+        from pilosa_tpu.utils.qprofile import profile_scope
+
+        def count_for(call):
+            snap = global_stats.histogram_snapshot()
+            ent = snap.get(f'query_seconds{{call="{call}"}}')
+            return ent["count"] if ent else 0
+
+        with profile_scope(index="i", call="RemoteLeg") as prof:
+            prof.remote = True
+        assert count_for("RemoteLeg") == 0
+        with profile_scope(index="i", call="LocalQuery"):
+            pass
+        assert count_for("LocalQuery") == 1
+
+    def test_exemplar_recorded_under_active_trace_only(self):
+        s = StatsClient()
+        s.timing("exm_seconds", 0.003)  # no active span: no exemplar
+        assert "trace_id" not in s.prometheus_text()
+        span = global_tracer.start_span("exemplar-test")
+        s.timing("exm_seconds", 0.004)
+        span.finish()
+        text = s.prometheus_text()
+        m = re.search(r'# \{trace_id="([0-9a-f]+)"\} 0\.004', text)
+        assert m, text
+        assert m.group(1) == span.trace_id
+
+
+def _bucket_counts(lines, family_prefix: str) -> list:
+    """Per-bucket (non-cumulative) counts from exposition _bucket lines."""
+    cums = []
+    for line in lines:
+        if line.startswith(family_prefix + "_bucket"):
+            cums.append(float(line.partition(" # ")[0].rsplit(" ", 1)[1]))
+    return [cums[0]] + [cums[i] - cums[i - 1] for i in range(1, len(cums))]
+
+
+class TestSloEvaluation:
+    def _monitor(self, slo):
+        from pilosa_tpu.utils.monitor import RuntimeMonitor
+
+        mon = RuntimeMonitor()
+        mon.slo = slo
+        mon.record_histogram_snapshot(force=True)  # leg-start baseline
+        return mon
+
+    def test_burning_objective_reports_multi_window_burn(self):
+        mon = self._monitor(
+            [{"metric": "slo_burn_seconds", "quantile": 0.9,
+              "threshold_s": 0.01, "window_s": 60.0}]
+        )
+        for _ in range(40):
+            global_stats.timing("slo_burn_seconds", 0.2)  # all violations
+        (o,) = mon.evaluate_slos()
+        assert o["compliant"] is False
+        assert o["observations"] == 40
+        # 100% violations against a 10% budget: burn rate 10x.
+        assert o["burnRate_fast"] == pytest.approx(10.0, rel=0.01)
+        assert o["burnRate_slow"] == pytest.approx(10.0, rel=0.01)
+        assert o["burning"] is True
+
+    def test_compliant_objective_not_burning(self):
+        mon = self._monitor(
+            [{"metric": "slo_ok_seconds", "quantile": 0.99,
+              "threshold_s": 0.5, "window_s": 60.0}]
+        )
+        for _ in range(40):
+            global_stats.timing("slo_ok_seconds", 0.001)
+        (o,) = mon.evaluate_slos()
+        assert o["compliant"] is True
+        assert o["burnRate_fast"] == pytest.approx(0.0, abs=1e-6)
+        assert o["burning"] is False
+
+    def test_no_observations_is_compliant_not_crash(self):
+        mon = self._monitor(
+            [{"metric": "slo_absent_seconds", "quantile": 0.99,
+              "threshold_s": 0.1, "window_s": 60.0}]
+        )
+        (o,) = mon.evaluate_slos()
+        assert o["compliant"] is True
+        assert o["currentQuantileS"] is None
+        assert o["observations"] == 0
+
+    def test_windowed_delta_excludes_pre_window_traffic(self):
+        """The burn calculation must diff against the baseline snapshot,
+        not read the cumulative series — yesterday's outage is not
+        today's burn."""
+        for _ in range(100):
+            global_stats.timing("slo_hist_seconds", 0.5)  # "yesterday"
+        mon = self._monitor(
+            [{"metric": "slo_hist_seconds", "quantile": 0.9,
+              "threshold_s": 0.01, "window_s": 60.0}]
+        )
+        for _ in range(10):
+            global_stats.timing("slo_hist_seconds", 0.001)  # healthy now
+        (o,) = mon.evaluate_slos()
+        assert o["observations"] == 10
+        assert o["compliant"] is True
+        assert o["burnRate_fast"] == pytest.approx(0.0, abs=1e-6)
+
+
+class TestSloConfigValidation:
+    def test_normalize_rejects_out_of_range_objectives(self):
+        """`quantile = 99` (the percent-vs-fraction typo) must fail
+        config load, not page forever with a ~1e9 burn rate."""
+        pytest.importorskip("tomllib")
+        from pilosa_tpu.server.config import Config
+
+        ok = Config._normalize_slo(
+            [{"metric": "query_seconds", "quantile": 0.99,
+              "threshold": 0.5, "window": 600}]
+        )
+        assert ok == [{"metric": "query_seconds", "quantile": 0.99,
+                       "threshold_s": 0.5, "window_s": 600.0}]
+        for bad in (
+            [{"metric": "m", "quantile": 99}],
+            [{"metric": "m", "quantile": 0.0}],
+            [{"metric": "m", "threshold": 0}],
+            # Past the top finite bucket bound the CDF reads every +Inf
+            # observation as compliant: the objective could never page.
+            [{"metric": "m", "threshold": BUCKET_BOUNDS[-1] * 2}],
+            [{"metric": "m", "window": -1}],
+            [{"quantile": 0.99}],
+        ):
+            with pytest.raises(ValueError):
+                Config._normalize_slo(bad)
+
+
+class TestHttpSurfaces:
+    @pytest.fixture()
+    def cluster1(self):
+        with TestCluster(1) as tc:
+            yield tc
+
+    def test_metrics_exposes_histogram_families(self, cluster1):
+        uri = str(cluster1[0].node.uri)
+        cluster1.create_index("h1")
+        cluster1.create_field("h1", "f")
+        cluster1.query(0, "h1", "Set(1, f=0)")
+        cluster1.query(0, "h1", "Count(Row(f=0))")
+        _get_json(uri, "/status")
+        text = _get_text(uri, "/metrics")
+        for family in (
+            "pilosa_query_phase_seconds",
+            "pilosa_http_request_duration_seconds",
+        ):
+            assert f"# TYPE {family} histogram" in text
+            assert f"# HELP {family}" in text
+            assert f'{family}_bucket{{' in text
+            assert re.search(rf'{family}_bucket{{[^}}]*le="\+Inf"}}', text)
+            assert f"{family}_sum{{" in text
+            assert f"{family}_count{{" in text
+
+    def test_debug_queries_latency_block(self, cluster1):
+        uri = str(cluster1[0].node.uri)
+        cluster1.create_index("h2")
+        cluster1.create_field("h2", "f")
+        # Through the HTTP surface so the profile opens at ingress.
+        req = urllib.request.Request(
+            uri + "/index/h2/query", data=b"Count(Row(f=0))", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+        out = _get_json(uri, "/debug/queries")
+        assert "latency" in out
+        assert "Count" in out["latency"], out["latency"]
+        row = out["latency"]["Count"]
+        assert row["count"] >= 1
+        assert row["p50Ms"] is not None
+        assert set(row) >= {"count", "p50Ms", "p95Ms", "p99Ms", "p999Ms"}
+
+    def test_pprof_seconds_validated_and_capped(self, cluster1):
+        uri = str(cluster1[0].node.uri)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(uri, "/debug/pprof/profile?seconds=abc")
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(uri, "/debug/pprof/profile?top=xyz&seconds=0.1")
+        assert ei.value.code == 400
+        # The clamp itself: the handler must floor/cap BEFORE profiling;
+        # a 0-second request still returns a report instantly.
+        out = _get_json(uri, "/debug/pprof/profile?seconds=0&top=3")
+        assert "frames" in out or "samples" in out or isinstance(out, dict)
+
+    def test_debug_slo_empty_without_objectives(self, cluster1):
+        uri = str(cluster1[0].node.uri)
+        out = _get_json(uri, "/debug/slo")
+        assert out["objectives"] == []
+        assert out["fastWindowS"] == 300.0
+        assert out["slowWindowS"] == 3600.0
+
+
+class TestClusterAcceptance:
+    def test_cluster_merged_p99_matches_pooled_observations(self):
+        """ISSUE r10 acceptance: /metrics/cluster's merged buckets'
+        interpolated p99 matches the pooled two-node observation
+        quantile within one bucket width."""
+        random.seed(11)
+        samples = [random.lognormvariate(-4, 1.3) for _ in range(1500)]
+        with TestCluster(2) as tc:
+            for v in samples:
+                global_stats.timing("pooled_acc_seconds", v)
+            text = _get_text(str(tc[0].node.uri), "/metrics/cluster")
+            merged_lines = [
+                l for l in text.splitlines() if 'node="_cluster"' in l
+            ]
+            assert merged_lines, "no merged cluster histograms emitted"
+            counts = _bucket_counts(merged_lines, "pilosa_pooled_acc_seconds")
+            # In-process harness nodes share one registry, so the merge
+            # pools two identical member vectors — quantiles unchanged.
+            assert sum(counts) == 2 * len(samples)
+            est99 = bucket_quantile(counts, 0.99)
+            exact99 = _exact_quantile(samples + samples, 0.99)
+            assert _within_one_bucket(est99, exact99), (est99, exact99)
+            # Per-node series survive next to the merged ones.
+            assert re.search(
+                r'pilosa_pooled_acc_seconds_bucket\{node="node0"', text
+            )
+
+    @pytest.mark.chaos
+    def test_slo_flags_injected_latency_burn_with_resolvable_exemplar(self):
+        """ISSUE r10 acceptance: a FaultProxy-injected peer latency burn
+        shows up at /debug/slo as a burning objective whose exemplar
+        trace id resolves through /debug/traces/<id>."""
+        from pilosa_tpu.utils.monitor import RuntimeMonitor
+
+        with TestCluster(2) as tc:
+            tc.create_index("slo")
+            tc.create_field("slo", "f")
+            topo = tc[0].cluster.topology
+            remote_shards = [
+                s for s in range(32)
+                if topo.shard_nodes("slo", s)[0].id == "node1"
+            ][:2]
+            assert remote_shards, "need a shard primaried on node1"
+            stmts = " ".join(
+                f"Set({s * SHARD_WIDTH + 3}, f=1)" for s in remote_shards
+            )
+            tc.query(0, "slo", stmts)
+            tc.await_shard_convergence("slo")
+
+            target = tc[0].cluster.topology.node_by_id("node1").uri
+            proxy = FaultProxy(target.host, target.port)
+            proxy.mode = "latency"
+            proxy.latency_s = 0.25
+            rc = RewriteClient(
+                {f"{target.host}:{target.port}": f"127.0.0.1:{proxy.port}"},
+                timeout=5.0,
+            )
+            tc[0].cluster.client = rc
+
+            mon = RuntimeMonitor(tc[0].holder)
+            mon.slo = [
+                {"metric": "peer_rpc_seconds", "quantile": 0.5,
+                 "threshold_s": 0.05, "window_s": 300.0}
+            ]
+            mon.record_histogram_snapshot(force=True)
+            tc[0].api.monitor = mon
+            uri = str(tc[0].node.uri)
+            try:
+                for _ in range(3):
+                    req = urllib.request.Request(
+                        uri + "/index/slo/query",
+                        data=b"Count(Row(f=1))",
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        out = json.loads(resp.read())
+                    assert out["results"][0] == len(remote_shards)
+                slo = _get_json(uri, "/debug/slo")
+            finally:
+                proxy.close()
+            (o,) = slo["objectives"]
+            assert o["compliant"] is False, o
+            assert o["burnRate_fast"] > 1.0, o
+            assert o["burning"] is True, o
+            assert o["exemplars"], "latency burn recorded no trace exemplar"
+            trace_id = o["exemplars"][0]["traceID"]
+            tree = _get_json(uri, f"/debug/traces/{trace_id}")
+            assert tree["traceID"] == trace_id
+            assert tree["spanCount"] >= 1, tree

@@ -11,10 +11,12 @@ DYNAMIC_FAMILIES with the doc spelling that covers them.
 
 Docs side: backticked tokens in docs/observability.md whose shape is a
 metric name (optionally pilosa_-prefixed, optional {tags}, optional
-exporter suffix _count/_sum/_p50/_p99) AND that end in one of the metric
-suffixes below — bench JSON keys, env knobs, and file names in the same
-docs do not match. A doc token `prefix_*` is a wildcard covering every
-source name that starts with `prefix_`.
+histogram/exporter suffix _bucket/_count/_sum/_p50/_p95/_p99/_p999 — a
+histogram family's three exposition series collapse to ONE documented
+name) AND that end in one of the metric suffixes below — bench JSON
+keys, env knobs, and file names in the same docs do not match. A doc
+token `prefix_*` is a wildcard covering every source name that starts
+with `prefix_`.
 
 Exit 0 clean; exit 1 with a report of both drift directions.
 """
@@ -38,8 +40,9 @@ DYNAMIC_FAMILIES = {
 }
 
 #: A doc token must end in one of these to be treated as a metric name
-#: (after stripping the exporter suffixes _count/_sum/_p50/_p99, so a
-#: plain-JSON field like `device_count` does not match).
+#: (after stripping the histogram/exporter suffixes _bucket/_count/_sum/
+#: _p50/_p95/_p99/_p999, so a plain-JSON field like `device_count` does
+#: not match).
 METRIC_SUFFIXES = (
     "_total", "_seconds", "_bytes", "_pending", "_done",
     "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
@@ -54,7 +57,7 @@ _CALL_RE = re.compile(
 
 _TOKEN_RE = re.compile(r"`([^`\n]+)`")
 
-_EXPORT_SUFFIX_RE = re.compile(r"_(?:count|sum|p50|p99)$")
+_EXPORT_SUFFIX_RE = re.compile(r"_(?:bucket|count|sum|p50|p95|p99|p999)$")
 
 
 #: Series synthesized as literal exposition lines (no StatsClient call):
