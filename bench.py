@@ -66,12 +66,25 @@ Round-9 leg (ISSUE r9):
   deltas that attribute how the window survived (every degraded
   response still the correct non-partial count, inside a 2 s budget).
 
+Round-11 legs (ISSUE r11):
+- concurrency_sweep: served qps at {1,16,64,256} concurrent clients
+  through the HTTP surface with the unified shard-leg batcher
+  (exec/batcher.py), each window its own checkpoint (qps@N) whose
+  leg_metrics delta carries batch_legs/coalesced vs device_launches —
+  the proof one launch answers many in-flight queries — plus the mean
+  batch occupancy and server-side request quantiles per window.
+- Client hardening: BenchConn retries are BOUNDED reconnect-and-retry
+  (BENCH_CLIENT_RETRIES, default 3) + Retry-After-honoring 429 handling;
+  a client that exhausts its budget counts a client_abort and retires
+  without killing the pool.map leg (the BENCH_r05 crash class).
+
 Env knobs: BENCH_SHARDS (default 954 = 1B cols), BENCH_ROWS (8),
 BENCH_DENSITY (0.05), BENCH_BATCH (256), BENCH_SECONDS (10),
 BENCH_LATENCY_N (30), BENCH_HTTP_CLIENTS (16),
 BENCH_HTTP_QUERIES_PER_REQ (16), BENCH_WRITE_RATES ("0,1,10,100"),
 BENCH_CHURN_SECONDS (8), BENCH_WARM_TIMEOUT (600),
-BENCH_DEGRADED_SECONDS (3), BENCH_PARTIAL_PATH (BENCH_partial.json).
+BENCH_DEGRADED_SECONDS (3), BENCH_CONCURRENCY ("1,16,64,256"),
+BENCH_CLIENT_RETRIES (3), BENCH_PARTIAL_PATH (BENCH_partial.json).
 """
 
 import concurrent.futures
@@ -90,7 +103,7 @@ import numpy as np
 
 from pilosa_tpu.core import Holder
 from pilosa_tpu.exec import Executor
-from pilosa_tpu.exec.batcher import CountBatcher
+from pilosa_tpu.exec.batcher import ShardLegBatcher
 from pilosa_tpu.pql import parse_string
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.utils.stats import global_stats
@@ -113,6 +126,11 @@ WRITE_RATES = [
 CHURN_SECONDS = float(os.environ.get("BENCH_CHURN_SECONDS", "8"))
 WARM_TIMEOUT = float(os.environ.get("BENCH_WARM_TIMEOUT", "600"))
 DEGRADED_SECONDS = float(os.environ.get("BENCH_DEGRADED_SECONDS", "3"))
+# Concurrency-sweep client counts (ISSUE r11): 1 anchors the scaling
+# ratio the acceptance gate reads (qps@64 >= 5x qps@1).
+CONCURRENCY = [
+    int(c) for c in os.environ.get("BENCH_CONCURRENCY", "1,16,64,256").split(",")
+]
 
 WORDS = SHARD_WIDTH // 32
 
@@ -122,16 +140,33 @@ PARTIAL_PATH = os.environ.get(
 )
 
 _RETRY_LOCK = threading.Lock()
-RETRIES = {"post": 0, "get": 0}
+RETRIES = {"post": 0, "get": 0, "shed": 0, "abort": 0}
+
+
+def _count_retry(kind: str, n: int = 1) -> None:
+    with _RETRY_LOCK:
+        RETRIES[kind] += n
+
+
+class _Overloaded(Exception):
+    """Server shed the request (429 + code=overloaded): retryable by
+    contract after Retry-After, never a client abort."""
+
+    def __init__(self, retry_after: float):
+        super().__init__("overloaded")
+        self.retry_after = retry_after
 
 
 class BenchConn:
-    """Keep-alive HTTP client with capture-proof retry (VERDICT r5
-    next-round #1a): ONE transient reset (listen-backlog overflow, a
-    keep-alive connection the server closed under us) reconnects and
-    retries instead of killing the whole bench run; retries are counted
-    into the output JSON so a flaky window is visible, and a SECOND
-    consecutive failure propagates — systemic failure must stay loud."""
+    """Keep-alive HTTP client with capture-proof BOUNDED reconnect-and-
+    retry (ISSUE r11 satellite; r5's one-shot retry zeroed BENCH_r05 when
+    the second reset landed): each request survives up to MAX_RETRIES
+    transient resets (listen-backlog overflow, a keep-alive connection
+    the server closed under us) by reconnecting, and up to MAX_SHED
+    deliberate 429 sheds by honoring Retry-After. Every retry is counted
+    into the output JSON (client_retries / per-kind breakdown) so a flaky
+    window is visible; exhausting the budget propagates — systemic
+    failure must stay loud (the caller counts it as a client_abort)."""
 
     TRANSIENT = (
         ConnectionResetError,
@@ -142,19 +177,37 @@ class BenchConn:
         http.client.ResponseNotReady,
     )
 
+    MAX_RETRIES = int(os.environ.get("BENCH_CLIENT_RETRIES", "3"))
+    MAX_SHED = 20  # 429s are cheap and clear fast; bound them separately
+
     def __init__(self, host: str, port: int, path: str = "/"):
         self.host, self.port, self.path = host, port, path
         self.conn = http.client.HTTPConnection(host, port)
 
+    def _reconnect(self) -> None:
+        self.conn.close()
+        self.conn = http.client.HTTPConnection(self.host, self.port)
+
     def post(self, body: str, path: str = None) -> list:
-        try:
-            return self._once(body, path)
-        except self.TRANSIENT:
-            with _RETRY_LOCK:
-                RETRIES["post"] += 1
-            self.conn.close()
-            self.conn = http.client.HTTPConnection(self.host, self.port)
-            return self._once(body, path)
+        transient_left = self.MAX_RETRIES
+        shed_left = self.MAX_SHED
+        while True:
+            try:
+                return self._once(body, path)
+            except self.TRANSIENT:
+                if transient_left == 0:
+                    raise
+                transient_left -= 1
+                _count_retry("post")
+                self._reconnect()
+            except _Overloaded as e:
+                if shed_left == 0:
+                    raise
+                shed_left -= 1
+                _count_retry("shed")
+                # Honor the server's Retry-After (capped at 1 s so a
+                # misconfigured header can't stall the window).
+                time.sleep(min(max(e.retry_after, 0.0), 1.0))
 
     def _once(self, body: str, path: str) -> list:
         self.conn.request(
@@ -162,21 +215,28 @@ class BenchConn:
             {"Content-Type": "application/json"},
         )
         resp = self.conn.getresponse()
-        return json.loads(resp.read())["results"]
+        raw = resp.read()
+        if resp.status == 429:
+            try:
+                ra = float(resp.getheader("Retry-After") or 0.02)
+            except ValueError:
+                ra = 0.02
+            raise _Overloaded(ra)
+        return json.loads(raw)["results"]
 
     def get_text(self, path: str) -> str:
-        try:
-            return self._get_once(path)
-        except self.TRANSIENT:
-            # Same one-shot retry as post(): the end-of-run /metrics
-            # scrape must not be the one unprotected request that zeroes
-            # an otherwise complete artifact. Counted separately — a
-            # scrape retry must not read as a disturbed query POST.
-            with _RETRY_LOCK:
-                RETRIES["get"] += 1
-            self.conn.close()
-            self.conn = http.client.HTTPConnection(self.host, self.port)
-            return self._get_once(path)
+        # Same bounded retry as post(): the end-of-run /metrics scrape
+        # must not be the one unprotected request that zeroes an
+        # otherwise complete artifact. Counted separately — a scrape
+        # retry must not read as a disturbed query POST.
+        for left in range(self.MAX_RETRIES, -1, -1):
+            try:
+                return self._get_once(path)
+            except self.TRANSIENT:
+                if left == 0:
+                    raise
+                _count_retry("get")
+                self._reconnect()
 
     def _get_once(self, path: str) -> str:
         self.conn.request("GET", path)
@@ -316,6 +376,14 @@ def walk_delta(before: dict, after: dict) -> dict:
 #: trajectory ships its own attribution (peer RPC health, walk kinds,
 #: wire-tier engagement) instead of one end-of-run blob.
 LEG_COUNTER_FAMILIES = (
+    # Batching plane (ISSUE r11): occupancy×launch attribution per leg —
+    # batch_legs_total / batch_coalesced_total vs device_launches_total
+    # is the coalescing ratio; the shed counter proves deliberate
+    # degradation instead of kernel resets.
+    "batch_legs_total",
+    "batch_coalesced_total",
+    "device_launches_total",
+    "http_requests_shed_total",
     "peer_rpc_errors_total",
     "peer_rpc_retries_total",
     "version_walk_total",
@@ -629,6 +697,41 @@ def bench_topn(be) -> float:
     return lat[len(lat) // 2]
 
 
+#: Per-client abort budget: a client that keeps failing after this many
+#: exhausted-retry failures gives up (its partial count still tallies) —
+#: one sick client can NEVER abort the whole pool.map leg (the BENCH_r05
+#: crash class, ISSUE r11 satellite).
+MAX_CLIENT_ABORTS = 25
+
+
+def _bench_client_loop(host, port, path, body_of, deadline, on_success,
+                       start: int = 0) -> None:
+    """One bench client's request loop, abort-isolated: an exception out
+    of BenchConn's bounded retries counts as a client_abort, the client
+    reconnects fresh and keeps going; past MAX_CLIENT_ABORTS it retires
+    quietly instead of propagating into pool.map."""
+    conn = BenchConn(host, port, path)
+    aborts_left = MAX_CLIENT_ABORTS
+    j = start
+    try:
+        while time.time() < deadline:
+            try:
+                conn.post(body_of(j))
+            except Exception:
+                _count_retry("abort")
+                aborts_left -= 1
+                if aborts_left <= 0:
+                    return
+                conn.close()
+                conn = BenchConn(host, port, path)
+                time.sleep(0.01)
+                continue
+            on_success()
+            j += 1
+    finally:
+        conn.close()
+
+
 def bench_http(holder, be, queries) -> tuple[dict, float]:
     """Drive the REAL serving surface: POST /index/bench/query against an
     in-process HTTP server whose executor has the device backend + the
@@ -651,7 +754,7 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     from pilosa_tpu.server.http import Server
 
     ex = Executor(holder, backend=be)
-    ex.batcher = CountBatcher(be)
+    ex.batcher = ShardLegBatcher(be)
     srv = Server(API(holder, ex), host="localhost", port=0).open()
     path = "/index/bench/query"
 
@@ -706,13 +809,12 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
         deadline = time.time() + seconds
 
         def client(k: int) -> None:
-            conn = BenchConn("localhost", srv.port, path)
-            j = k
-            while time.time() < deadline:
-                conn.post(bodies[j % len(bodies)])
-                counters[k] += per_req
-                j += 1
-            conn.close()
+            _bench_client_loop(
+                "localhost", srv.port, path,
+                lambda j: bodies[j % len(bodies)], deadline,
+                lambda: counters.__setitem__(k, counters[k] + per_req),
+                start=k,
+            )
 
         t0 = time.time()
         with concurrent.futures.ThreadPoolExecutor(HTTP_CLIENTS) as pool:
@@ -770,6 +872,137 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
         qps_at_rate, achieved_rate, lat[len(lat) // 2], http_phase_ms,
         aborts, churn_walks, http_server_ms,
     )
+
+
+def _batch_counter_delta(base: dict, prefix: str) -> int:
+    """Summed delta of every counter series in one family since `base`
+    (a snapshot()['counters'] dict) — launches/coalesces across kinds."""
+    snap = global_stats.snapshot()["counters"]
+    return round(sum(
+        v - base.get(k, 0.0) for k, v in snap.items() if k.startswith(prefix)
+    ))
+
+
+def _occupancy_mean_delta(base_hist: dict) -> Optional[float]:
+    """Windowed mean batch occupancy (legs per coalesced launch group)
+    across every batch_occupancy{kind=…} series since the `base_hist`
+    histogram_snapshot — exact _sum/_count means (utils/stats.py
+    histogram_mean), pooled over kinds."""
+    from pilosa_tpu.utils.stats import histogram_mean
+
+    tot_s = tot_c = 0.0
+    for name, ent in global_stats.histogram_snapshot().items():
+        if not name.startswith("batch_occupancy"):
+            continue
+        b = base_hist.get(name)
+        c = ent["count"] - (b["count"] if b else 0.0)
+        m = histogram_mean(ent, b)
+        if m is None:
+            continue
+        tot_s += m * c
+        tot_c += c
+    return (tot_s / tot_c) if tot_c > 0 else None
+
+
+def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
+    """Concurrency-sweep leg (ISSUE r11 acceptance): served qps at
+    {1,16,64,256} concurrent keep-alive clients through the real HTTP
+    surface with the unified shard-leg batcher — the figure that must
+    scale superlinearly as coalescing amortizes the dispatch floor.
+
+    The sweep deliberately uses 3-ary intersect Counts
+    (Intersect(f, g, h)): those are NOT pair-planable, so every leg
+    rides the slot-batched scan path and pays a REAL device launch per
+    drain — the dispatch-bound regime BENCH_r04 diagnosed
+    (single_query_p50 ≈ 131 ms vs a ~112 ms per-launch floor). The
+    2-ary bench queries would demonstrate nothing here: the pair-stats
+    cache already serves them host-side at ~1.5M resolves/s
+    (qps_at_write_rate covers that regime). Scaling with client count
+    is therefore the launch-amortization proof: at 1 client each
+    request pays the relay floor alone; at 64, one launch carries ~64
+    requests' legs.
+
+    Each window checkpoints as its own leg (qps@N), so leg_metrics
+    embeds its batch/launch/shed counter deltas automatically; the
+    summary carries per-window qps, mean batch occupancy (legs/launch),
+    device-launch deltas, and server-side request quantiles next to the
+    client numbers."""
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http import Server
+
+    ex = Executor(holder, backend=be)
+    ex.batcher = ShardLegBatcher(be)
+    srv = Server(API(holder, ex), host="localhost", port=0).open()
+    path = "/index/bench/query"
+    per_req = HTTP_QUERIES_PER_REQ
+    rng = np.random.default_rng(11)
+    tri = [
+        f"Count(Intersect(Row(f={int(rng.integers(0, ROWS))}), "
+        f"Row(g={int(rng.integers(0, ROWS))}), "
+        f"Row(h={int(rng.integers(0, 4))})))"
+        for _ in range(BATCH)
+    ]
+    bodies = [
+        "".join(tri[i : i + per_req]) for i in range(0, len(tri), per_req)
+    ]
+    warm = BenchConn("localhost", srv.port, path)
+    warm.post(bodies[0])
+    qps_at: dict[str, float] = {}
+    occupancy_at: dict[str, Optional[float]] = {}
+    launches_at: dict[str, int] = {}
+    server_ms_at: dict[str, Optional[dict]] = {}
+    try:
+        for n in CONCURRENCY:
+            hist0 = global_stats.histogram_snapshot()
+            counters0 = global_stats.snapshot()["counters"]
+            counts = [0] * n
+            deadline = time.time() + SECONDS
+
+            def client(k: int, _counts=counts) -> None:
+                _bench_client_loop(
+                    "localhost", srv.port, path,
+                    lambda j: bodies[j % len(bodies)], deadline,
+                    lambda: _counts.__setitem__(k, _counts[k] + per_req),
+                    start=k,
+                )
+
+            t0 = time.time()
+            with concurrent.futures.ThreadPoolExecutor(n) as pool:
+                list(pool.map(client, range(n)))
+            elapsed = time.time() - t0
+            key = str(n)
+            qps_at[key] = round(sum(counts) / elapsed, 1)
+            occ = _occupancy_mean_delta(hist0)
+            occupancy_at[key] = round(occ, 2) if occ is not None else None
+            launches_at[key] = _batch_counter_delta(
+                counters0, "device_launches_total"
+            )
+            server_ms_at[key] = hist_quantiles_ms(
+                "http_request_duration_seconds", hist0,
+                tag='route="post_query"',
+            )
+            checkpoint(
+                f"qps@{n}",
+                **{
+                    f"qps_at_{n}_clients": qps_at[key],
+                    f"batch_occupancy_mean_at_{n}": occupancy_at[key],
+                },
+            )
+    finally:
+        warm.close()
+        srv.close()
+    out = {
+        "qps_at_clients": qps_at,
+        "batch_occupancy_mean_at_clients": occupancy_at,
+        "device_launches_at_clients": launches_at,
+        "concurrency_server_ms": server_ms_at,
+    }
+    base = qps_at.get("1")
+    if base:
+        out["qps_scaling_vs_1_client"] = {
+            k: round(v / base, 2) for k, v in qps_at.items()
+        }
+    return out
 
 
 def bench_group_by(holder, be) -> tuple[float, float]:
@@ -1150,9 +1383,19 @@ def main():
         http_phase_per_request_ms=http_phase_ms,
         http_post_retries=RETRIES["post"],
         http_get_retries=RETRIES["get"],
+        # Capture-proof client accounting (ISSUE r11 satellite): bounded
+        # reconnect-and-retry totals and the clients that exhausted them.
+        client_retries=RETRIES["post"] + RETRIES["get"] + RETRIES["shed"],
+        client_aborts=RETRIES["abort"],
         http_connection_aborts=aborts,
         churn_version_walks=http_churn_walks,
     )
+    sweep = bench_concurrency_sweep(h, be, checkpoint)
+    sweep["client_retries"] = (
+        RETRIES["post"] + RETRIES["get"] + RETRIES["shed"]
+    )
+    sweep["client_aborts"] = RETRIES["abort"]
+    checkpoint("concurrency_sweep", **sweep)
     checkpoint("degraded_qps", **bench_degraded_qps())
 
     out.update(

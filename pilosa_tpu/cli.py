@@ -46,9 +46,9 @@ def cmd_server(args) -> int:
             log.printf("executor=tpu unavailable (%s); falling back to cpu", e)
     executor = Executor(holder, backend=backend)
     if backend is not None:
-        from pilosa_tpu.exec.batcher import CountBatcher
+        from pilosa_tpu.exec.batcher import ShardLegBatcher
 
-        executor.batcher = CountBatcher(backend, window=cfg.batch_window)
+        executor.batcher = ShardLegBatcher(backend, window=cfg.batch_window)
         if cfg.preheat:
             import threading as _threading
 
@@ -76,6 +76,8 @@ def cmd_server(args) -> int:
     # Default per-query budget for clients that send no ?timeout=
     # (server/http.py opens the deadline scope at ingress).
     api.query_timeout = cfg.query_timeout
+    # In-flight /query admission cap (deliberate 429 shedding past it).
+    api.max_inflight_queries = cfg.max_inflight
 
     # TLS (reference server/tlsconfig.go): certificate+key serve HTTPS;
     # peers are dialed with a CA-verified (or skip-verify) context. A

@@ -68,6 +68,8 @@ from pilosa_tpu.ops.blocks import (
 )
 from pilosa_tpu.ops.kernels import (
     MAX_PAIR_SHARDS,
+    mask_lane_slab,
+    masked_lane_counts,
     nary_stats,
     nary_stats_pershard,
     pair_stats,
@@ -99,6 +101,24 @@ MAX_PAIR_CACHE_ENTRIES = 16
 # depth is bounded only by the spec key; sums weight plane counts in exact
 # Python ints. Depths beyond this are out of int64 BSI range anyway.
 MAX_BSI_DEPTH = 63
+
+# Device-memory cap for one batched bitmap-materialization launch's
+# [Q, S, W] output; a row-leg group whose slot bucket would exceed it
+# splits into multiple launches (each still amortizing its round trip).
+MAX_ROW_BATCH_BYTES = 256 << 20
+
+
+def _slot_bucket(n: int) -> int:
+    """Slot-count bucket for a batched launch: the next power of two.
+    Batched programs trace the slot axis as a concrete array dim, so an
+    exact-occupancy shape would recompile per batch size; bucketing pads
+    occupancy into O(log Q) compiled signatures (ISSUE r11 tentpole —
+    the ragged-paged-attention fixed-slot trick). Padded slots replay
+    slot 0's operands and are lane-masked in-kernel."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
 
 
 class _Unsupported(Exception):
@@ -1265,9 +1285,27 @@ class TPUBackend:
     def _psum(self, x):
         return jax.lax.psum(x, self.mesh.axis) if self.mesh is not None else x
 
+    def _counted_launch(self, kind: str, fn):
+        """Wrap a compiled program so every execution counts as
+        `device_launches_total{kind=…}` — the chokepoint every query
+        program passes through, so batching wins are SLO-visible as a
+        falling launch rate against a steady batch_legs_total (ISSUE r11:
+        `query_phase_seconds{phase=device_dispatch}` collapses to a
+        per-BATCH cost; this counter is the denominator that proves it)."""
+        stats = self.stats.with_tags(f"kind:{kind}")
+
+        def counted(*args):
+            stats.count("device_launches_total")
+            return fn(*args)
+
+        return counted
+
     def _program(self, kind: str, spec, reduce_dev: bool, extra=None):
         """One compiled program per (kind, tree-shape, reduction mode);
-        the spec tree fixes the leaf count, so it alone keys the shape."""
+        the spec tree fixes the leaf count, so it alone keys the shape.
+        Batched kinds (count_batch / vec_batch) additionally key on the
+        slot-count bucket through their [Q]-leading scalar shapes — see
+        _slot_bucket."""
         key = (kind, spec, reduce_dev, extra)
         with self._fns_lock:
             fn = self._fns.get(key)
@@ -1301,15 +1339,18 @@ class TPUBackend:
         elif kind == "count_batch":
 
             def body(blocks, scalars):
-                # scan over the query axis: each step is the fused
+                # scan over the query-slot axis: each step is the fused
                 # unbatched count over [S, W] slabs — never materializes a
                 # [S, Q, W] gather (32 GB at the 1B-column/256-batch
                 # shape), and works for any spec (BSI leaves included).
+                # The LAST scanned array is the [Q] ragged-occupancy lane
+                # mask: padded slots (slot-count bucketing, _slot_bucket)
+                # replay slot 0's scalars and are zeroed in-kernel so no
+                # reduction can ever see them.
                 def step(_, qs):
-                    slab = _eval_spec(spec, iter(blocks), iter(qs))
-                    per_shard = jnp.sum(
-                        jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32
-                    )
+                    act = qs[-1]
+                    slab = _eval_spec(spec, iter(blocks), iter(qs[:-1]))
+                    per_shard = masked_lane_counts(slab, act)
                     if reduce_dev:
                         return None, self._psum(jnp.sum(per_shard, dtype=jnp.uint32))
                     return None, per_shard
@@ -1318,6 +1359,24 @@ class TPUBackend:
                 return out  # [Q] or [Q, S]
 
             out = (P() if reduce_dev else P(None, mesh.axis if mesh else None)) if mesh is not None else None
+            fn = self._wrap(body, False, out)
+
+        elif kind == "vec_batch":
+
+            def body(blocks, scalars):
+                # Batched bitmap materialization: scan the query-slot
+                # axis, stacking each slot's [S, W] slab into [Q, S, W]
+                # (capped by MAX_ROW_BATCH_BYTES at the call site). Same
+                # last-array lane-mask contract as count_batch.
+                def step(_, qs):
+                    act = qs[-1]
+                    slab = _eval_spec(spec, iter(blocks), iter(qs[:-1]))
+                    return None, mask_lane_slab(slab, act)
+
+                _, out = jax.lax.scan(step, None, scalars)
+                return out  # [Q, S, W]
+
+            out = P(None, mesh.axis) if mesh is not None else None
             fn = self._wrap(body, False, out)
 
         elif kind == "topn_plain":
@@ -1442,6 +1501,7 @@ class TPUBackend:
         else:
             raise ValueError(kind)
 
+        fn = self._counted_launch(kind, fn)
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -1750,6 +1810,7 @@ class TPUBackend:
                     check_vma=False,
                 )
             )
+        fn = self._counted_launch("pair_stats", fn)
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -2971,9 +3032,33 @@ class TPUBackend:
 
     # -- generic batched scan path -----------------------------------------
 
+    @staticmethod
+    def _padded_slot_scalars(per_call: list[tuple], qb: int) -> tuple:
+        """Stack per-call scalar tuples into [Qb, ...] slot arrays padded
+        to the slot bucket (padding replays slot 0), and append the [Qb]
+        uint32 lane mask the batched program's scan consumes last —
+        the fixed-shape-slot / ragged-occupancy layout."""
+        q = len(per_call)
+        n_scalars = len(per_call[0])
+        out = []
+        for j in range(n_scalars):
+            rows = [np.asarray(pc[j], dtype=np.uint32) for pc in per_call]
+            rows.extend(rows[:1] * (qb - q))
+            out.append(np.stack(rows))
+        active = np.zeros(qb, dtype=np.uint32)
+        active[:q] = 1
+        out.append(active)
+        return tuple(out)
+
     def _generic_batch_dispatch(self, index, calls, shards_t):
         """Group same-(spec, leaf-blocks) calls into fused scan dispatches:
-        row ids become [Q] traced vectors, one program per group."""
+        row ids become [Q] traced slot vectors, one program per group.
+        Slot counts pad to a power-of-two bucket (_slot_bucket) so batch
+        occupancy — which varies per drain window under backpressure
+        batching — maps to O(log Q) compiled signatures instead of one
+        XLA compile per occupancy; padded slots are lane-masked in-kernel
+        and the `idxs` per-slot query-id vector scatters live results
+        back at resolve time."""
         prof = current_profile()
         results: list[Optional[int]] = [None] * len(calls)
         groups: dict = {}
@@ -3009,11 +3094,8 @@ class TPUBackend:
                     out = self._program("count", spec, reduce_dev)(blocks, ())
                 pending.append((idxs, out, True))
                 continue
-            scalars = tuple(
-                np.stack(
-                    [np.asarray(assembled[i][1][j], dtype=np.uint32) for i in idxs]
-                )
-                for j in range(n_scalars)
+            scalars = self._padded_slot_scalars(
+                [assembled[i][1] for i in idxs], _slot_bucket(len(idxs))
             )
             with jax.profiler.TraceAnnotation(
                 "pilosa.count_batch"
@@ -3036,6 +3118,139 @@ class TPUBackend:
                         results[i] = int(arr[j])
             for i in fallbacks:
                 results[i] = self.count_shards(index, calls[i], list(shards_t))
+            return results  # type: ignore[return-value]
+
+        return resolve
+
+    def row_batch_async(
+        self, index: str, calls: list[Call], shards: list[int]
+    ) -> Callable[[], list[Row]]:
+        """Batched bitmap materialization — the batching plane's row legs
+        (Row/Intersect/Union/… resolves). Calls assemble against the
+        resident stack and group by (spec shape, leaf blocks); within a
+        group, byte-identical scalar slots dedupe (parse-cached trees
+        make concurrent hot queries literally identical), the survivors
+        pad to a slot bucket, and ONE vec_batch launch produces the
+        group's [Q, S, W] slab stack (chunked under MAX_ROW_BATCH_BYTES).
+        The resolver reads each chunk back once and builds every leg its
+        own Row from its slot's slab — legs never share mutable results.
+
+        Single-slot groups ride the existing "vec" program (no scan axis,
+        no extra compile). Calls without a device lowering fall back to
+        bitmap_call per call inside the resolver (CPU oracle included);
+        a malformed call (QueryError) fails the whole group at assembly —
+        the batcher then re-dispatches legs individually so only the
+        offending submitter sees the error."""
+        idx = self.holder.index(index)
+        avail = idx.available_shards().to_array().tolist() if idx else []
+        pos_of = {s: i for i, s in enumerate(avail)}
+        if avail and all(s in pos_of for s in shards):
+            shards_t = tuple(avail)
+            positions = [pos_of[s] for s in shards]
+        else:
+            shards_t = tuple(shards)
+            positions = list(range(len(shards)))
+        prof = current_profile()
+        results: list[Optional[Row]] = [None] * len(calls)
+        groups: dict = {}
+        assembled: dict[int, tuple] = {}
+        fallbacks: list[int] = []
+        with prof.phase("plan"):
+            for i, c in enumerate(calls):
+                try:
+                    spec, blocks, scalars = self._assemble(index, c, shards_t)
+                except _Unsupported:
+                    fallbacks.append(i)
+                    continue
+                key = (spec, tuple(id(b) for b in blocks))
+                groups.setdefault(key, []).append(i)
+                assembled[i] = (blocks, scalars)
+        # (query ids, per-query slot, chunked device outputs, slots/chunk)
+        pending: list[tuple] = []
+        for (spec, _bk), idxs in groups.items():
+            blocks = assembled[idxs[0]][0]
+            s_pad = blocks[0].shape[0]
+            # Slot dedupe by scalar bytes: the per-slot query-id mapping
+            # (slot_of) scatters one computed slab to every leg that
+            # asked for it.
+            slot_index: dict[tuple, int] = {}
+            unique: list[int] = []
+            slot_of: dict[int, int] = {}
+            for i in idxs:
+                k = tuple(
+                    np.asarray(s, dtype=np.uint32).tobytes()
+                    for s in assembled[i][1]
+                )
+                if k not in slot_index:
+                    slot_index[k] = len(unique)
+                    unique.append(i)
+                slot_of[i] = slot_index[k]
+            slab_bytes = s_pad * WORDS_PER_SHARD * 4
+            # Rounded DOWN to a power of two: a full chunk's slot bucket
+            # then equals per_chunk exactly, so bucket padding can never
+            # inflate a launch past the byte cap it exists to enforce.
+            per_chunk = max(1, MAX_ROW_BATCH_BYTES // slab_bytes)
+            per_chunk = 1 << (per_chunk.bit_length() - 1)
+            outs = []
+            with jax.profiler.TraceAnnotation("pilosa.row_batch"), prof.phase(
+                "device_dispatch"
+            ):
+                for base in range(0, len(unique), per_chunk):
+                    chunk = unique[base : base + per_chunk]
+                    if len(chunk) == 1:
+                        outs.append(
+                            self._program("vec", spec, False)(
+                                blocks, assembled[chunk[0]][1]
+                            )
+                        )
+                        continue
+                    scal = self._padded_slot_scalars(
+                        [assembled[i][1] for i in chunk],
+                        _slot_bucket(len(chunk)),
+                    )
+                    outs.append(
+                        self._program("vec_batch", spec, False)(blocks, scal)
+                    )
+            pending.append((idxs, slot_of, outs, per_chunk))
+
+        # Subset requests gather on device before readback (same
+        # heuristic as bitmap_call: moving a whole padded slab over the
+        # relay for a few shards wastes the link).
+        sub = len(positions) * 4 <= (
+            pending[0][2][0].shape[-2] if pending else 0
+        )
+        pos_dev = jnp.asarray(positions, dtype=jnp.int32) if sub else None
+
+        def resolve() -> list[Row]:
+            with current_profile().phase("host_reduce"):
+                for idxs, slot_of, outs, per_chunk in pending:
+                    hosts = []
+                    for out in outs:
+                        if sub:
+                            out = (
+                                out[pos_dev] if out.ndim == 2
+                                else out[:, pos_dev, :]
+                            )
+                        hosts.append(np.asarray(out))
+                    row_pos = (
+                        list(range(len(positions))) if sub else positions
+                    )
+                    for i in idxs:
+                        slot = slot_of[i]
+                        h = hosts[slot // per_chunk]
+                        slab = h if h.ndim == 2 else h[slot % per_chunk]
+                        row = Row()
+                        for pos, s in zip(row_pos, shards):
+                            words = slab[pos]
+                            if words.any():
+                                row.merge(
+                                    Row.from_segment(
+                                        s, Bitmap(unpack_row(words))
+                                    )
+                                )
+                        results[i] = row
+            for i in fallbacks:
+                results[i] = self.bitmap_call(index, calls[i], list(shards))
             return results  # type: ignore[return-value]
 
         return resolve

@@ -485,6 +485,32 @@ def expand_run_spans(acc, lo, hi, nnz):
     return acc | jnp.where(cover, full, jnp.uint32(0))
 
 
+# ---------------------------------------------------------------------------
+# Ragged-occupancy slot masking (ISSUE r11 batching plane): the batched
+# serving programs in exec/tpu.py pad a group's query slots up to a fixed
+# slot-count bucket so a handful of compiled signatures serve any
+# occupancy. Padded slots replay slot 0's operands; these helpers zero
+# them INSIDE the kernel so an inactive lane can never leak a value into
+# any cross-lane reduction, whatever the program does downstream — the
+# per-slot query-id scatter on the host is then a pure routing step.
+# ---------------------------------------------------------------------------
+
+
+def mask_lane_slab(slab, active):
+    """Zero a padded slot's bitmap slab: uint32[...] & (0 - active) where
+    active is a 0/1 uint32 — the two's-complement trick turns the flag
+    into an all-ones/all-zeros mask without a select."""
+    return slab & (jnp.uint32(0) - active)
+
+
+def masked_lane_counts(slab, active):
+    """Per-shard popcounts of one slot's slab with inactive lanes zeroed:
+    uint32[S, W], uint32 0/1 -> uint32[S]. The count-batch scan body uses
+    this so a padded slot contributes exactly 0 to any reduction."""
+    per = jnp.sum(jax.lax.population_count(slab), axis=-1, dtype=jnp.uint32)
+    return per * active
+
+
 def pair_stats_xla(f_stack, g_stack):
     """Fused-XLA reference formulation of pair_stats (same results; used
     as the differential oracle for the Pallas kernel and as the fallback

@@ -10,6 +10,7 @@ cluster layer injects itself to gate methods and route imports.
 from __future__ import annotations
 
 import json
+import threading
 from typing import Any, Optional
 
 import numpy as np
@@ -69,6 +70,44 @@ class API:
         # first /debug/slo scrape.
         self.slo: list[dict] = []
         self.monitor = None
+        # Deliberate load shedding (ROADMAP item 1 down payment): when
+        # max_inflight_queries > 0, the HTTP layer admits at most that
+        # many concurrent /query executions and answers the rest with
+        # 429 + Retry-After + code=overloaded — the front door degrades
+        # by contract, never by kernel reset. 0 = unbounded (default).
+        self.max_inflight_queries = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight_queries = 0
+
+    # -- admission control (wired by server/http.py around /query) ---------
+
+    def begin_query(self) -> bool:
+        """Admit one query execution, or refuse (False) when the in-flight
+        cap is reached. Callers that get True MUST call end_query() in a
+        finally block. Exported as the http_inflight_queries gauge."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        # Gauge writes stay INSIDE the lock: written outside with a
+        # captured count, two interleaved begin/end calls could publish
+        # their snapshots out of order and leave the gauge wrong until
+        # the next query (code review r11). Lock order is always
+        # _inflight_lock -> stats lock; nothing takes them reversed.
+        with self._inflight_lock:
+            if (
+                self.max_inflight_queries > 0
+                and self._inflight_queries >= self.max_inflight_queries
+            ):
+                return False
+            self._inflight_queries += 1
+            global_stats.gauge("http_inflight_queries", self._inflight_queries)
+        return True
+
+    def end_query(self) -> None:
+        from pilosa_tpu.utils.stats import global_stats
+
+        with self._inflight_lock:
+            self._inflight_queries -= 1
+            global_stats.gauge("http_inflight_queries", self._inflight_queries)
 
     def _validate_state(self, method: str) -> None:
         if self.cluster is None or method in _STATE_EXEMPT:

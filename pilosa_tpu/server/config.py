@@ -110,6 +110,11 @@ class Config:
     # many seconds is re-launched at the next live replica (first result
     # wins). 0 disables hedging.
     hedge_delay: float = 0.25
+    # In-flight /query admission cap (server/http.py): past this many
+    # concurrently executing queries, new ones are shed with 429 +
+    # Retry-After + code=overloaded (http_requests_shed_total) instead
+    # of queueing until the kernel RSTs the accept backlog. 0 = no cap.
+    max_inflight: int = 0
     # HBM residency budget in bytes for the TPU backend's field stacks
     # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
     # via row paging instead of whole-stack residency.
@@ -208,6 +213,7 @@ class Config:
             "long-query-time": self.long_query_time,
             "batch-window": self.batch_window,
             "preheat": self.preheat,
+            "max-inflight": self.max_inflight,
             "max-hbm-bytes": self.max_hbm_bytes,
             "profile": {"port": self.profile_port},
             "query-timeout": self.query_timeout,
@@ -246,6 +252,7 @@ class Config:
             "batch-window": "batch_window",
             "preheat": "preheat",
             "client-timeout": "client_timeout",
+            "max-inflight": "max_inflight",
             "max-hbm-bytes": "max_hbm_bytes",
             "query-timeout": "query_timeout",
             "client-retries": "client_retries",
@@ -292,6 +299,7 @@ class Config:
             pre + "PREHEAT": ("preheat", lambda v: v.lower() in ("1", "true")),
             pre + "PROFILE_PORT": ("profile_port", int),
             pre + "CLIENT_TIMEOUT": ("client_timeout", float),
+            pre + "MAX_INFLIGHT": ("max_inflight", int),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
             pre + "QUERY_TIMEOUT": ("query_timeout", float),
             pre + "CLIENT_RETRIES": ("client_retries", int),
@@ -332,6 +340,7 @@ class Config:
             f"batch-window = {c.batch_window}\n"
             f"preheat = {str(c.preheat).lower()}\n"
             f"client-timeout = {c.client_timeout}\n"
+            f"max-inflight = {c.max_inflight}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
             f"query-timeout = {c.query_timeout}\n"
             f"client-retries = {c.client_retries}\n"

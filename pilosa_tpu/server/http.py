@@ -567,6 +567,7 @@ class _Handler(BaseHTTPRequestHandler):
         404: "not-found",
         409: "conflict",
         413: "too-large",
+        429: "overloaded",
         500: "internal",
         501: "not-implemented",
         502: "bad-gateway",
@@ -579,9 +580,10 @@ class _Handler(BaseHTTPRequestHandler):
             "error": msg,
             "code": code or self._CODE_BY_STATUS.get(status, f"http-{status}"),
         }
-        # 503/504 are retryable-by-contract: tell the client when
-        # (ISSUE r9 satellite). 1 s is the breaker/hedge recovery scale.
-        headers = {"Retry-After": "1"} if status in (503, 504) else None
+        # 429/503/504 are retryable-by-contract: tell the client when
+        # (ISSUE r9 satellite). 1 s is the breaker/hedge recovery scale;
+        # a shed 429 clears as soon as an in-flight query finishes.
+        headers = {"Retry-After": "1"} if status in (429, 503, 504) else None
         self._reply(body, status=status, headers=headers)
 
     def _dispatch(self, method: str) -> None:
@@ -730,13 +732,35 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("POST", r"/index/(?P<index>[^/]+)/query")
     def handle_post_query(self, index):
-        # The deadline scope opens HERE — at HTTP receipt, like the query
-        # profile — so the budget covers the whole serving path through
-        # response serialization (ISSUE r9 tentpole 1).
-        from pilosa_tpu.utils.deadline import deadline_scope
+        # Admission gate FIRST (ROADMAP item 1 down payment): past the
+        # configured in-flight cap the request is shed deliberately —
+        # 429 + Retry-After + code=overloaded, counted — instead of
+        # queueing until the accept path RSTs under burst. The unread
+        # body must still be drained (chunked bodies already were, in
+        # parse_request) or the keep-alive connection would parse it as
+        # the next request — the desync class this file rejects
+        # elsewhere.
+        from pilosa_tpu.utils.stats import global_stats
 
-        with deadline_scope(self._request_deadline()):
-            self._serve_query(index)
+        if not self.api.begin_query():
+            global_stats.count("http_requests_shed_total")
+            self._body()
+            self._error(
+                "server overloaded: in-flight query cap reached",
+                status=429,
+                code="overloaded",
+            )
+            return
+        try:
+            # The deadline scope opens HERE — at HTTP receipt, like the
+            # query profile — so the budget covers the whole serving path
+            # through response serialization (ISSUE r9 tentpole 1).
+            from pilosa_tpu.utils.deadline import deadline_scope
+
+            with deadline_scope(self._request_deadline()):
+                self._serve_query(index)
+        finally:
+            self.api.end_query()
 
     def _serve_query(self, index):
         body = self._body()

@@ -5,9 +5,19 @@ moves through the serving path (server/http.py -> server/api.py ->
 exec/executor.py -> exec/tpu.py). The profile is activated thread-locally
 (profile_scope) so deep layers attribute work without threading an object
 through every signature; the serving path is thread-per-request, so the
-thread-local IS the request scope. Work the micro-batcher's leader does
-on behalf of coalesced followers attributes to the leader's profile —
-shared device work has exactly one payer per dispatch.
+thread-local IS the request scope.
+
+Batching-plane attribution contract (exec/batcher.py, ISSUE r11): a
+coalesced follower's ENTIRE cost is its `batch_wait` phase — the wait on
+the leader's shared launch covers plan + dispatch + readback done on its
+behalf. The leader (or detached helper drain) self-attributes the shared
+work (`plan`/`device_dispatch`/`host_reduce`) exactly once per launch,
+so summing `query_phase_seconds{phase=device_dispatch}` over a window
+yields the PER-BATCH launch cost while `phase=batch_wait` carries the
+per-query experience — shared device work has exactly one payer per
+dispatch, never one per coalesced query. Helper-thread drains run with
+no active profile (NOP sink); their launches stay visible through
+`device_launches_total{kind=…}` and the `batch_occupancy` histogram.
 
 Three export surfaces (all fed from profile_scope.__exit__):
 - tagged histograms on /metrics: query_phase_seconds{call=...,phase=...}

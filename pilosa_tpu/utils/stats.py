@@ -103,6 +103,23 @@ def merge_buckets(a: Sequence[float], b: Sequence[float]) -> list[float]:
     return [x + y for x, y in zip(a, b)]
 
 
+def histogram_mean(entry: dict, baseline: Optional[dict] = None) -> Optional[float]:
+    """Exact mean of one histogram_snapshot() entry, optionally diffed
+    against an earlier snapshot of the same series (windowed mean).
+    Derived from the exact _sum/_count — never from bucket midpoints —
+    so it is precise even for value-typed histograms whose range
+    outruns the bucket set (batch_occupancy's legs/launch, where the
+    bench's acceptance gate is the windowed mean). None when the
+    (diffed) series is empty."""
+    s, c = entry["sum"], entry["count"]
+    if baseline is not None:
+        s -= baseline["sum"]
+        c -= baseline["count"]
+    if c <= 0:
+        return None
+    return s / c
+
+
 def series_matches(name: str, metric: str) -> bool:
     """Does a snapshot series name (`family` or `family{tags}`) belong
     to `metric`? `metric` may itself be a fully tagged series name. The

@@ -1,0 +1,403 @@
+"""Unified shard-leg batching plane (exec/batcher.py, ISSUE r11).
+
+Two layers of coverage:
+- StubBackend tests exercise the batcher's composition contract with no
+  device (or jax) dependency: deterministic windows via window > 0,
+  mixed-kind grouping (Count + Row + Sum + TopN legs drained together
+  land in per-kind groups, one backend dispatch each), identical-leg
+  dedupe for the synchronous kinds, per-slot query-id result scatter,
+  error isolation (one bad leg fails only its submitter), and the
+  occupancy/coalesce telemetry.
+- Differential tests (skipped where the device backend can't import)
+  prove batched results identical to the unbatched path for
+  Count/Row/Sum/Min/Max/TopN under concurrent submission — the ISSUE
+  r11 acceptance bar.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.exec.batcher import CountBatcher, ShardLegBatcher
+from pilosa_tpu.utils.stats import global_stats
+
+
+class StubBackend:
+    """Deterministic fake of the device backend's batched entry points.
+
+    Count calls are ints; a count resolves to call*10 so scatter order is
+    checkable. Row calls resolve to ("row", call). BSI aggregates return
+    (value, count) derived from the field name; TopN returns a ranked
+    list the batcher must trim per leg. Every dispatch is recorded."""
+
+    BAD = object()  # a call whose presence fails any dispatch it rides in
+
+    def __init__(self):
+        self.count_groups = []
+        self.row_groups = []
+        self.bsi_calls = []
+        self.topn_calls = []
+        self.individual_counts = []
+        self.fail_count_groups = False
+
+    # -- count legs --------------------------------------------------------
+
+    def count_batch_async(self, index, calls, shards):
+        if self.fail_count_groups and len(calls) > 1:
+            raise RuntimeError("injected group failure")
+        if any(c is self.BAD for c in calls):
+            if len(calls) == 1:
+                self.individual_counts.append(list(calls))
+            raise ValueError("bad call")
+        if len(calls) == 1 and self.fail_count_groups:
+            self.individual_counts.append(list(calls))
+        self.count_groups.append((list(calls), tuple(shards)))
+        values = [c * 10 for c in calls]
+        return lambda: values
+
+    # -- row legs ----------------------------------------------------------
+
+    def row_batch_async(self, index, calls, shards):
+        if any(c is self.BAD for c in calls):
+            raise ValueError("bad row call")
+        self.row_groups.append((list(calls), tuple(shards)))
+        rows = [("row", c) for c in calls]
+        return lambda: rows
+
+    def bitmap_call(self, index, call, shards):
+        if call is self.BAD:
+            raise ValueError("bad row call")
+        return ("row", call)
+
+    # -- synchronous kinds -------------------------------------------------
+
+    def bsi_sum(self, index, field, shards, filter_call=None):
+        if field == "boom":
+            raise ValueError("bad field")
+        self.bsi_calls.append(("bsi_sum", field, filter_call))
+        return (len(field) * 100, 7)
+
+    def bsi_min(self, index, field, shards, filter_call=None):
+        self.bsi_calls.append(("bsi_min", field, filter_call))
+        return (1, 2)
+
+    def topn_field(self, index, field, shards, n, src_call=None):
+        assert n == 0, "batcher must request the full ranked vector"
+        self.topn_calls.append((field, src_call))
+        return [(r, 50 - r) for r in range(5)]
+
+
+def _run_threads(fns):
+    """Run callables concurrently; return per-fn (result | exception)."""
+    out = [None] * len(fns)
+
+    def wrap(k):
+        try:
+            out[k] = fns[k]()
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            out[k] = e
+
+    threads = [threading.Thread(target=wrap, args=(k,)) for k in range(len(fns))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+class TestLegComposition:
+    def test_mixed_kinds_group_per_kind(self):
+        """Count + Row + Sum + TopN legs drained in one window land in
+        per-kind groups: one count dispatch carrying every count call,
+        one row dispatch, deduped sync calls."""
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.3)
+        shards = [0, 1]
+        filt = object()  # shared filter tree (parse-cache identity)
+        fns = [
+            lambda: b.count("i", [1, 2], shards),
+            lambda: b.count("i", [3], shards),
+            lambda: b.row("i", "rowA", shards),
+            lambda: b.row("i", "rowB", shards),
+            lambda: b.bsi("bsi_sum", "i", "v", shards, None),
+            lambda: b.bsi("bsi_sum", "i", "v", shards, None),  # dedupes
+            lambda: b.bsi("bsi_min", "i", "v", shards, None),
+            lambda: b.topn("i", "f", shards, 2, filt),
+            lambda: b.topn("i", "f", shards, 0, filt),  # shares the launch
+        ]
+        got = _run_threads(fns)
+        assert not any(isinstance(g, Exception) for g in got), got
+        # One count dispatch carried all three calls (leader order may
+        # interleave legs, but the group is singular and complete).
+        assert len(be.count_groups) == 1
+        assert sorted(be.count_groups[0][0]) == [1, 2, 3]
+        assert sorted(got[0]) + got[1] == [10, 20, 30]
+        # One row launch with both legs' calls; per-leg results.
+        assert len(be.row_groups) == 1
+        assert sorted(be.row_groups[0][0]) == ["rowA", "rowB"]
+        assert got[2] == ("row", "rowA") and got[3] == ("row", "rowB")
+        # Identical Sum legs deduped to ONE backend call; Min separate.
+        assert be.bsi_calls.count(("bsi_sum", "v", None)) == 1
+        assert be.bsi_calls.count(("bsi_min", "v", None)) == 1
+        assert got[4] == got[5] == (100, 7)
+        assert got[6] == (1, 2)
+        # TopN shared one ranked-vector computation; n trimmed per leg.
+        assert len(be.topn_calls) == 1
+        assert got[7] == [(0, 50), (1, 49)]
+        assert len(got[8]) == 5
+
+    def test_count_scatter_respects_leg_boundaries(self):
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.2)
+        got = _run_threads([
+            lambda: b.count("i", [1, 2], [0]),
+            lambda: b.count("i", [7], [0]),
+        ])
+        assert got[0] == [10, 20]
+        assert got[1] == [70]
+
+    def test_distinct_shard_sets_do_not_share_a_group(self):
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.2)
+        got = _run_threads([
+            lambda: b.count("i", [1], [0]),
+            lambda: b.count("i", [2], [0, 1]),
+        ])
+        assert got[0] == [10] and got[1] == [20]
+        assert len(be.count_groups) == 2
+        assert {g[1] for g in be.count_groups} == {(0,), (0, 1)}
+
+    def test_uncontended_leg_dispatches_immediately(self):
+        """window=0: a lone leg pays no coalescing sleep and still works
+        through every public submit method."""
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.0)
+        assert b.count("i", [4], [0]) == [40]
+        assert b.row("i", "r", [0]) == ("row", "r")
+        assert b.bsi("bsi_sum", "i", "v", [0]) == (100, 7)
+        assert b.topn("i", "f", [0], 1) == [(0, 50)]
+
+    def test_countbatcher_alias(self):
+        assert CountBatcher is ShardLegBatcher
+
+
+class TestErrorIsolation:
+    def test_bad_count_leg_fails_only_its_submitter(self):
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.25)
+        got = _run_threads([
+            lambda: b.count("i", [1], [0]),
+            lambda: b.count("i", [StubBackend.BAD], [0]),
+            lambda: b.count("i", [5], [0]),
+        ])
+        bads = [g for g in got if isinstance(g, ValueError)]
+        goods = sorted(g[0] for g in got if isinstance(g, list))
+        assert len(bads) == 1
+        assert goods == [10, 50]
+
+    def test_group_failure_retries_individually(self):
+        """A whole-group dispatch failure re-dispatches each leg alone:
+        every good leg still resolves, through the isolation path."""
+        be = StubBackend()
+        be.fail_count_groups = True
+        b = ShardLegBatcher(be, window=0.25)
+        got = _run_threads([
+            lambda: b.count("i", [1], [0]),
+            lambda: b.count("i", [2], [0]),
+        ])
+        assert sorted(g[0] for g in got) == [10, 20]
+
+    def test_bad_row_leg_fails_only_its_submitter(self):
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.25)
+        got = _run_threads([
+            lambda: b.row("i", "good", [0]),
+            lambda: b.row("i", StubBackend.BAD, [0]),
+        ])
+        bads = [g for g in got if isinstance(g, ValueError)]
+        assert len(bads) == 1
+        assert ("row", "good") in got
+
+    def test_bad_sync_leg_fails_only_its_dedupe_set(self):
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.25)
+        got = _run_threads([
+            lambda: b.bsi("bsi_sum", "i", "v", [0]),
+            lambda: b.bsi("bsi_sum", "i", "boom", [0]),
+        ])
+        bads = [g for g in got if isinstance(g, ValueError)]
+        assert len(bads) == 1
+        assert (100, 7) in got
+
+
+class TestTelemetry:
+    def _counters(self):
+        return dict(global_stats.snapshot()["counters"])
+
+    def test_occupancy_and_coalesce_counters(self):
+        before = self._counters()
+        be = StubBackend()
+        b = ShardLegBatcher(be, window=0.25)
+        got = _run_threads([
+            lambda: b.count("i", [1], [0]),
+            lambda: b.count("i", [2], [0]),
+            lambda: b.count("i", [3], [0]),
+        ])
+        assert sorted(g[0] for g in got) == [10, 20, 30]
+        after = self._counters()
+
+        def delta(name):
+            return after.get(name, 0.0) - before.get(name, 0.0)
+
+        assert delta('batch_legs_total{kind="count"}') == 3
+        # 3 legs in one launch group = 2 coalesced beyond the first.
+        assert delta('batch_coalesced_total{kind="count"}') == 2
+        snap = global_stats.histogram_snapshot()
+        occ = snap.get('batch_occupancy{kind="count"}')
+        assert occ is not None and occ["count"] >= 1
+
+    def test_histogram_mean_helper(self):
+        from pilosa_tpu.utils.stats import histogram_mean
+
+        assert histogram_mean({"sum": 12.0, "count": 3}) == 4.0
+        assert histogram_mean(
+            {"sum": 12.0, "count": 4}, {"sum": 2.0, "count": 2}
+        ) == 5.0
+        assert histogram_mean({"sum": 0.0, "count": 0}) is None
+
+
+# ---------------------------------------------------------------------------
+# Differential acceptance: batched == unbatched for every routed kind,
+# under concurrent submission through the real executor + device backend.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def device_backend_available():
+    """Skip (never error) where the device backend can't import — the
+    stub-backend half of this module must still run on a jax without
+    shard_map (the same gate tests/test_bench_smoke.py uses)."""
+    pytest.importorskip(
+        "pilosa_tpu.exec.tpu",
+        reason="device backend unavailable (jax.shard_map)",
+        exc_type=ImportError,
+    )
+
+
+@pytest.fixture
+def holder(tmp_path, device_backend_available):
+    from pilosa_tpu.core import Holder
+
+    h = Holder(str(tmp_path / "data")).open()
+    yield h
+    h.close()
+
+
+def _build_index(holder, rng):
+    from pilosa_tpu.core.field import options_for_int
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    idx = holder.create_index("i")
+    for fname, rows in (("f", (1, 2)), ("g", (9,))):
+        field = idx.create_field(fname)
+        for row in rows:
+            cols = np.unique(
+                rng.integers(0, 2 * SHARD_WIDTH, 2500, dtype=np.uint64)
+            )
+            field.import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+    v = idx.create_field("v", options_for_int(-1000, 1000))
+    cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 400, dtype=np.uint64))
+    v.import_value(cols, rng.integers(-900, 901, cols.size))
+
+
+DIFF_QUERIES = [
+    "Count(Intersect(Row(f=1), Row(g=9)))",
+    "Count(Row(f=2))",
+    "Row(f=1)",
+    "Union(Row(f=1), Row(g=9))",
+    "Intersect(Row(f=2), Row(g=9))",
+    "Sum(field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
+    "Sum(Row(f=1), field=v)",
+    "TopN(f, n=1)",
+    "TopN(f)",
+]
+
+
+class TestBatchedDifferential:
+    def test_batched_equals_unbatched_under_concurrency(self, holder, rng):
+        """The ISSUE r11 differential gate: every routed leg kind returns
+        byte-identical JSON through the batching plane (window > 0 so
+        the legs REALLY coalesce) and through the plain oracle path."""
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.exec.result import result_to_json
+        from pilosa_tpu.exec.tpu import TPUBackend
+
+        _build_index(holder, rng)
+        oracle = Executor(holder)
+        want = {q: result_to_json(oracle.execute("i", q)[0]) for q in DIFF_QUERIES}
+
+        be = TPUBackend(holder)
+        ex = Executor(holder, backend=be)
+        ex.batcher = ShardLegBatcher(be, window=0.2)
+        counters0 = dict(global_stats.snapshot()["counters"])
+
+        def run(q):
+            return lambda: result_to_json(ex.execute("i", q)[0])
+
+        got = _run_threads([run(q) for q in DIFF_QUERIES])
+        for q, g in zip(DIFF_QUERIES, got):
+            assert not isinstance(g, Exception), (q, g)
+            assert g == want[q], q
+        # The window really coalesced: at least one multi-leg group.
+        after = dict(global_stats.snapshot()["counters"])
+        coalesced = sum(
+            after.get(k, 0.0) - counters0.get(k, 0.0)
+            for k in after
+            if k.startswith("batch_coalesced_total")
+        )
+        assert coalesced >= 1
+
+    def test_row_batch_async_direct(self, holder, rng):
+        """row_batch_async alone: slot dedupe + scatter parity with
+        bitmap_call, including an unsupported call's fallback slot."""
+        from pilosa_tpu.exec.tpu import TPUBackend
+        from pilosa_tpu.pql import parse_string
+
+        _build_index(holder, rng)
+        be = TPUBackend(holder)
+        shards = [0, 1]
+        calls = [
+            parse_string("Row(f=1)").calls[0],
+            parse_string("Union(Row(f=1), Row(g=9))").calls[0],
+            parse_string("Row(f=1)").calls[0],  # dedupes with slot 0
+        ]
+        rows = be.row_batch_async("i", calls, shards)()
+        for c, row in zip(calls, rows):
+            want = be.bitmap_call("i", c, shards)
+            np.testing.assert_array_equal(
+                row.columns(), want.columns()
+            )
+        # Distinct legs never share a Row object (downstream mutates
+        # attrs/keys per query).
+        assert rows[0] is not rows[2]
+
+    def test_executor_single_query_via_batcher_matches(self, holder, rng):
+        """window=0 single legs through the executor: no coalescing, no
+        added latency path — results still oracle-identical."""
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.exec.result import result_to_json
+        from pilosa_tpu.exec.tpu import TPUBackend
+
+        _build_index(holder, rng)
+        be = TPUBackend(holder)
+        ex = Executor(holder, backend=be)
+        ex.batcher = ShardLegBatcher(be, window=0.0)
+        oracle = Executor(holder)
+        for q in DIFF_QUERIES:
+            assert result_to_json(ex.execute("i", q)[0]) == result_to_json(
+                oracle.execute("i", q)[0]
+            ), q

@@ -25,6 +25,9 @@ SMOKE_ENV = {
     "BENCH_HTTP_QUERIES_PER_REQ": "4",
     "BENCH_WRITE_RATES": "0,10",
     "BENCH_CHURN_SECONDS": "0.5",
+    # Tiny concurrency sweep: the leg's machinery (per-N checkpoints,
+    # occupancy/launch deltas) is what's smoked, not the scaling curve.
+    "BENCH_CONCURRENCY": "1,4",
     # A failed background warm must degrade the wire (dense fallback),
     # never hang the smoke on the warm poll.
     "BENCH_WARM_TIMEOUT": "120",
@@ -53,9 +56,15 @@ def test_bench_smoke(tmp_path):
     assert "cold_build_dense_seconds" in blob
     assert "churn_version_walks" in blob
     assert "minmax_churn_qps_ratio" in blob
+    # The r11 concurrency-sweep keys the driver's acceptance reads.
+    assert set(blob["qps_at_clients"]) == {"1", "4"}
+    assert "batch_occupancy_mean_at_clients" in blob
+    assert "device_launches_at_clients" in blob
+    assert "client_retries" in blob and "client_aborts" in blob
     # Every leg checkpointed along the way.
     for leg in ("build", "cold_build", "tpu_batch", "single_query",
-                "minmax_churn", "http"):
+                "minmax_churn", "http", "qps@1", "qps@4",
+                "concurrency_sweep"):
         assert leg in blob["legs_done"], blob["legs_done"]
     # The partial artifact also landed complete on disk.
     disk = json.loads(open(env["BENCH_PARTIAL_PATH"]).read())

@@ -328,6 +328,84 @@ class TestProtobufResponses:
             assert "error" in out
 
 
+class TestAdmissionControl:
+    """In-flight /query cap (ISSUE r11 satellite): past the cap the
+    server sheds deliberately — 429 + Retry-After + code=overloaded,
+    counted — instead of queueing toward an accept-path reset."""
+
+    def _fill(self, api, n):
+        for _ in range(n):
+            assert api.begin_query()
+
+    def test_shed_past_cap_then_recover(self, server):
+        from pilosa_tpu.utils.stats import global_stats
+
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(server, "POST", "/index/i/query", b"Set(1, f=1)", raw=True)
+        api = server.api
+        api.max_inflight_queries = 2
+        before = global_stats.snapshot()["counters"].get(
+            "http_requests_shed_total", 0.0
+        )
+        self._fill(api, 2)  # saturate the cap deterministically
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                req(server, "POST", "/index/i/query", b"Count(Row(f=1))", raw=True)
+            assert e.value.code == 429
+            assert e.value.headers.get("Retry-After") == "1"
+            body = json.loads(e.value.read())
+            assert body["code"] == "overloaded"
+            after = global_stats.snapshot()["counters"].get(
+                "http_requests_shed_total", 0.0
+            )
+            assert after - before == 1
+        finally:
+            api.end_query()
+            api.end_query()
+        # Slots freed: the same query is admitted and answers normally.
+        out = req(server, "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert out["results"] == [1]
+
+    def test_unbounded_by_default(self, server):
+        assert server.api.max_inflight_queries == 0
+        assert server.api.begin_query()
+        server.api.end_query()
+
+    def test_shed_keeps_keepalive_connection_usable(self, server):
+        """The shed 429 must drain the unread body: a keep-alive client's
+        NEXT request on the same socket must parse cleanly, not desync
+        into the shed request's body."""
+        import http.client
+
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        req(server, "POST", "/index/i/query", b"Set(1, f=1)", raw=True)
+        api = server.api
+        api.max_inflight_queries = 1
+        assert api.begin_query()
+        try:
+            conn = http.client.HTTPConnection(server.host, server.port)
+            conn.request(
+                "POST", "/index/i/query", b"Count(Row(f=1))",
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 429
+            resp.read()
+        finally:
+            api.end_query()
+        # Same connection, next request: admitted and correct.
+        conn.request(
+            "POST", "/index/i/query", b"Count(Row(f=1))",
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["results"] == [1]
+        conn.close()
+
+
 class TestRuntimeMonitor:
     def test_gauges_populate(self, server):
         from pilosa_tpu.utils.monitor import RuntimeMonitor

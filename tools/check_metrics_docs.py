@@ -47,6 +47,9 @@ METRIC_SUFFIXES = (
     "_total", "_seconds", "_bytes", "_pending", "_done",
     "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
     "_shards", "_evictions", "_rederives", "_state",
+    # Round 11: the batch_occupancy value histogram (legs/launch) and
+    # the http_inflight_queries admission gauge.
+    "_occupancy", "_queries",
 )
 
 _CALL_RE = re.compile(
