@@ -239,7 +239,14 @@ class HTTPBroadcaster:
         try:
             self._deliver(node, msg, payload)
         except Exception:
-            pass
+            # Async broadcast is best-effort by contract (missed nodes
+            # reconverge via gossip/anti-entropy) — but a silently
+            # diverging peer must still be visible on /metrics.
+            from pilosa_tpu.utils.stats import global_stats
+
+            global_stats.with_tags(f"peer:{node.id}").count(
+                "broadcast_async_errors_total"
+            )
 
     def send_to(self, node, msg: Message) -> None:
         self._deliver(node, msg)

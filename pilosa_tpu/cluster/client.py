@@ -229,7 +229,9 @@ class InternalClient:
                 try:
                     detail = e.read().decode("utf-8", "replace")
                     err_code = json.loads(detail).get("code", "")
-                except Exception:
+                except (OSError, ValueError, AttributeError):
+                    # Body unreadable / not JSON / not an object: the
+                    # status-only ClientError below is still correct.
                     pass
                 stats.with_tags(f"class:{e.code // 100}xx").count(
                     "peer_rpc_errors_total"

@@ -77,6 +77,7 @@ class Resizer:
                 Node(n.id, n.uri, n.is_coordinator, n.state)
                 for n in self.cluster.topology.nodes
             ] + [Node(node.id, node.uri, False)]
+            # lint: allow-lock-discipline(control plane: job mutations serialize across the announce RPCs by design; the data path never takes this lock)
             return self._start_job(new_nodes)
 
     def remove_node(self, node_id: str) -> int:
@@ -91,6 +92,7 @@ class Resizer:
                 for n in self.cluster.topology.nodes
                 if n.id != node_id
             ]
+            # lint: allow-lock-discipline(control plane: job mutations serialize across the announce RPCs by design; the data path never takes this lock)
             return self._start_job(new_nodes, removed=gone)
 
     def handle_join(self, node: Node) -> None:

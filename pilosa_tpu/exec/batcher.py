@@ -243,6 +243,11 @@ class ShardLegBatcher:
             try:
                 resolver()
             except Exception:
+                # Shared-launch resolution failed: visible on /metrics,
+                # then isolate so one bad query can't fail the window.
+                self.stats.with_tags(f"kind:{legs[0].kind}").count(
+                    "batch_dispatch_errors_total"
+                )
                 self._resolve_individually(legs)
 
     def _observe_group(self, kind: str, legs: list[_Leg]) -> None:
@@ -264,6 +269,9 @@ class ShardLegBatcher:
                 index, all_calls, list(shards)
             )
         except Exception:
+            self.stats.with_tags("kind:count").count(
+                "batch_dispatch_errors_total"
+            )
             self._resolve_individually(legs)
             return None
 
@@ -286,6 +294,9 @@ class ShardLegBatcher:
                 index, [leg.payload for leg in legs], list(shards)
             )
         except Exception:
+            self.stats.with_tags("kind:row").count(
+                "batch_dispatch_errors_total"
+            )
             self._resolve_individually(legs)
             return None
 

@@ -230,6 +230,7 @@ class Executor:
             if p99 is None:
                 return ""
             return f" p99={round(p99 * 1e3, 1)}ms"
+        # lint: allow-except-exception(slow-log p99 context is display-only; a stats bug must not fail the query)
         except Exception:  # noqa: BLE001 — context is best-effort
             return ""
 

@@ -46,7 +46,16 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # gate for older jax (pre-0.5): same API under
+    # experimental, except check_vma's old spelling check_rep.
+    from jax.experimental.shard_map import shard_map as _shard_map_compat
+
+    def shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_compat(f, **kw)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.core.cache import Pair
@@ -421,7 +430,7 @@ class _StackedBlocks:
                     led = self._ledger.get(key)
                     if led is not None:
                         led["access_count"] += 1
-                        led["last_access"] = time.time()
+                        led["last_access"] = time.monotonic()
                     return cached[1], cached[2]
                 latch = self._building.get(key)
                 if latch is None:
@@ -475,7 +484,7 @@ class _StackedBlocks:
         )
         led["uploads"] += 1
         led["access_count"] += 1
-        led["last_access"] = time.time()
+        led["last_access"] = time.monotonic()
 
     def peek(self, index: str, field_name: str,
              view_name: str = VIEW_STANDARD):
@@ -532,7 +541,10 @@ class _StackedBlocks:
         eviction-candidate order (served at /debug/hbm). _entries is the
         LRU (oldest-touched iterates first), so the listing order IS the
         order _evict would take victims."""
-        now = time.time()
+        # Idle arithmetic runs on the monotonic clock; ONE wall read maps
+        # idle ages onto the operator-facing lastAccess epoch stamps.
+        now = time.monotonic()
+        wall = time.time()  # lint: allow-monotonic-time(lastAccess is an operator-facing epoch display; idleSeconds math is monotonic)
         out = []
         with self._lock:
             for key, (_, arr, rows_p, _) in self._entries.items():
@@ -549,7 +561,7 @@ class _StackedBlocks:
                     "uploadEpoch": led["upload_epoch"],
                     "uploads": led["uploads"],
                     "accessCount": led["access_count"],
-                    "lastAccess": led["last_access"],
+                    "lastAccess": round(wall - (now - led["last_access"]), 3),
                     "idleSeconds": round(now - led["last_access"], 3),
                 }
                 if len(key) > 3 and key[3] == "row":
@@ -969,19 +981,20 @@ class TPUBackend:
             # critical path (ops/sparse.py; idempotent per device).
             warm_chunk_programs(self.blocks.device)
 
-    def _count_device_fallback(self, path: str, shape, err) -> None:
+    def _count_device_fallback(self, reason: str, shape, err) -> None:
         """Count (and log once per shape) a device-fast-path fallback so
         hardware-only regressions surface on /metrics instead of shipping
-        as silently-slow correct answers. Exported as
-        device_fallback_total{reason=...}."""
-        self.stats.with_tags(f"reason:{path}").count("device_fallback_total")
-        key = (path, shape)
+        as silently-slow correct answers. `reason` is a bounded code-path
+        label (pair_stats/groupn_pershard/...), never request content
+        (lint: metric-tags). Exported as device_fallback_total{reason=...}."""
+        self.stats.with_tags(f"reason:{reason}").count("device_fallback_total")
+        key = (reason, shape)
         if key not in self._fallback_logged:
             self._fallback_logged.add(key)
             if self.logger is not None:
                 self.logger.printf(
                     "device fast path %s fell back for shape %r: %s",
-                    path, shape, err,
+                    reason, shape, err,
                 )
 
     # -- spec + leaf assembly ---------------------------------------------

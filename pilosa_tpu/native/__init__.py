@@ -44,12 +44,14 @@ def _load() -> ctypes.CDLL | None:
                         # -march=native: the .so is built per host on
                         # first use, so host-specific vectorization is
                         # safe; retried without for exotic toolchains.
+                        # lint: allow-lock-discipline(one-time lazy toolchain build under the init latch; first callers accept the compile latency)
                         subprocess.run(
                             base[:2] + ["-march=native"] + base[2:],
                             check=True,
                             capture_output=True,
                         )
                     except subprocess.CalledProcessError:
+                        # lint: allow-lock-discipline(same one-time lazy build, -march fallback)
                         subprocess.run(base, check=True, capture_output=True)
                 lib = ctypes.CDLL(_LIB)
                 lib.pilosa_fnv32a.restype = ctypes.c_uint32
@@ -101,6 +103,7 @@ def _load() -> ctypes.CDLL | None:
                 ]
                 _lib = lib
                 return _lib
+            # lint: allow-except-exception(toolchain probe: loop retries a forced rebuild, then the fallback warns and pure-Python continues)
             except Exception:
                 # A stale/wrong-arch .so can fail to load: retry once with a
                 # forced rebuild before giving up on the native path.

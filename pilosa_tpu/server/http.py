@@ -37,8 +37,9 @@ _TOKEN_RE = re.compile(r"[!#$%&'*+\-.^_`|~0-9A-Za-z]+")
 _PPROF = None
 _PPROF_LOCK = threading.Lock()
 
-#: Process start, for /debug/vars uptime.
-_START_TIME = time.time()
+#: Process start, for /debug/vars uptime — monotonic: uptime is a
+#: duration, an NTP step must not dent it (lint: monotonic-time).
+_START_TIME = time.monotonic()
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -613,6 +614,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # ring served the span to the assembler.
                 try:
                     span.set_tag("node", self._local_node_id())
+                # lint: allow-except-exception(span node-tagging is best-effort display metadata)
                 except Exception:  # noqa: BLE001 — tagging is best-effort
                     pass
                 try:
@@ -1020,7 +1022,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         out = {
             "version": __version__,
-            "uptimeSeconds": round(time.time() - _START_TIME, 3),
+            "uptimeSeconds": round(time.monotonic() - _START_TIME, 3),
         }
         out.update(global_stats.snapshot())
         self._reply(out)
@@ -1285,7 +1287,7 @@ class _Handler(BaseHTTPRequestHandler):
             # member's entry must not be the one missing version/uptime.
             out = {
                 "version": __version__,
-                "uptimeSeconds": round(time.time() - _START_TIME, 3),
+                "uptimeSeconds": round(time.monotonic() - _START_TIME, 3),
             }
             out.update(global_stats.snapshot())
             return out
@@ -1496,6 +1498,7 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body()
         try:
             msg = Message.from_bytes(body)
+        # lint: allow-except-exception(delivered as the structured bad-frame 400 the sender's wire renegotiation keys on)
         except Exception:
             # Structured parse-failure code BEFORE any side effect: the
             # sender's wire negotiation (broadcast.py _deliver) retries

@@ -33,7 +33,10 @@ from pilosa_tpu.utils.stats import (
 )
 
 # Single source of process uptime for gauges AND /debug/diagnostics.
-PROCESS_STARTED_AT = time.time()
+# Monotonic (ISSUE r12 lint: monotonic-time): uptime is a DURATION —
+# an NTP step must never make it jump. Every timestamp in this module
+# (snapshot ring, exemplar ages, burn windows) shares this clock.
+PROCESS_STARTED_AT = time.monotonic()
 
 #: Multi-window burn-rate horizons (the classic fast/slow alert pair):
 #: the fast window catches a sudden burn before it torches the budget,
@@ -132,7 +135,7 @@ class RuntimeMonitor:
         """Retain the current bucket vectors of every SLO-relevant
         series. Called from the poll loop AND from /debug/slo scrapes,
         so windows accrue even on a server without the poller thread."""
-        now = time.time()
+        now = time.monotonic()
         with self._snap_lock:
             if (
                 not force
@@ -174,7 +177,7 @@ class RuntimeMonitor:
         retained snapshot at least window_s old; a younger monitor
         truncates the window to what it has actually seen — reported,
         never silently widened."""
-        now = time.time()
+        now = time.monotonic()
         current: Optional[list[float]] = None
         for name, ent in now_snap.items():
             if self._series_matches(name, metric):
@@ -261,8 +264,9 @@ class RuntimeMonitor:
             # older than the objective window are dropped — cumulative
             # buckets remember yesterday's outage forever, and pointing
             # an operator at a long-evicted trace as evidence for a
-            # CURRENT burn is worse than no exemplar at all.
-            now = time.time()
+            # CURRENT burn is worse than no exemplar at all. Exemplar
+            # stamps are monotonic (utils/stats.py) — same clock as now.
+            now = time.monotonic()
             exemplars = []
             for name, se in now_snap.items():
                 if not self._series_matches(name, metric):
@@ -294,7 +298,7 @@ class RuntimeMonitor:
         s.gauge("runtime_rss_bytes", _rss_bytes())
         s.gauge("runtime_threads", threading.active_count())
         s.gauge("runtime_open_fds", _open_fds())
-        s.gauge("runtime_uptime_seconds", time.time() - self.started_at)
+        s.gauge("runtime_uptime_seconds", time.monotonic() - self.started_at)
         counts = gc.get_count()
         s.gauge("runtime_gc_gen0_pending", counts[0])
         collected = sum(st.get("collected", 0) for st in gc.get_stats())
@@ -331,6 +335,7 @@ class RuntimeMonitor:
         while not self._stop.wait(self.interval):
             try:
                 self.poll_once()
+            # lint: allow-except-exception(poll-loop crash barrier: a gauge bug must never kill the monitor thread)
             except Exception:  # noqa: BLE001 — gauges must never kill the loop
                 pass
 
@@ -364,6 +369,7 @@ def _device_inventory() -> dict:
             }
             try:
                 mem = d.memory_stats()
+            # lint: allow-except-exception(jax memory_stats raises backend-specific types; diagnostics must not 500)
             except Exception:  # noqa: BLE001 — CPU devices have none
                 mem = None
             if mem:
@@ -391,7 +397,7 @@ def diagnostics_snapshot(holder=None, started_at: Optional[float] = None) -> dic
         },
         "jax": _device_inventory(),
         "uptime_seconds": round(
-            time.time() - (started_at or PROCESS_STARTED_AT), 1
+            time.monotonic() - (started_at or PROCESS_STARTED_AT), 1
         ),
         "rss_bytes": _rss_bytes(),
         "threads": threading.active_count(),

@@ -92,7 +92,10 @@ class QueryProfile:
         # (?remote=true): its phases still attribute, but it must NOT
         # feed the whole-query latency series (see _export).
         self.remote = False
-        self.started_at = time.time()
+        # Epoch stamp by contract: /debug/queries serves startedAt as a
+        # wall-clock time operators correlate with logs; durations come
+        # from the separate perf_counter t0 below.
+        self.started_at = time.time()  # lint: allow-monotonic-time(startedAt is an operator-facing epoch display stamp)
         self._t0 = time.perf_counter()
         self.phases: dict[str, float] = {}
         self.counters: dict[str, int] = {}

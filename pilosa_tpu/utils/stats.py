@@ -149,7 +149,7 @@ class _Histogram:
         self.counts = [0] * (len(BUCKET_BOUNDS) + 1)
         self.sum = 0.0
         self.count = 0
-        # bucket index -> (trace_id, observed value, unix time)
+        # bucket index -> (trace_id, observed value, monotonic time)
         self.exemplars: dict[int, tuple[str, float, float]] = {}
 
 
@@ -201,9 +201,13 @@ class StatsClient:
         if _exemplar_provider is not None:
             try:
                 trace_id = _exemplar_provider()
+            # lint: allow-except-exception(exemplar provider is best-effort; a tracer bug must not fail the hot observe path)
             except Exception:  # noqa: BLE001 — exemplars are best-effort
                 trace_id = None
-        exemplar = (trace_id, value, time.time()) if trace_id else None
+        # Monotonic stamp: exemplar times only ever feed AGE arithmetic
+        # (utils/monitor.py SLO windows, /debug/slo ageS) — never an
+        # epoch display (lint: monotonic-time).
+        exemplar = (trace_id, value, time.monotonic()) if trace_id else None
         r = self._root
         key = self._key(name)
         with r._lock:

@@ -34,7 +34,7 @@ class Span:
         # timescale to order spans from different machines (and to report
         # the observed clock skew when a child appears to start before
         # its remote parent).
-        self.start = time.time()
+        self.start = time.time()  # lint: allow-monotonic-time(cross-node span ordering needs a shared epoch; skew is measured, not assumed)
         self.tags: dict = {}
         self.duration = None
 

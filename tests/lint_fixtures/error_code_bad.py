@@ -1,0 +1,22 @@
+"""error-code fixture: JSON error replies bypassing the code contract."""
+
+
+class Handler:
+    def _reply(self, obj, status=200, content_type="application/json",
+               headers=None):
+        pass
+
+    def handle_no_code(self):
+        # BAD: 500 JSON body without a literal "code" field.
+        self._reply({"error": "boom"}, status=500)
+
+    def handle_retryable_bypass(self):
+        # BAD: 503 outside _error loses the Retry-After contract.
+        self._reply({"error": "down", "code": "unavailable"}, status=503)
+
+    def handle_ok_proto(self):
+        # fine: non-JSON content type is exempt.
+        self._reply(b"\x00", status=500, content_type="application/x-protobuf")
+
+    def handle_ok(self):
+        self._reply({"ok": True})

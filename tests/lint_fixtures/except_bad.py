@@ -1,0 +1,30 @@
+"""except-exception fixture: silently swallowed broad catches."""
+
+
+def silent(fn):
+    try:
+        return fn()
+    except Exception:  # BAD: error object never referenced, no raise,
+        return None    # no *_errors_total count, no waiver
+
+
+def bare(fn):
+    try:
+        return fn()
+    except:  # noqa: E722 — BAD: bare except eats KeyboardInterrupt
+        return None
+
+
+def ok_reraise(fn):
+    try:
+        return fn()
+    except Exception:
+        raise
+
+
+def ok_logged(fn, log):
+    try:
+        return fn()
+    except Exception as e:
+        log.printf("fixture: %s", e)  # delivered: referenced, visible
+        return None

@@ -1,0 +1,24 @@
+"""jax-dispatch fixture: host-sync and recompile hazards."""
+
+import jax
+import jax.numpy as jnp
+
+# BAD: jnp work at module import time.
+_TABLE = jnp.arange(128)
+
+
+def hot_path(x):
+    # BAD: jit compiled and invoked inline — re-traces every call.
+    y = jax.jit(lambda a: a + 1)(x)
+    # BAD: per-element host sync.
+    return y[0].item()
+
+
+def serve_batch(backend, calls):
+    # BAD: raw occupancy shape into a batched entry point.
+    return backend.count_batch_async(calls, len(calls))
+
+
+def good_builder(body):
+    # fine: builder returns the program; callers memoize.
+    return jax.jit(body)

@@ -1,0 +1,29 @@
+"""lock-discipline fixture: a seeded AB/BA deadlock + sleep under lock.
+
+Thread 1 runs ab() (holds X, wants Y); thread 2 runs ba() (holds Y,
+wants X) — the classic interleaving deadlock the static graph must
+flag as a cycle.
+"""
+
+import threading
+import time
+
+_lock_x = threading.Lock()
+_lock_y = threading.Lock()
+
+
+def ab():
+    with _lock_x:
+        with _lock_y:
+            return 1
+
+
+def ba():
+    with _lock_y:
+        with _lock_x:
+            return 2
+
+
+def slow_under_lock():
+    with _lock_x:
+        time.sleep(0.1)  # BAD: every other acquirer stalls behind this

@@ -1,0 +1,10 @@
+"""metric-tags fixture: cardinality bombs in with_tags arguments."""
+
+
+def emit(stats, query: str, url: str, peer: str):
+    # BAD: unknown tag key (not in the documented vocabulary).
+    stats.with_tags("shardset:everything").count("fixture_total")
+    # BAD: raw request content as a tag value.
+    stats.with_tags(f"node:{url}").count("fixture_total")
+    # fine: documented key, bounded value.
+    stats.with_tags(f"peer:{peer}").count("fixture_total")

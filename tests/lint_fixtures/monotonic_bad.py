@@ -1,0 +1,16 @@
+"""monotonic-time fixture: wall clock fed into duration arithmetic."""
+
+import time
+
+
+def elapsed(t0: float) -> float:
+    return time.time() - t0  # BAD: NTP step changes the "duration"
+
+
+def deadline_in(seconds: float) -> float:
+    return time.time() + seconds  # BAD: wall-clock deadline
+
+
+def stamped() -> float:
+    # GOOD: a reasoned waiver — test_lint asserts it is consumed.
+    return time.time()  # lint: allow-monotonic-time(fixture epoch stamp)

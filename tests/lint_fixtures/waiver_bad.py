@@ -1,0 +1,17 @@
+"""waiver-syntax / unused-waiver fixture."""
+
+import time
+
+
+def fine() -> int:
+    # BAD (waiver-syntax): waiver without a reason.
+    x = 1  # lint: allow-monotonic-time
+    # BAD (waiver-syntax): waiver naming an unknown rule.
+    y = 2  # lint: allow-made-up-rule(whatever)
+    # BAD (unused-waiver): nothing on this line violates the rule.
+    z = 3  # lint: allow-except-exception(stale permission)
+    return x + y + z
+
+
+def used() -> float:
+    return time.time()  # lint: allow-monotonic-time(consumed by design)

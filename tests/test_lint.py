@@ -1,0 +1,326 @@
+"""Tier-1 wiring for tools/lint (ISSUE r12 tentpole): the repo tree must
+lint clean, and every checker must prove it fires on its seeded
+known-bad fixture — a rule that cannot demonstrate a catch is dead
+weight. Mirrors the tests/test_metrics_docs.py pattern that established
+the statically-checked-invariant convention.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import time as _time
+
+import pytest
+
+from tools.lint import core
+from tools.lint.checkers import make_checkers
+from tools.lint.checkers.error_codes import ErrorCodeChecker
+from tools.lint.checkers.exceptions import ExceptDisciplineChecker
+from tools.lint.checkers.jax_dispatch import JaxDispatchChecker
+from tools.lint.checkers.lock_discipline import LockDisciplineChecker
+from tools.lint.checkers.metrics import (
+    TagCardinalityChecker,
+    metrics_docs_drift,
+)
+from tools.lint.checkers.monotonic_time import MonotonicTimeChecker
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+ALL_RULES = {c.rule for c in make_checkers()}
+
+
+def load_fixture(name: str) -> core.SourceFile:
+    return core.SourceFile.load(FIXTURES / name, ALL_RULES)
+
+
+# ---------------------------------------------------------------------------
+# The gate: the shipped tree is clean (and fast enough for tier-1).
+# ---------------------------------------------------------------------------
+
+
+class TestRepoClean:
+    def test_repo_tree_lints_clean(self):
+        t0 = _time.monotonic()
+        violations = core.run_lint(make_checkers())
+        dt = _time.monotonic() - t0
+        report = "\n".join(v.render() for v in violations)
+        assert not violations, f"lint violations in the repo tree:\n{report}"
+        # The suite must stay cheap enough to gate every PR.
+        assert dt < 30.0, f"lint suite too slow for tier-1: {dt:.1f}s"
+
+    def test_lock_graph_cycle_free_is_asserted(self):
+        """The acceptance-criteria property specifically: zero
+        lock-discipline findings over pilosa_tpu/ (cycles, re-entry,
+        blocking-under-lock) modulo reasoned waivers."""
+        violations = core.run_lint(
+            make_checkers(), rules={"lock-discipline"}
+        )
+        report = "\n".join(v.render() for v in violations)
+        assert not violations, report
+
+
+# ---------------------------------------------------------------------------
+# Each checker fires on its seeded fixture.
+# ---------------------------------------------------------------------------
+
+
+class TestCheckersFire:
+    def test_monotonic_time_fixture(self):
+        f = load_fixture("monotonic_bad.py")
+        got = list(MonotonicTimeChecker().check_file(f))
+        assert len(got) == 2  # two bad sites; the waivered one is not
+        assert all(v.rule == "monotonic-time" for v in got)
+        assert any(w.used for w in f.waivers)  # good waiver consumed
+
+    def test_error_code_fixture(self):
+        f = load_fixture("error_code_bad.py")
+        got = list(ErrorCodeChecker().check_file(f))
+        msgs = " | ".join(v.message for v in got)
+        assert len(got) == 2
+        assert "without a literal" in msgs      # codeless 500
+        assert "bypasses _error" in msgs        # direct 503
+
+    def test_error_code_funnel_structural(self):
+        """A server/http.py whose _error lost Retry-After is flagged."""
+        src = (
+            "class H:\n"
+            "    def _error(self, msg, status=400, code=''):\n"
+            "        self._reply({'error': msg, 'code': code},\n"
+            "                    status=status)\n"
+        )
+        f = core.SourceFile(
+            path=pathlib.Path("http.py"),
+            rel="pilosa_tpu/server/http.py",
+            text=src, tree=ast.parse(src),
+        )
+        got = list(ErrorCodeChecker().check_file(f))
+        assert any("Retry-After" in v.message for v in got)
+
+    def test_jax_dispatch_fixture(self):
+        f = load_fixture("jax_dispatch_bad.py")
+        got = list(JaxDispatchChecker().check_file(f))
+        msgs = " | ".join(v.message for v in got)
+        assert "module import time" in msgs
+        assert ".item()" in msgs
+        assert "compiled and called inline" in msgs
+        assert "raw len(...)" in msgs
+        assert len(got) == 4  # the returned-builder pattern is NOT flagged
+
+    def test_lock_cycle_fixture(self):
+        """The seeded AB/BA cycle — the acceptance-criteria fixture."""
+        f = load_fixture("lock_cycle_bad.py")
+        got = list(LockDisciplineChecker().finalize([f]))
+        msgs = " | ".join(v.message for v in got)
+        assert "lock-order cycle" in msgs
+        assert "_lock_x" in msgs and "_lock_y" in msgs
+        assert "time.sleep" in msgs  # blocking under lock, same fixture
+
+    def test_except_fixture(self):
+        f = load_fixture("except_bad.py")
+        got = list(ExceptDisciplineChecker().check_file(f))
+        assert len(got) == 2  # silent broad catch + bare except
+        msgs = " | ".join(v.message for v in got)
+        assert "swallows" in msgs
+        assert "bare `except:`" in msgs
+
+    def test_metric_tags_fixture(self):
+        f = load_fixture("metric_tags_bad.py")
+        got = list(TagCardinalityChecker().check_file(f))
+        assert len(got) == 2
+        msgs = " | ".join(v.message for v in got)
+        assert "unknown tag key" in msgs
+        assert "unbounded cardinality" in msgs
+
+    def test_metric_docs_drift_detects_both_directions(self):
+        doc = "catalogue: `real_total` and `phantom_total`."
+        findings = metrics_docs_drift(
+            src={"real_total", "undocumented_total"}, doc_text=doc
+        )
+        blob = "\n".join(findings)
+        assert "emitted but not documented: undocumented_total" in blob
+        assert "documented but not emitted: phantom_total" in blob
+        # DYNAMIC_FAMILIES doc-mention guard is live too.
+        assert any("dynamic family" in x for x in findings)
+
+
+# ---------------------------------------------------------------------------
+# Waiver machinery: validated as used-and-reasoned.
+# ---------------------------------------------------------------------------
+
+
+class TestWaivers:
+    def test_waiver_missing_reason_and_unknown_rule(self):
+        f = load_fixture("waiver_bad.py")
+        msgs = " | ".join(v.message for v in f.waiver_errors)
+        assert "has no reason" in msgs
+        assert "unknown rule 'made-up-rule'" in msgs
+
+    def test_unused_waiver_reported(self):
+        got = core.run_lint(
+            make_checkers(), paths=[str(FIXTURES / "waiver_bad.py")]
+        )
+        unused = [v for v in got if v.rule == "unused-waiver"]
+        assert len(unused) == 1
+        assert "except-exception" in unused[0].message
+        # ...and the consumed monotonic waiver is NOT flagged unused.
+        assert not any(
+            v.rule == "unused-waiver" and "monotonic" in v.message
+            for v in got
+        )
+
+    def test_waiver_on_own_line_covers_next_statement(self):
+        src = (
+            "import time\n"
+            "def f():\n"
+            "    # lint: allow-monotonic-time(own-line waiver)\n"
+            "    return time.time()\n"
+        )
+        f = core.SourceFile(
+            path=pathlib.Path("x.py"), rel="pilosa_tpu/x.py",
+            text=src, tree=ast.parse(src),
+        )
+        f._parse_waivers(ALL_RULES)
+        assert not list(MonotonicTimeChecker().check_file(f))
+        assert f.waivers[0].used
+
+
+# ---------------------------------------------------------------------------
+# Framework: registry, CLI, --changed fast mode.
+# ---------------------------------------------------------------------------
+
+
+class TestFramework:
+    def test_registry_rules_unique_and_documented(self):
+        checkers = make_checkers()
+        rules = [c.rule for c in checkers]
+        assert len(rules) == len(set(rules)) == 7
+        for c in checkers:
+            assert c.rule and c.doc, f"{type(c).__name__} lacks rule/doc"
+
+    def test_cli_exit_codes(self, capsys):
+        from tools.lint.__main__ import main
+
+        assert main([]) == 0  # clean tree
+        assert "lint clean" in capsys.readouterr().out
+        assert main([str(FIXTURES / "except_bad.py"),
+                     "--rule", "except-exception"]) == 1
+        out = capsys.readouterr().out
+        assert "[except-exception]" in out
+        assert "violation(s)" in out
+        assert main(["--list-rules"]) == 0
+        assert "lock-discipline" in capsys.readouterr().out
+
+    def test_changed_mode_lints_only_changed_files(self, monkeypatch):
+        monkeypatch.setattr(
+            core, "_git_changed_files",
+            lambda: [FIXTURES / "except_bad.py"],
+        )
+        got = core.run_lint(make_checkers(), changed=True,
+                            rules={"except-exception"})
+        assert {v.path for v in got if v.rule == "except-exception"} == {
+            "tests/lint_fixtures/except_bad.py"
+        }
+        # And an empty change set is a clean no-op, not an error.
+        monkeypatch.setattr(core, "_git_changed_files", lambda: [])
+        assert core.run_lint(make_checkers(), changed=True,
+                             rules={"except-exception"}) == []
+
+    def test_parse_error_reported_not_raised(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        got = core.run_lint(make_checkers(), paths=[str(bad)])
+        assert any(v.rule == "parse" for v in got)
+
+    def test_missing_path_reported_not_raised(self, tmp_path):
+        got = core.run_lint(
+            make_checkers(), paths=[str(tmp_path / "does_not_exist.py")]
+        )
+        assert len(got) == 1 and got[0].rule == "parse"
+        assert "cannot read" in got[0].message
+
+
+class TestReviewRegressions:
+    """Fixes from the r12 review pass, pinned."""
+
+    def test_version_gate_compat_def_not_import_time(self):
+        """A def nested under a module-level try:/except ImportError:
+        only runs when called — the natural spelling of a jax
+        version gate must not trip import-jnp."""
+        src = (
+            "import jax\n"
+            "try:\n"
+            "    from jax import shard_map\n"
+            "except ImportError:\n"
+            "    def shard_map(f, **kw):\n"
+            "        return jax.experimental.shard_map.shard_map(f, **kw)\n"
+        )
+        f = core.SourceFile(
+            path=pathlib.Path("x.py"), rel="pilosa_tpu/exec/x.py",
+            text=src, tree=ast.parse(src),
+        )
+        assert not [
+            v for v in JaxDispatchChecker().check_file(f)
+            if "import time" in v.message
+        ]
+        # ...while a call under the SAME try: block still fires.
+        src2 = "import jax.numpy as jnp\ntry:\n    T = jnp.arange(4)\nexcept Exception:\n    T = None\n"
+        f2 = core.SourceFile(
+            path=pathlib.Path("y.py"), rel="pilosa_tpu/exec/y.py",
+            text=src2, tree=ast.parse(src2),
+        )
+        assert any(
+            "import time" in v.message
+            for v in JaxDispatchChecker().check_file(f2)
+        )
+
+    def test_stale_lock_waiver_on_unheld_blocking_site_is_unused(
+        self, tmp_path, monkeypatch
+    ):
+        """A lock-discipline waiver on a blocking call that holds no
+        lock was never needed: on a FULL-tree run it must surface as
+        unused-waiver, not be silently consumed by the propagation
+        filter. (Judged only on full runs — the tmp dir stands in as
+        the default tree.)"""
+        p = tmp_path / "stale.py"
+        p.write_text(
+            "import subprocess\n"
+            "def build():\n"
+            "    subprocess.run(['true'])  "
+            "# lint: allow-lock-discipline(stale permission)\n"
+        )
+        monkeypatch.setattr(core, "DEFAULT_TREE", str(tmp_path))
+        got = core.run_lint(make_checkers(),
+                            rules={"lock-discipline"})
+        assert [v.rule for v in got] == ["unused-waiver"]
+
+    def test_subset_run_does_not_misjudge_cross_file_lock_waivers(self):
+        """Linting one file (--changed shape) must not flag resize.py's
+        lock-discipline waivers as unused just because the consuming
+        edge runs through the unlinted cluster/client.py."""
+        got = core.run_lint(
+            make_checkers(), paths=["pilosa_tpu/cluster/resize.py"]
+        )
+        assert not [v for v in got if v.rule == "unused-waiver"], [
+            v.render() for v in got
+        ]
+
+
+# ---------------------------------------------------------------------------
+# The shim: existing check_metrics_docs invocations keep working.
+# ---------------------------------------------------------------------------
+
+
+class TestShim:
+    def test_shim_delegates(self, capsys):
+        import importlib.util
+
+        path = core.REPO_ROOT / "tools" / "check_metrics_docs.py"
+        spec = importlib.util.spec_from_file_location("cmd_shim", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main() == 0
+        assert "clean" in capsys.readouterr().out
+        # The legacy API surface the old tests rely on is still there.
+        assert "peer_rpc_seconds" in mod.source_metrics()
+        exact, wild = mod.doc_tokens()
+        assert exact and wild

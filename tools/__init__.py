@@ -1,0 +1,3 @@
+"""Developer tooling for the pilosa-tpu repo (lint plane, probes, bench
+helpers). Package-shaped so `python -m tools.lint` works from the repo
+root."""

@@ -1,151 +1,52 @@
 #!/usr/bin/env python3
-"""Static metric-name drift check (ISSUE r8 satellite): every metric the
-code emits must appear in docs/observability.md, and every metric-shaped
-name the docs catalogue must exist in code — wired into tier-1 as a test
-(tests/test_metrics_docs.py) so the catalogue can never rot.
+"""Static metric-name drift check — THIN SHIM.
 
-Source side: literal first-argument names of StatsClient calls
-(count/gauge/timing/histogram/timer/remove_gauge) anywhere under
-pilosa_tpu/. Dynamic (f-string) names are exempt and listed in
-DYNAMIC_FAMILIES with the doc spelling that covers them.
-
-Docs side: backticked tokens in docs/observability.md whose shape is a
-metric name (optionally pilosa_-prefixed, optional {tags}, optional
-histogram/exporter suffix _bucket/_count/_sum/_p50/_p95/_p99/_p999 — a
-histogram family's three exposition series collapse to ONE documented
-name) AND that end in one of the metric suffixes below — bench JSON
-keys, env knobs, and file names in the same docs do not match. A doc
-token `prefix_*` is a wildcard covering every source name that starts
-with `prefix_`.
-
-Exit 0 clean; exit 1 with a report of both drift directions.
+The implementation moved into the lint plane (ISSUE r12 checker 6):
+tools/lint/checkers/metrics.py, runnable as part of
+`python -m tools.lint` (rule `metric-docs`). This entry point keeps
+existing invocations — CI scripts, tests/test_metrics_docs.py, operator
+muscle memory — working unchanged: same module-level API
+(source_metrics / doc_tokens / DYNAMIC_FAMILIES), same exit codes, same
+two-way drift report.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent
-SRC_DIR = ROOT / "pilosa_tpu"
-DOC = ROOT / "docs" / "observability.md"
+# Runnable both as `python tools/check_metrics_docs.py` (sys.path[0] is
+# tools/) and via importlib from the tests: anchor the repo root.
+_ROOT = str(Path(__file__).resolve().parent.parent)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-#: Metric families emitted with computed (f-string) names: the checker
-#: cannot read them statically, so each must keep a doc mention of the
-#: spelled-out family (asserted below so the exemption itself can't rot).
-DYNAMIC_FAMILIES = {
-    # executor.py: stats.count(f"query_{call.name}_total")
-    "query_<Call>_total",
-}
-
-#: A doc token must end in one of these to be treated as a metric name
-#: (after stripping the histogram/exporter suffixes _bucket/_count/_sum/
-#: _p50/_p95/_p99/_p999, so a plain-JSON field like `device_count` does
-#: not match).
-METRIC_SUFFIXES = (
-    "_total", "_seconds", "_bytes", "_pending", "_done",
-    "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
-    "_shards", "_evictions", "_rederives", "_state",
-    # Round 11: the batch_occupancy value histogram (legs/launch) and
-    # the http_inflight_queries admission gauge.
-    "_occupancy", "_queries",
+from tools.lint.checkers.metrics import (  # noqa: E402,F401 — re-exported API
+    DOC,
+    DYNAMIC_FAMILIES,
+    METRIC_SUFFIXES,
+    SYNTHESIZED,
+    doc_tokens,
+    metrics_docs_drift,
+    source_metrics,
 )
-
-_CALL_RE = re.compile(
-    r"""\.(?:count|gauge|timing|histogram|timer|remove_gauge)\(\s*
-        ["']([a-z][a-z0-9_.]*)["']""",
-    re.VERBOSE,
-)
-
-_TOKEN_RE = re.compile(r"`([^`\n]+)`")
-
-_EXPORT_SUFFIX_RE = re.compile(r"_(?:bucket|count|sum|p50|p95|p99|p999)$")
-
-
-#: Series synthesized as literal exposition lines (no StatsClient call):
-#: the /metrics/cluster scrape-health pair. Each must still appear as a
-#: literal in the source, which source_metrics verifies.
-SYNTHESIZED = ("cluster_scrape_up", "cluster_scrape_seconds")
-
-
-def source_metrics() -> set[str]:
-    names: set[str] = set()
-    all_text = []
-    for path in sorted(SRC_DIR.rglob("*.py")):
-        text = path.read_text()
-        all_text.append(text)
-        for m in _CALL_RE.finditer(text):
-            names.add(m.group(1).replace(".", "_").replace("-", "_"))
-    blob = "\n".join(all_text)
-    for name in SYNTHESIZED:
-        if name in blob:
-            names.add(name)
-    return names
-
-
-def doc_tokens() -> tuple[set[str], set[str]]:
-    """(exact metric-shaped tokens, wildcard prefixes) from the doc."""
-    exact: set[str] = set()
-    wildcards: set[str] = set()
-    for tok in _TOKEN_RE.findall(DOC.read_text()):
-        tok = tok.strip()
-        tok = re.sub(r"\{[^}]*\}$", "", tok)  # strip {tags}
-        if tok.startswith("pilosa_"):
-            tok = tok[len("pilosa_"):]
-        if re.fullmatch(r"[a-z][a-z0-9_]*_\*", tok):
-            wildcards.add(tok[:-2])
-            continue
-        if not re.fullmatch(r"[a-z][a-z0-9_]*", tok):
-            continue
-        base = _EXPORT_SUFFIX_RE.sub("", tok)
-        if base.endswith(METRIC_SUFFIXES):
-            exact.add(base)
-    return exact, wildcards
 
 
 def main() -> int:
+    # One tree scan + one doc read (the checker module's DOC constant —
+    # no second copy of the path), shared between the drift check and
+    # the clean-path summary counts.
     src = source_metrics()
-    doc_exact, doc_wild = doc_tokens()
     doc_text = DOC.read_text()
-
-    undocumented = sorted(
-        n
-        for n in src
-        if n not in doc_exact
-        and not any(n.startswith(w) for w in doc_wild)
-    )
-    phantom = sorted(
-        t
-        for t in doc_exact
-        if t not in src
-        # A documented name may be an exporter-derived spelling of a
-        # real timing series (name_count/_sum/_p50/_p99 handled above)
-        # or a prefix another doc line spells exactly; anything else is
-        # a catalogue entry with no emitter.
-    )
-    missing_dynamic = sorted(f for f in DYNAMIC_FAMILIES if f not in doc_text)
-
-    ok = True
-    if undocumented:
-        ok = False
-        print("EMITTED BUT NOT DOCUMENTED in docs/observability.md:")
-        for n in undocumented:
-            print(f"  {n}")
-    if phantom:
-        ok = False
-        print("DOCUMENTED BUT NOT EMITTED anywhere in pilosa_tpu/:")
-        for n in phantom:
-            print(f"  {n}")
-    if missing_dynamic:
-        ok = False
-        print("DYNAMIC FAMILY missing its doc mention:")
-        for n in missing_dynamic:
-            print(f"  {n}")
-    if ok:
-        print(f"metrics docs clean: {len(src)} emitted names, "
-              f"{len(doc_exact)} documented, {len(doc_wild)} wildcard families")
-    return 0 if ok else 1
+    findings = metrics_docs_drift(src=src, doc_text=doc_text)
+    if findings:
+        for line in findings:
+            print(line)
+        return 1
+    doc_exact, doc_wild = doc_tokens(doc_text)
+    print(f"metrics docs clean: {len(src)} emitted names, "
+          f"{len(doc_exact)} documented, {len(doc_wild)} wildcard families")
+    return 0
 
 
 if __name__ == "__main__":

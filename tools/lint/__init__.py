@@ -1,0 +1,16 @@
+"""pilint: project-invariant static analysis for the TPU serving plane.
+
+`python -m tools.lint` runs every checker over pilosa_tpu/ and exits
+0/1 with a per-rule report (file:line, rule id, fix hint). See
+docs/development.md for the rule catalogue and waiver syntax, and
+tools/lint/checkers/__init__.py for how to add a checker.
+"""
+
+from tools.lint.core import (  # noqa: F401
+    Checker,
+    SourceFile,
+    Violation,
+    collect_files,
+    run_lint,
+)
+from tools.lint.checkers import make_checkers  # noqa: F401

@@ -1,0 +1,65 @@
+"""CLI: python -m tools.lint [paths...] [--changed] [--rule ID]...
+
+Exit 0 when the tree is clean, 1 with a per-rule report otherwise —
+wired into tier-1 by tests/test_lint.py exactly like the metric drift
+check, so a new violation fails the build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from collections import Counter
+
+from tools.lint.checkers import make_checkers
+from tools.lint.core import run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="pilosa-tpu project-invariant static analysis",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: pilosa_tpu/)")
+    ap.add_argument("--changed", action="store_true",
+                    help="fast mode: only files changed vs git HEAD")
+    ap.add_argument("--rule", action="append", default=[],
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    checkers = make_checkers()
+    if args.list_rules:
+        width = max(len(c.rule) for c in checkers)
+        for c in checkers:
+            print(f"{c.rule:<{width}}  {c.doc}")
+        print(f"{'waiver-syntax':<{width}}  "
+              "malformed / reasonless / unknown-rule waiver comments")
+        print(f"{'unused-waiver':<{width}}  "
+              "waivers that no longer match any violation")
+        return 0
+
+    t0 = time.monotonic()
+    violations = run_lint(
+        checkers,
+        paths=args.paths or None,
+        changed=args.changed,
+        rules=set(args.rule) or None,
+    )
+    dt = time.monotonic() - t0
+    if not violations:
+        print(f"lint clean ({len(checkers)} checkers, {dt:.2f}s)")
+        return 0
+    for v in violations:
+        print(v.render())
+    by_rule = Counter(v.rule for v in violations)
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+    print(f"\n{len(violations)} violation(s): {summary} ({dt:.2f}s)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""Checker registry: one module per rule family, assembled here.
+
+Adding a checker: subclass tools.lint.core.Checker in a new module,
+set `rule`/`doc`/`scope`, implement check_file (per-module AST walk)
+and/or finalize (cross-file), and register an instance in
+make_checkers() below — it is the ONE registry every entry point (CLI,
+run_lint, tests) constructs from. Ship a known-bad fixture under
+tests/lint_fixtures/ and assert it fires in tests/test_lint.py — a
+checker that cannot demonstrate a catch is dead weight (see
+docs/development.md "Lint plane").
+"""
+
+from __future__ import annotations
+
+from tools.lint.checkers.error_codes import ErrorCodeChecker
+from tools.lint.checkers.exceptions import ExceptDisciplineChecker
+from tools.lint.checkers.jax_dispatch import JaxDispatchChecker
+from tools.lint.checkers.lock_discipline import LockDisciplineChecker
+from tools.lint.checkers.metrics import MetricDocsChecker, TagCardinalityChecker
+from tools.lint.checkers.monotonic_time import MonotonicTimeChecker
+
+
+def make_checkers():
+    """Fresh checker instances (some carry per-run state)."""
+    return [
+        MonotonicTimeChecker(),
+        ErrorCodeChecker(),
+        JaxDispatchChecker(),
+        LockDisciplineChecker(),
+        ExceptDisciplineChecker(),
+        MetricDocsChecker(),
+        TagCardinalityChecker(),
+    ]

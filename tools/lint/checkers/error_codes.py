@@ -1,0 +1,231 @@
+"""error-code: every 4xx/5xx JSON body carries a machine-readable `code`.
+
+PR 4's contract: clients (cluster/client.py most of all — it routes
+retries and breaker decisions off the parsed `code`) never string-match
+error text. The HTTP layer centralizes this in `_Handler._error` (code +
+Retry-After on 429/503/504) and the `_CODE_BY_STATUS` fallback map; this
+checker keeps new reply sites from bypassing that funnel:
+
+- `_reply(...)` with an error status must be inside `_error`, carry a
+  non-JSON content type (protobuf query errors), or pass a dict literal
+  containing a "code" key.
+- Retryable statuses (429/503/504) may ONLY go out through `_error` —
+  a site-local reply would silently drop Retry-After.
+- `_error(...)` / `APIError(status=...)` sites using a status the
+  `_CODE_BY_STATUS` map doesn't know must pass an explicit code= (the
+  runtime fallback would mint an uninformative "http-NNN").
+- Structural: `_error` itself must keep the Retry-After branch covering
+  {429, 503, 504}.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.lint.core import Checker, SourceFile, Violation, const_int
+
+_RETRYABLE = {429, 503, 504}
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _enclosing_functions(tree: ast.AST) -> dict[int, str]:
+    """line -> name of the innermost enclosing function, for funnel
+    checks ('is this call inside _error?')."""
+    spans: list[tuple[int, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spans.append((node.lineno, node.end_lineno or node.lineno, node.name))
+    out: dict[int, str] = {}
+    # Innermost wins: later (smaller) spans overwrite.
+    for lo, hi, name in sorted(spans, key=lambda s: (s[0], -(s[1]))):
+        for ln in range(lo, hi + 1):
+            out[ln] = name
+    return out
+
+
+def _dict_has_key(node: ast.expr, key: str) -> bool:
+    return isinstance(node, ast.Dict) and any(
+        isinstance(k, ast.Constant) and k.value == key for k in node.keys
+    )
+
+
+class ErrorCodeChecker(Checker):
+    rule = "error-code"
+    doc = ("4xx/5xx JSON bodies must carry a `code` field and retryable "
+           "statuses must route through _error for Retry-After")
+    scope = ("pilosa_tpu/server/http.py", "pilosa_tpu/server/api.py")
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        fn_of_line = _enclosing_functions(f.tree)
+        code_map_keys = self._code_map_keys(f)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            name = callee.attr if isinstance(callee, ast.Attribute) else (
+                callee.id if isinstance(callee, ast.Name) else None
+            )
+            if name == "_reply":
+                yield from self._check_reply(f, node, fn_of_line)
+            elif name in ("_error", "APIError"):
+                yield from self._check_coded_site(
+                    f, node, name, code_map_keys
+                )
+        if f.rel.endswith("server/http.py"):
+            yield from self._check_error_funnel(f)
+
+    # -- _reply sites ------------------------------------------------------
+
+    def _check_reply(self, f, node: ast.Call, fn_of_line) -> Iterable[Violation]:
+        status_node = _kwarg(node, "status")
+        if status_node is None and len(node.args) >= 2:
+            status_node = node.args[1]
+        status = const_int(status_node) if status_node is not None else None
+        if status is None or status < 400:
+            return
+        if fn_of_line.get(node.lineno) == "_error":
+            return
+        ctype = _kwarg(node, "content_type")
+        is_json = not (
+            isinstance(ctype, ast.Constant)
+            and isinstance(ctype.value, str)
+            and "json" not in ctype.value
+        )
+        if not is_json:
+            return
+        # Waivers are consulted only once a violation is established —
+        # a waiver on a compliant reply must surface as unused-waiver,
+        # not be silently eaten (code review r12).
+        if status in _RETRYABLE:
+            if f.waive(self.rule, node.lineno, node.end_lineno):
+                return
+            yield Violation(
+                rule=self.rule, path=f.rel, line=node.lineno,
+                message=f"direct _reply with retryable status {status} "
+                        "bypasses _error (no Retry-After header)",
+                hint="raise APIError(..., status=..., code=...) or call "
+                     "self._error(...) so 429/503/504 carry Retry-After",
+            )
+            return
+        body = node.args[0] if node.args else None
+        if body is None or not _dict_has_key(body, "code"):
+            if f.waive(self.rule, node.lineno, node.end_lineno):
+                return
+            yield Violation(
+                rule=self.rule, path=f.rel, line=node.lineno,
+                message=f"JSON error reply (status {status}) without a "
+                        "literal \"code\" field",
+                hint="route through self._error()/APIError so the body "
+                     "carries a machine-readable code",
+            )
+
+    # -- _error / APIError status coverage ---------------------------------
+
+    def _code_map_keys(self, f: SourceFile) -> Optional[set[int]]:
+        """Keys of the _CODE_BY_STATUS dict literal (http.py); None when
+        this file doesn't define it (api.py uses http.py's — the keys are
+        collected per-file, so api.py sites fall back to the shared
+        canonical set below)."""
+        for node in ast.walk(f.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "_CODE_BY_STATUS"
+                    for t in node.targets
+                )
+                and isinstance(node.value, ast.Dict)
+            ):
+                keys = {const_int(k) for k in node.value.keys}
+                keys.discard(None)
+                return keys
+        return None
+
+    #: api.py raises APIError without seeing http.py's map; this mirror
+    #: is asserted against the real map in finalize so it cannot drift.
+    CANONICAL_STATUSES = {400, 404, 409, 413, 429, 500, 501, 502, 503, 504}
+
+    def __init__(self):
+        self._seen_map_keys: Optional[set[int]] = None
+
+    def _check_coded_site(
+        self, f, node: ast.Call, name: str, map_keys: Optional[set[int]]
+    ) -> Iterable[Violation]:
+        if map_keys is not None:
+            self._seen_map_keys = map_keys
+        known = map_keys if map_keys is not None else self.CANONICAL_STATUSES
+        status_node = _kwarg(node, "status")
+        status = const_int(status_node) if status_node is not None else None
+        if status is None:
+            return  # default 400, covered
+        if _kwarg(node, "code") is not None:
+            return
+        if name == "_error" and len(node.args) >= 3:
+            return  # positional code
+        if status in known:
+            return
+        if f.waive(self.rule, node.lineno, node.end_lineno):
+            return
+        yield Violation(
+            rule=self.rule, path=f.rel, line=node.lineno,
+            message=f"{name} with status {status} has no explicit code= "
+                    "and no _CODE_BY_STATUS fallback entry",
+            hint="add code=\"...\" here, or teach _CODE_BY_STATUS the "
+                 "new status",
+        )
+
+    def finalize(self, files) -> Iterable[Violation]:
+        if (
+            self._seen_map_keys is not None
+            and self._seen_map_keys != self.CANONICAL_STATUSES
+        ):
+            yield Violation(
+                rule=self.rule, path="pilosa_tpu/server/http.py", line=1,
+                message="_CODE_BY_STATUS keys diverged from the checker's "
+                        f"mirror (map: {sorted(self._seen_map_keys)})",
+                hint="update CANONICAL_STATUSES in "
+                     "tools/lint/checkers/error_codes.py to match",
+            )
+        self._seen_map_keys = None
+
+    # -- structural: the Retry-After funnel --------------------------------
+
+    def _check_error_funnel(self, f: SourceFile) -> Iterable[Violation]:
+        err_fn = None
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "_error":
+                err_fn = node
+                break
+        if err_fn is None:
+            yield Violation(
+                rule=self.rule, path=f.rel, line=1,
+                message="server/http.py has no _error funnel method",
+                hint="keep the one place that attaches code + Retry-After",
+            )
+            return
+        mentions_retry_after = any(
+            isinstance(n, ast.Constant) and n.value == "Retry-After"
+            for n in ast.walk(err_fn)
+        )
+        covered: set[int] = set()
+        for n in ast.walk(err_fn):
+            if isinstance(n, (ast.Tuple, ast.Set, ast.List)):
+                vals = {const_int(e) for e in n.elts}
+                vals.discard(None)
+                if vals & _RETRYABLE:
+                    covered |= vals
+        missing = _RETRYABLE - covered
+        if not mentions_retry_after or missing:
+            yield Violation(
+                rule=self.rule, path=f.rel, line=err_fn.lineno,
+                message="_error no longer attaches Retry-After for all of "
+                        f"429/503/504 (missing: {sorted(missing) or 'header'})",
+                hint="retryable-by-contract statuses must tell the "
+                     "client when to come back (PR 4)",
+            )

@@ -1,0 +1,107 @@
+"""except-exception: broad catches must re-raise, count, or be waivered.
+
+A `except Exception` that swallows silently is how the Go reference's
+"panic trap" pattern degrades in Python: the crash disappears and the
+symptom surfaces three layers away as a stuck thread or a stale gauge.
+The contract here (ISSUE r12 checker 5): every broad handler must either
+
+- re-raise (any `raise` in the handler body),
+- deliver the exception onward (assign the caught exception object to
+  something — the batcher's leg.error rendezvous, collected error
+  lists), which is a re-raise by proxy at the waiter,
+- count into an `*_errors_total` / `*_failures_total` / `*_aborts_total`
+  metric so the crash is on /metrics, or
+- carry a waiver naming the crash barrier it implements
+  (`# lint: allow-except-exception(<barrier>)`).
+
+Bare `except:` is always a violation (it eats KeyboardInterrupt).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.lint.core import Checker, SourceFile, Violation
+
+_COUNTED_SUFFIXES = ("_errors_total", "_failures_total", "_aborts_total")
+
+
+def _counts_error_metric(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "count"
+            and n.args
+            and isinstance(n.args[0], ast.Constant)
+            and isinstance(n.args[0].value, str)
+            and n.args[0].value.endswith(_COUNTED_SUFFIXES)
+        ):
+            return True
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _delivers(handler: ast.ExceptHandler) -> bool:
+    """The caught exception object is stored somewhere (error rendezvous
+    / collected per-leg error) rather than dropped."""
+    name = handler.name
+    if not name:
+        return False
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Assign):
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+        if isinstance(n, ast.Call):
+            # e.g. failures.append({"error": str(e)}) — collected.
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+    return False
+
+
+class ExceptDisciplineChecker(Checker):
+    rule = "except-exception"
+    doc = ("broad `except Exception` must re-raise, deliver/collect the "
+           "error, count an *_errors_total metric, or waiver the barrier")
+    # Unscoped: the default tree is pilosa_tpu/ already; explicit paths
+    # (fixtures, --changed) must still be checkable.
+    scope = ("",)
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if f.waive(self.rule, node.lineno):
+                    continue
+                yield Violation(
+                    rule=self.rule, path=f.rel, line=node.lineno,
+                    message="bare `except:` catches KeyboardInterrupt/"
+                            "SystemExit",
+                    hint="catch Exception at most (and then re-raise, "
+                         "count, or waiver)",
+                )
+                continue
+            if not (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException")
+            ):
+                continue
+            if _reraises(node) or _counts_error_metric(node) or _delivers(node):
+                continue
+            if f.waive(self.rule, node.lineno):
+                continue
+            yield Violation(
+                rule=self.rule, path=f.rel, line=node.lineno,
+                message=f"broad `except {node.type.id}` swallows the "
+                        "error silently",
+                hint="narrow the exception tuple, re-raise, count an "
+                     "*_errors_total metric, or waiver the crash "
+                     "barrier: # lint: allow-except-exception(<barrier>)",
+            )

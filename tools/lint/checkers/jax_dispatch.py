@@ -1,0 +1,198 @@
+"""jax-dispatch: host-sync / recompile hazards in the device layers.
+
+The serving path is dispatch-bound (ROADMAP): one stray host sync or an
+unmemoized jit in exec/ or ops/ costs more than whole query's device
+work, and a shape keyed on raw occupancy recompiles per batch size —
+the exact failure PR 6's `_slot_bucket` power-of-two bucketing exists to
+prevent. Four sub-rules:
+
+- item-sync: `.item()` forces a device->host readback + pipeline stall;
+  read back whole arrays once via np.asarray at the readback point.
+- import-jnp: jnp/jax calls at module import time run device work (and
+  can initialize the backend) before the CLI chose a platform.
+- jit-inline: `jax.jit(...)` immediately called, or compiled in a
+  function that neither memoizes nor returns the program — XLA
+  recompiles on every invocation (seconds per call on real shapes).
+  The blessed patterns: builder functions that RETURN the program, and
+  memo stores (`d[key] = fn` / `d.setdefault(key, fn)`) anywhere in the
+  enclosing function chain.
+- raw-batch-len: a `len(...)` passed straight into a `*batch*` call is
+  an exact-occupancy shape; route it through `_slot_bucket(len(...))`
+  (or `_pad_shards`) so compiled signatures stay O(log Q).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.lint.core import Checker, SourceFile, Violation, call_root_name, dotted_name
+
+_BUCKET_WRAPPERS = {"_slot_bucket", "_pad_shards", "_padded_rows"}
+
+
+def _parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    out = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _enclosing_chain(node: ast.AST, parents) -> list[ast.AST]:
+    """Enclosing FunctionDefs, innermost first."""
+    chain = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            chain.append(cur)
+        cur = parents.get(cur)
+    return chain
+
+
+def _has_memo_store(fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Subscript) for t in n.targets
+        ):
+            return True
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "setdefault"
+        ):
+            return True
+    return False
+
+
+class JaxDispatchChecker(Checker):
+    rule = "jax-dispatch"
+    doc = ("host syncs, import-time jnp work, unmemoized jits, and "
+           "unbucketed batch shapes in the device layers")
+    scope = ("pilosa_tpu/exec/", "pilosa_tpu/ops/")
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        parents = _parents(f.tree)
+        yield from self._check_import_scope(f)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            yield from self._check_item(f, node)
+            yield from self._check_jit(f, node, parents)
+            yield from self._check_batch_len(f, node)
+
+    # -- .item() host sync -------------------------------------------------
+
+    def _check_item(self, f, node: ast.Call) -> Iterable[Violation]:
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            return
+        if f.waive(self.rule, node.lineno, node.end_lineno):
+            return
+        yield Violation(
+            rule=self.rule, path=f.rel, line=node.lineno,
+            message=".item() is a per-element device->host sync",
+            hint="read back once with np.asarray(...) at the readback "
+                 "boundary, or keep the value on device",
+        )
+
+    # -- import-time jnp/jax work ------------------------------------------
+
+    def _check_import_scope(self, f: SourceFile) -> Iterable[Violation]:
+        def import_time_calls(node):
+            """Calls that execute at import, at ANY nesting of module-
+            level control flow (try:/if:) — but never inside a function
+            or lambda body, which only runs when called (a version-gate
+            `try: ... except ImportError: def compat(...)` is fine)."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    root = call_root_name(child.func)
+                    name = dotted_name(child.func)
+                    if root in ("jnp", "jax") and name != "jax.jit":
+                        yield child
+                yield from import_time_calls(child)
+
+        for node in import_time_calls(f.tree):
+            if f.waive(self.rule, node.lineno, node.end_lineno):
+                continue
+            yield Violation(
+                rule=self.rule, path=f.rel, line=node.lineno,
+                message=f"{dotted_name(node.func)}(...) runs at module "
+                        "import time",
+                hint="device/backend work at import races platform "
+                     "selection; build lazily inside a function",
+            )
+
+    # -- unmemoized / inline jit -------------------------------------------
+
+    def _check_jit(self, f, node: ast.Call, parents) -> Iterable[Violation]:
+        if dotted_name(node.func) != "jax.jit":
+            return
+        parent = parents.get(node)
+        # jax.jit(...)(args): compiled and invoked in one expression —
+        # nothing retains the program, XLA re-traces every call.
+        inline_call = isinstance(parent, ast.Call) and parent.func is node
+        chain = _enclosing_chain(node, parents)
+        if not chain:
+            return  # module-level assignment: compiled once per process
+        memoized = any(_has_memo_store(fn) for fn in chain)
+        returned = self._under_return(node, parents)
+        if not inline_call and (memoized or returned):
+            return
+        if f.waive(self.rule, node.lineno, node.end_lineno):
+            return
+        if inline_call:
+            msg = "jax.jit(...)(...) compiled and called inline"
+        else:
+            msg = ("jax.jit result neither memoized nor returned by a "
+                   "builder")
+        yield Violation(
+            rule=self.rule, path=f.rel, line=node.lineno,
+            message=msg,
+            hint="cache the compiled program keyed by its shape "
+                 "signature (see TPUBackend._program / ops/sparse.py "
+                 "_get_prog)",
+        )
+
+    @staticmethod
+    def _under_return(node: ast.AST, parents) -> bool:
+        cur = node
+        while cur is not None:
+            if isinstance(cur, ast.Return):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            cur = parents.get(cur)
+        return False
+
+    # -- raw len() into batched call sites ---------------------------------
+
+    def _check_batch_len(self, f, node: ast.Call) -> Iterable[Violation]:
+        name = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        )
+        if "batch" not in name or name in _BUCKET_WRAPPERS:
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if (
+                isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id == "len"
+            ):
+                if f.waive(self.rule, arg.lineno, arg.end_lineno):
+                    continue
+                yield Violation(
+                    rule=self.rule, path=f.rel, line=arg.lineno,
+                    message=f"raw len(...) passed to {name}(): "
+                            "exact-occupancy shape recompiles per batch "
+                            "size",
+                    hint="wrap in _slot_bucket(len(...)) so slot counts "
+                         "pad to power-of-two buckets (PR 6)",
+                )

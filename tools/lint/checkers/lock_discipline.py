@@ -1,0 +1,543 @@
+"""lock-discipline: the Python stand-in for `go vet` + `-race`.
+
+The serving plane is thread-per-request over shared registries (stats,
+tracer, HBM cache, batcher queue, breaker table); the Go reference gets
+the race detector for free, we get this. Two properties are enforced
+statically over the WHOLE package:
+
+1. Lock-order safety. Every `with <lock>:` block is found (locks are
+   attributes/module globals assigned `threading.Lock()/RLock()/
+   Condition()`), a call graph is built with conservative name
+   resolution, and "holding A, (transitively) acquires B" becomes an
+   edge A->B. A cycle in that graph is an AB/BA deadlock waiting for
+   the right interleaving; re-acquiring a non-reentrant Lock (directly
+   or through a call chain) is a guaranteed one.
+
+2. No blocking under a lock. While any lock is held, neither the block
+   body nor anything it (transitively) calls may sleep, touch a socket
+   (send/recv/accept/connect/urlopen), run a subprocess, wait on an
+   Event/latch, join a thread, or dispatch to the device
+   (jax.device_put / block_until_ready) — the leader/follower batcher,
+   breaker registry, and histogram observe paths stay lock-cheap by
+   CONSTRUCTION, and this rule keeps them that way.
+
+Static analysis of dynamic Python is an under-approximation by nature:
+attribute calls resolve to the enclosing class first, then by unique
+name project-wide, then by a small-union fallback; names too generic to
+resolve (dict.get, list.append, ...) are skipped. That misses exotic
+dispatch — it does NOT miss the `with self._lock: self.other_method()`
+patterns real deadlocks are made of. False positives get a reasoned
+waiver at the `with` site.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from tools.lint.core import Checker, SourceFile, Violation, dotted_name
+
+#: Attribute/method names far too generic to resolve by name union —
+#: resolving `d.get(...)` to some class's `get` method would invent
+#: call-graph edges (and from them, phantom deadlocks).
+_GENERIC_NAMES = {
+    "get", "set", "pop", "popitem", "popleft", "appendleft", "items",
+    "keys", "values", "append", "extend", "insert", "remove", "sort",
+    "reverse", "copy", "clear", "update", "setdefault", "add",
+    "discard", "count", "index", "join", "split", "rsplit", "strip",
+    "lstrip", "rstrip", "startswith", "endswith", "encode", "decode",
+    "format", "replace", "read", "write", "readline", "readlines",
+    "close", "flush", "open", "search", "match", "fullmatch",
+    "findall", "finditer", "sub", "group", "groups", "start", "end",
+    "partition", "rpartition", "lower", "upper", "title", "tolist",
+    "astype", "reshape", "sum", "max", "min", "any", "all", "mean",
+    "nonzero", "item", "wait", "acquire", "release", "locked", "name",
+    "cancel", "put", "empty", "full", "qsize", "result", "submit",
+    "sleep", "is_set",
+    # DB-API cursor/connection methods (sqlite in store/): never the
+    # project's Executor.execute, which self-resolves above.
+    "execute", "executemany", "fetchone", "fetchall", "commit",
+    "rollback", "cursor",
+}
+
+#: Direct blocking operations (attribute name or dotted call).
+_BLOCKING_ATTRS = {
+    "recv": "socket recv", "recv_into": "socket recv",
+    "sendall": "socket send", "accept": "socket accept",
+    "connect": "socket connect", "makefile": "socket makefile",
+    "wait": "Event/Condition wait", "select": "select",
+    "block_until_ready": "device sync",
+}
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "urllib.request.urlopen": "urlopen",
+    "subprocess.run": "subprocess", "subprocess.Popen": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "select.select": "select",
+    "jax.device_put": "device dispatch",
+}
+#: .join() blocks only on thread-like receivers; "".join must not match.
+_JOIN_RECEIVER_HINTS = ("thread", "proc", "pool", "prewarm", "worker")
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+
+
+@dataclass
+class _Lock:
+    lock_id: str      # module.Class.attr | module.NAME | module.func.NAME
+    kind: str         # Lock | RLock | Condition
+    attr: str         # attribute / variable name
+    rel: str
+    line: int
+
+
+@dataclass
+class _Func:
+    func_id: str                  # module.(Class.)name(.nested)
+    rel: str
+    node: ast.AST
+    cls: Optional[str]            # enclosing class name
+    #: lock ids acquired directly anywhere in the body
+    acquires: set = field(default_factory=set)
+    #: (callee key, lineno, held lock ids at the call site)
+    calls: list = field(default_factory=list)
+    #: (lineno, description, held lock ids) for direct blocking ops
+    blocking: list = field(default_factory=list)
+    #: (lock_id, lineno, held-before tuple) per with-site
+    with_sites: list = field(default_factory=list)
+
+
+def _module_name(rel: str) -> str:
+    name = rel
+    for prefix in ("pilosa_tpu/",):
+        if name.startswith(prefix):
+            name = name[len(prefix):]
+    return name[:-3].replace("/", ".") if name.endswith(".py") else name
+
+
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    doc = ("static lock-acquisition graph: no cycles, no re-acquired "
+           "non-reentrant locks, no blocking calls while a lock is held")
+    # Unscoped: the default tree is pilosa_tpu/ already; explicit paths
+    # (fixtures, --changed) must still be checkable.
+    scope = ("",)
+    cross_file = True
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        return ()  # whole-project analysis; see finalize
+
+    # -- collection --------------------------------------------------------
+
+    def finalize(self, files: list[SourceFile]) -> Iterable[Violation]:
+        if not files:
+            return
+        self.locks: dict[str, _Lock] = {}          # lock_id -> _Lock
+        self.attr_locks: dict[str, list[str]] = {} # attr name -> lock ids
+        self.funcs: dict[str, _Func] = {}
+        self.methods: dict[str, list[str]] = {}    # method name -> func ids
+        self.module_funcs: dict[tuple, str] = {}   # (module, name) -> id
+        self.class_methods: dict[tuple, str] = {}  # (class, name) -> id
+        self.file_of: dict[str, SourceFile] = {f.rel: f for f in files}
+
+        for f in files:
+            self._collect(f)
+        for fn in self.funcs.values():
+            self._scan_function(fn)
+        # A waivered blocking site is accepted AT ITS SOURCE: drop it
+        # before the fixpoint so callers of the waivered function aren't
+        # re-flagged for a risk the waiver already owns (e.g. the native
+        # helper's one-time lazy compile). Only lock-held sites can
+        # consume a waiver — a blocking call under NO lock was never a
+        # violation, so a waiver there must surface as unused-waiver
+        # instead of being silently eaten (code review r12).
+        for fn in self.funcs.values():
+            fn.blocking = [
+                (line, desc, held) for line, desc, held in fn.blocking
+                if not (held and self._waived(fn.rel, line))
+            ]
+        trans_acq = self._transitive_acquires()
+        trans_blk = self._transitive_blocking()
+        yield from self._emit(files, trans_acq, trans_blk)
+
+    def _waived(self, rel: str, line: int) -> bool:
+        f = self.file_of.get(rel)
+        return f is not None and f.waive(self.rule, line)
+
+    def _collect(self, f: SourceFile) -> None:
+        mod = _module_name(f.rel)
+
+        def add_lock(lock_id, kind, attr, line):
+            self.locks[lock_id] = _Lock(lock_id, kind, attr, f.rel, line)
+            self.attr_locks.setdefault(attr, []).append(lock_id)
+
+        def visit(body, path: str, cls: Optional[str]):
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    visit(stmt.body, f"{path}.{stmt.name}" if path else stmt.name,
+                          stmt.name)
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fid = f"{mod}.{path}.{stmt.name}" if path else f"{mod}.{stmt.name}"
+                    fn = _Func(func_id=fid, rel=f.rel, node=stmt, cls=cls)
+                    self.funcs[fid] = fn
+                    self.methods.setdefault(stmt.name, []).append(fid)
+                    if cls is not None:
+                        self.class_methods.setdefault(
+                            (cls, stmt.name), fid
+                        )
+                    else:
+                        self.module_funcs[(mod, stmt.name)] = fid
+                    # Lock assignments + nested defs inside the function.
+                    self._collect_fn_locks(stmt, fid, cls, mod, add_lock)
+                    visit(
+                        [s for s in stmt.body
+                         if isinstance(s, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef))],
+                        f"{path}.{stmt.name}" if path else stmt.name,
+                        cls,
+                    )
+                elif isinstance(stmt, ast.Assign):
+                    kind = self._lock_ctor(stmt.value)
+                    if kind:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                add_lock(f"{mod}.{t.id}", kind, t.id,
+                                         stmt.lineno)
+
+        visit(f.tree.body, "", None)
+
+    def _collect_fn_locks(self, fn_node, fid, cls, mod, add_lock) -> None:
+        """Lock assignments in THIS function body only (nested defs get
+        their own pass with their own fid, so the id reflects the scope
+        the name actually lives in)."""
+        def walk_own(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk_own(child)
+
+        for n in walk_own(fn_node):
+            if not isinstance(n, ast.Assign):
+                continue
+            kind = self._lock_ctor(n.value)
+            if not kind:
+                continue
+            for t in n.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and cls is not None
+                ):
+                    add_lock(f"{mod}.{cls}.{t.attr}", kind, t.attr, n.lineno)
+                elif isinstance(t, ast.Name):
+                    # function-local lock (closure rendezvous)
+                    add_lock(f"{fid}.{t.id}", kind, t.id, n.lineno)
+
+    @staticmethod
+    def _lock_ctor(value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            return _LOCK_CTORS.get(dotted_name(value.func) or "")
+        return None
+
+    # -- per-function scan --------------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST, fn: _Func) -> Optional[str]:
+        """lock id for a `with <expr>:` context, or None (not a lock)."""
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            candidates = self.attr_locks.get(attr, [])
+            if not candidates:
+                return None
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                or fn.cls is not None
+            ):
+                # self.X — or a same-class alias like `r._lock` where r
+                # is the root instance: prefer the enclosing class's X.
+                for c in candidates:
+                    if f".{fn.cls}.{attr}" in c:
+                        return c
+            if len(candidates) == 1:
+                return candidates[0]
+            return None  # ambiguous attribute: don't invent edges
+        if isinstance(expr, ast.Name):
+            # innermost function-local, then enclosing funcs, then module
+            parts = fn.func_id.split(".")
+            for depth in range(len(parts), 0, -1):
+                cand = ".".join(parts[:depth]) + f".{expr.id}"
+                if cand in self.locks:
+                    return cand
+            mod = _module_name(fn.rel)
+            return f"{mod}.{expr.id}" if f"{mod}.{expr.id}" in self.locks else None
+        return None
+
+    def _resolve_call(self, call: ast.Call, fn: _Func) -> Optional[str]:
+        """callee func id, or None when unresolvable."""
+        mod = _module_name(fn.rel)
+        func = call.func
+        if isinstance(func, ast.Name):
+            fid = self.module_funcs.get((mod, func.id))
+            if fid:
+                return fid
+            # unique project-wide module function of that name
+            cands = [
+                v for (m, n), v in self.module_funcs.items() if n == func.id
+            ]
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            # self.m() resolves by the enclosing class BEFORE the
+            # generic-name filter: Executor.execute is a real project
+            # method even though bare `.execute(` usually means a DB
+            # cursor.
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and fn.cls is not None
+            ):
+                fid = self.class_methods.get((fn.cls, name))
+                if fid:
+                    return fid
+            if name in _GENERIC_NAMES or name.startswith("__"):
+                return None
+            cands = self.methods.get(name, [])
+            if len(cands) == 1:
+                return cands[0]
+            if 1 < len(cands) <= 4:
+                # Small SAME-MODULE union (e.g. StatsClient +
+                # NopStatsClient both define gauge): a synthetic union
+                # key resolved at fixpoint time. Cross-module unions are
+                # refused — merging roaring's Bitmap._put with the TPU
+                # cache's _put would smear device dispatch over the
+                # whole host bitmap layer and invent violations.
+                mods = {self.funcs[c].rel for c in cands if c in self.funcs}
+                if len(mods) == 1:
+                    return "|".join(sorted(cands))
+            return None
+        return None
+
+    def _blocking_desc(self, call: ast.Call) -> Optional[str]:
+        dn = dotted_name(call.func)
+        if dn in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[dn]
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in _BLOCKING_ATTRS:
+                return _BLOCKING_ATTRS[attr]
+            if attr == "join":
+                recv = call.func.value
+                rname = (recv.attr if isinstance(recv, ast.Attribute)
+                         else recv.id if isinstance(recv, ast.Name) else "")
+                if any(h in rname.lower() for h in _JOIN_RECEIVER_HINTS):
+                    return "thread join"
+        elif isinstance(call.func, ast.Name) and call.func.id == "urlopen":
+            return "urlopen"
+        return None
+
+    def _scan_function(self, fn: _Func) -> None:
+        def visit(node: ast.AST, held: tuple):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # closures run later, not under this lock
+            if isinstance(node, ast.With):
+                new = []
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    lock_id = self._resolve_lock(item.context_expr, fn)
+                    if lock_id is not None:
+                        fn.acquires.add(lock_id)
+                        fn.with_sites.append(
+                            (lock_id, item.context_expr.lineno, held)
+                        )
+                        new.append(lock_id)
+                inner = held + tuple(new)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                desc = self._blocking_desc(node)
+                if desc is not None:
+                    fn.blocking.append((node.lineno, desc, held))
+                else:
+                    callee = self._resolve_call(node, fn)
+                    if callee is not None:
+                        fn.calls.append((callee, node.lineno, held))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        body = getattr(fn.node, "body", [])
+        for stmt in body:
+            visit(stmt, ())
+
+    # -- fixpoints ----------------------------------------------------------
+
+    def _callee_ids(self, key: str) -> list[str]:
+        return key.split("|") if "|" in key else [key]
+
+    def _transitive_acquires(self) -> dict[str, set]:
+        trans = {fid: set(fn.acquires) for fid, fn in self.funcs.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fid, fn in self.funcs.items():
+                for key, _ln, _held in fn.calls:
+                    for callee in self._callee_ids(key):
+                        got = trans.get(callee)
+                        if got and not got <= trans[fid]:
+                            trans[fid] |= got
+                            changed = True
+        return trans
+
+    def _transitive_blocking(self) -> dict[str, Optional[str]]:
+        """func id -> description of a blocking op reachable from it."""
+        trans: dict[str, Optional[str]] = {}
+        for fid, fn in self.funcs.items():
+            trans[fid] = fn.blocking[0][1] if fn.blocking else None
+        changed = True
+        while changed:
+            changed = False
+            for fid, fn in self.funcs.items():
+                if trans[fid]:
+                    continue
+                for key, _ln, _held in fn.calls:
+                    for callee in self._callee_ids(key):
+                        d = trans.get(callee)
+                        if d:
+                            short = callee.rsplit(".", 1)[-1]
+                            trans[fid] = f"{d} (via {short})"
+                            changed = True
+                            break
+                    if trans[fid]:
+                        break
+        return trans
+
+    # -- violations ---------------------------------------------------------
+
+    def _emit(self, files, trans_acq, trans_blk) -> Iterable[Violation]:
+        edges: dict[tuple, list] = {}  # (A, B) -> [(rel, line)]
+        emitted: set[tuple] = set()    # (rel, line, message) dedupe
+        waived = self._waived
+
+        def once(v: Violation):
+            key = (v.path, v.line, v.message)
+            if key not in emitted:
+                emitted.add(key)
+                yield v
+
+        for fid, fn in self.funcs.items():
+            # direct nesting edges + non-reentrant re-acquisition
+            for lock_id, line, held in fn.with_sites:
+                for h in held:
+                    if h == lock_id:
+                        if self.locks[lock_id].kind == "Lock":
+                            if not waived(fn.rel, line):
+                                yield from once(Violation(
+                                    rule=self.rule, path=fn.rel, line=line,
+                                    message="re-acquires non-reentrant "
+                                            f"lock {lock_id} already held",
+                                    hint="guaranteed deadlock: use RLock "
+                                         "or restructure",
+                                ))
+                    else:
+                        edges.setdefault((h, lock_id), []).append(
+                            (fn.rel, line)
+                        )
+            # call-graph edges + blocking + re-entry through calls
+            for key, line, held in fn.calls:
+                if not held:
+                    continue
+                callee_acq = set()
+                for callee in self._callee_ids(key):
+                    callee_acq |= trans_acq.get(callee, set())
+                for h in held:
+                    for b in callee_acq:
+                        if b == h:
+                            if self.locks[b].kind == "Lock" and not waived(fn.rel, line):
+                                yield from once(Violation(
+                                    rule=self.rule, path=fn.rel, line=line,
+                                    message=f"call re-enters non-reentrant "
+                                            f"lock {b} through "
+                                            f"{key.rsplit('.', 1)[-1]}()",
+                                    hint="guaranteed deadlock: hoist the "
+                                         "call out of the locked region",
+                                ))
+                        else:
+                            edges.setdefault((h, b), []).append(
+                                (fn.rel, line)
+                            )
+                blk = None
+                for callee in self._callee_ids(key):
+                    blk = blk or trans_blk.get(callee)
+                if blk and not waived(fn.rel, line):
+                    yield from once(Violation(
+                        rule=self.rule, path=fn.rel, line=line,
+                        message=f"blocking call under lock "
+                                f"{held[-1]}: {blk}",
+                        hint="move the blocking work outside the locked "
+                             "region (collect under lock, act after)",
+                    ))
+            for line, desc, held in fn.blocking:
+                if held and not waived(fn.rel, line):
+                    yield from once(Violation(
+                        rule=self.rule, path=fn.rel, line=line,
+                        message=f"blocking call under lock {held[-1]}: "
+                                f"{desc}",
+                        hint="move the blocking work outside the locked "
+                             "region",
+                    ))
+        yield from self._cycles(edges, waived)
+
+    def _cycles(self, edges: dict, waived) -> Iterable[Violation]:
+        graph: dict[str, set] = {}
+        for (a, b), _sites in edges.items():
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        # DFS cycle detection (the lock graph is tiny).
+        color: dict[str, int] = {}
+        stack: list[str] = []
+        found: list[list[str]] = []
+
+        def dfs(n):
+            color[n] = 1
+            stack.append(n)
+            for m in graph.get(n, ()):
+                if color.get(m, 0) == 0:
+                    dfs(m)
+                elif color.get(m) == 1:
+                    found.append(stack[stack.index(m):] + [m])
+            stack.pop()
+            color[n] = 2
+
+        for n in sorted(graph):
+            if color.get(n, 0) == 0:
+                dfs(n)
+        seen = set()
+        for cyc in found:
+            key = frozenset(cyc)
+            if key in seen:
+                continue
+            seen.add(key)
+            sites = []
+            for a, b in zip(cyc, cyc[1:]):
+                sites.extend(edges.get((a, b), ()))
+            if sites and all(waived(rel, line) for rel, line in sites):
+                continue
+            rel, line = sites[0] if sites else ("pilosa_tpu", 1)
+            chain = " -> ".join(cyc)
+            yield Violation(
+                rule=self.rule, path=rel, line=line,
+                message=f"lock-order cycle: {chain}",
+                hint="an AB/BA deadlock under the right interleaving; "
+                     "impose one global acquisition order "
+                     + "; ".join(f"{r}:{l}" for r, l in sites[:4]),
+            )

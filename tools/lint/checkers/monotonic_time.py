@@ -1,0 +1,46 @@
+"""monotonic-time: wall-clock reads are waiver-only.
+
+PR 4 made every deadline/duration monotonic end to end
+(utils/deadline.py); a single `time.time()` fed into that arithmetic
+reintroduces the NTP-step bug class the refactor removed (a 2 s clock
+slew mid-query reads as a 2 s latency spike, or an instantly-expired
+deadline). Since the only legitimate wall-clock uses left are epoch
+STAMPS (trace span starts for cross-node ordering, /debug display
+fields), the rule is total: every `time.time()` call is a violation
+unless waivered with the reason it must be wall clock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.lint.core import Checker, SourceFile, Violation, dotted_name
+
+
+class MonotonicTimeChecker(Checker):
+    rule = "monotonic-time"
+    doc = ("time.time() in duration/deadline math breaks under clock "
+           "steps; monotonic everywhere, wall clock only by waiver")
+    # Unscoped: the default tree is pilosa_tpu/ already; explicit paths
+    # (fixtures, --changed) must still be checkable.
+    scope = ("",)
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in ("time.time", "datetime.datetime.now",
+                            "datetime.datetime.utcnow"):
+                continue
+            if f.waive(self.rule, node.lineno, node.end_lineno):
+                continue
+            yield Violation(
+                rule=self.rule, path=f.rel, line=node.lineno,
+                message=f"{name}() is wall clock",
+                hint="use time.monotonic() (durations/deadlines) or "
+                     "time.perf_counter() (fine timing); if this is a "
+                     "deliberate epoch stamp, waiver it: "
+                     "# lint: allow-monotonic-time(<why wall clock>)",
+            )

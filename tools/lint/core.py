@@ -1,0 +1,334 @@
+"""pilint core: per-file AST lint framework with validated waivers.
+
+The Go reference gets `go vet` + `-race` for free; this is the Python
+stand-in, specialized to THIS project's invariants (monotonic deadlines,
+`code`-field error bodies, jit dispatch hygiene, lock ordering, crash
+barriers, metric/doc drift). The framework is deliberately small:
+
+- A SourceFile wraps one parsed module: text, AST, and its waivers.
+- A Checker owns one rule id and yields Violations per file and/or once
+  per run (finalize, for cross-file analyses like the lock graph).
+- Waivers are `# lint: allow-<rule>(<reason>)` comments. They are data,
+  not escape hatches: a waiver with no reason is itself a violation, a
+  waiver naming an unknown rule is a violation, and a waiver no checker
+  consumed is a violation — so the waiver inventory can never rot into
+  a list of stale permissions (the failure mode of bare `# noqa`).
+
+A waiver suppresses violations on the physical line it shares; a waiver
+comment alone on its line covers the next statement line. Checkers call
+SourceFile.waive(rule, start, end) with the violating node's line span,
+so a waiver anywhere inside a multi-line statement counts.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+#: Default lint tree: the shipped package. tools/tests are linted only
+#: when named explicitly (fixtures are known-bad on purpose).
+DEFAULT_TREE = "pilosa_tpu"
+
+_WAIVER_RE = re.compile(
+    r"allow-(?P<rule>[a-z][a-z0-9-]*)"
+    r"(?:\((?P<reason>[^()]*)\))?"
+)
+_WAIVER_MARK = re.compile(r"#\s*lint:\s*(?P<body>.*)$")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str  # repo-relative, for stable reports
+    line: int
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+
+@dataclass
+class Waiver:
+    rule: str
+    reason: str
+    line: int        # line of the comment itself
+    applies_to: int  # line the waiver covers (next stmt for own-line comments)
+    used: bool = False
+
+
+@dataclass
+class SourceFile:
+    path: Path
+    rel: str
+    text: str
+    tree: Optional[ast.AST]
+    parse_error: Optional[str] = None
+    waivers: list[Waiver] = field(default_factory=list)
+    #: Waiver-syntax violations found while parsing comments.
+    waiver_errors: list[Violation] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Path, known_rules: Iterable[str]) -> "SourceFile":
+        text = path.read_text()
+        rel = str(path.resolve().relative_to(REPO_ROOT)) if path.resolve().is_relative_to(REPO_ROOT) else str(path)
+        try:
+            tree = ast.parse(text, filename=rel)
+            err = None
+        except SyntaxError as e:
+            tree, err = None, f"syntax error: {e}"
+        f = cls(path=path, rel=rel, text=text, tree=tree, parse_error=err)
+        f._parse_waivers(set(known_rules))
+        return f
+
+    def _parse_waivers(self, known_rules: set[str]) -> None:
+        """Collect `# lint: allow-<rule>(<reason>)` comments via the
+        tokenizer (never from string literals). Validates rule names and
+        the mandatory reason here, so a malformed waiver fails even when
+        its rule's checker finds nothing nearby."""
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [
+                (t.start[0], t.string, t.line)
+                for t in tokens
+                if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            return
+        lines = self.text.splitlines()
+        for lineno, comment, _src_line in comments:
+            mark = _WAIVER_MARK.search(comment)
+            if mark is None:
+                continue
+            body = mark.group("body")
+            matches = list(_WAIVER_RE.finditer(body))
+            if not matches:
+                self.waiver_errors.append(Violation(
+                    rule="waiver-syntax", path=self.rel, line=lineno,
+                    message=f"unparseable lint waiver comment: {comment.strip()!r}",
+                    hint="use `# lint: allow-<rule>(<reason>)`",
+                ))
+                continue
+            own_line = lines[lineno - 1].lstrip().startswith("#")
+            applies_to = lineno
+            if own_line:
+                # Comment-only line: the waiver covers the next
+                # non-blank, non-comment source line.
+                for nxt in range(lineno, len(lines)):
+                    stripped = lines[nxt].strip()
+                    if stripped and not stripped.startswith("#"):
+                        applies_to = nxt + 1
+                        break
+            for m in matches:
+                rule, reason = m.group("rule"), (m.group("reason") or "").strip()
+                if rule not in known_rules:
+                    self.waiver_errors.append(Violation(
+                        rule="waiver-syntax", path=self.rel, line=lineno,
+                        message=f"waiver names unknown rule {rule!r}",
+                        hint="rule ids are the checker names in "
+                             "`python -m tools.lint --list-rules`",
+                    ))
+                    continue
+                if not reason:
+                    self.waiver_errors.append(Violation(
+                        rule="waiver-syntax", path=self.rel, line=lineno,
+                        message=f"waiver for {rule!r} has no reason",
+                        hint="say WHY: `# lint: allow-"
+                             f"{rule}(<reason>)`",
+                    ))
+                    continue
+                self.waivers.append(Waiver(
+                    rule=rule, reason=reason, line=lineno,
+                    applies_to=applies_to,
+                ))
+
+    def waive(self, rule: str, start: int, end: Optional[int] = None) -> bool:
+        """True (and marks the waiver used) when a waiver for `rule`
+        covers any line in [start, end]."""
+        end = end if end is not None else start
+        for w in self.waivers:
+            if w.rule == rule and start <= w.applies_to <= end:
+                w.used = True
+                return True
+        return False
+
+
+class Checker:
+    """One rule. Subclasses set `rule`, `doc` (one-line rationale shown
+    in reports/--list-rules) and implement check_file and/or finalize."""
+
+    rule: str = ""
+    doc: str = ""
+    #: Repo-relative path prefixes this checker inspects ("" = all).
+    scope: tuple[str, ...] = ("",)
+    #: Project-level checkers (metric/doc drift) run even when only a
+    #: subset of files is linted — their subject is the whole repo.
+    project_level: bool = False
+    #: Cross-file checkers (the lock graph) need the whole tree to see
+    #: which waivers are genuinely consumed: on subset runs their
+    #: waivers are exempt from unused-waiver judging.
+    cross_file: bool = False
+
+    def in_scope(self, f: SourceFile) -> bool:
+        return any(f.rel.startswith(p) for p in self.scope)
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        return ()
+
+    def finalize(self, files: list[SourceFile]) -> Iterable[Violation]:
+        """Called once after every file was offered; `files` is the
+        in-scope subset. Cross-file rules report here."""
+        return ()
+
+
+def _git_changed_files() -> list[Path]:
+    """Changed-vs-HEAD python files (staged + unstaged + untracked) —
+    the --changed fast mode for pre-commit loops."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", str(REPO_ROOT), "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout
+    except (subprocess.SubprocessError, OSError):
+        return []
+    paths = []
+    for line in out.splitlines():
+        name = line[3:].split(" -> ")[-1].strip().strip('"')
+        if not name.endswith(".py"):
+            continue
+        if name.startswith("tests/lint_fixtures/"):
+            continue  # deliberately-bad fixtures are never lint targets
+        p = REPO_ROOT / name
+        if p.exists():
+            paths.append(p)
+    return paths
+
+
+def collect_files(
+    paths: Optional[list[str]] = None, changed: bool = False
+) -> list[Path]:
+    if changed:
+        return sorted(_git_changed_files())
+    if paths:
+        out: list[Path] = []
+        for raw in paths:
+            p = Path(raw)
+            if not p.is_absolute():
+                p = REPO_ROOT / p
+            if p.is_dir():
+                out.extend(sorted(p.rglob("*.py")))
+            else:
+                out.append(p)
+        return out
+    return sorted((REPO_ROOT / DEFAULT_TREE).rglob("*.py"))
+
+
+def run_lint(
+    checkers: list[Checker],
+    paths: Optional[list[str]] = None,
+    changed: bool = False,
+    rules: Optional[set[str]] = None,
+) -> list[Violation]:
+    """Run `checkers` over the selected tree; returns every violation
+    (rule violations + waiver-syntax + unused waivers), sorted."""
+    # Waiver validation knows EVERY registered rule, even under --rule
+    # filtering — a waiver for an unselected rule is not "unknown".
+    known_rules = {c.rule for c in checkers}
+    if rules:
+        checkers = [c for c in checkers if c.rule in rules]
+    active_rules = {c.rule for c in checkers}
+    files = []
+    violations: list[Violation] = []
+    for p in collect_files(paths, changed=changed):
+        if "__pycache__" in p.parts:
+            continue
+        try:
+            files.append(SourceFile.load(p, known_rules))
+        except OSError as e:
+            # A typo'd CLI path must be a reportable finding, not a
+            # traceback (the promised report format covers it).
+            violations.append(Violation(
+                rule="parse", path=str(p), line=1,
+                message=f"cannot read file: {e}",
+            ))
+    for f in files:
+        if f.parse_error:
+            violations.append(Violation(
+                rule="parse", path=f.rel, line=1, message=f.parse_error,
+            ))
+            continue
+        violations.extend(f.waiver_errors)
+    parsed = [f for f in files if f.tree is not None]
+    for checker in checkers:
+        in_scope = [f for f in parsed if checker.in_scope(f)]
+        for f in in_scope:
+            violations.extend(checker.check_file(f))
+        violations.extend(checker.finalize(in_scope))
+    # Unused waivers: a permission nothing needed anymore is drift.
+    # Judged only for rules whose checkers actually ran this invocation.
+    explicit_subset = bool(paths) or changed
+    for f in parsed:
+        for w in f.waivers:
+            if w.used or w.rule not in active_rules:
+                continue
+            if explicit_subset and any(
+                c.project_level or c.cross_file
+                for c in checkers
+                if c.rule == w.rule
+            ):
+                # Project-level/cross-file rules didn't see the whole
+                # tree on a subset run: a lock-discipline waiver whose
+                # consuming edge runs through an unlinted file would
+                # read as falsely unused (code review r12).
+                continue
+            violations.append(Violation(
+                rule="unused-waiver", path=f.rel, line=w.line,
+                message=f"waiver for {w.rule!r} matched no violation "
+                        f"(reason was: {w.reason!r})",
+                hint="delete the stale waiver, or move it onto the "
+                     "line it should cover",
+            ))
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+# -- shared AST helpers used by several checkers ---------------------------
+
+def call_root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of a dotted call target: `jnp.sum(x)` -> 'jnp',
+    `jax.lax.psum(...)` -> 'jax', `foo(...)` -> 'foo'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render `a.b.c` as 'a.b.c' (None for non-trivial expressions)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
