@@ -131,6 +131,13 @@ DEGRADED_SECONDS = float(os.environ.get("BENCH_DEGRADED_SECONDS", "3"))
 CONCURRENCY = [
     int(c) for c in os.environ.get("BENCH_CONCURRENCY", "1,16,64,256").split(",")
 ]
+# Ingest-under-load leg (ISSUE r8): window length, writer/reader client
+# counts, import batch rows, and the leg's own (disk-backed) shard count.
+INGEST_SECONDS = float(os.environ.get("BENCH_INGEST_SECONDS", "4"))
+INGEST_WRITERS = int(os.environ.get("BENCH_INGEST_WRITERS", "4"))
+INGEST_READERS = int(os.environ.get("BENCH_INGEST_READERS", "8"))
+INGEST_BATCH = int(os.environ.get("BENCH_INGEST_BATCH", "256"))
+INGEST_SHARDS = int(os.environ.get("BENCH_INGEST_SHARDS", "8"))
 
 WORDS = SHARD_WIDTH // 32
 
@@ -401,6 +408,16 @@ LEG_COUNTER_FAMILIES = (
     "hedged_requests_total",
     "deadline_exceeded_total",
     "write_replica_unavailable_total",
+    # Write-plane families (ISSUE r8): the ingest leg's shed/snapshot/
+    # recovery attribution — deliberate 429/503s and background rewrites
+    # instead of OOM or ingest stalls.
+    "import_shed_total",
+    "import_bits_total",
+    "import_values_total",
+    "wal_truncated_records_total",
+    "fragment_recovery_total",
+    "fragment_snapshots_total",
+    "fragment_snapshot_failures_total",
 )
 
 
@@ -1175,6 +1192,231 @@ def bench_degraded_qps() -> dict:
     }
 
 
+def bench_ingest_under_load() -> dict:
+    """Ingest-under-load leg (ISSUE r8 tentpole 5): sustained
+    `import_value` rows/s from INGEST_WRITERS HTTP writer clients WHILE
+    the concurrency-sweep read mix (3-ary intersect Counts) runs —
+    the production shape ROADMAP item 4 names, never exercised before.
+
+    Self-contained on a DISK-backed holder (the main bench holder is
+    memory-only, which has no WAL/snapshot plane at all): the leg
+    measures the real write path — unbuffered WAL appends, background
+    snapshot rewrites past MAX_OP_N, and the import admission gate
+    (max_import_bytes sized so concurrent writer bursts occasionally
+    shed, proving deliberate 429s under overload).
+
+    Captures: acknowledged rows/s, read qps + server-side read p99 for
+    a read-only window vs the churn window (the read-p99 delta), shed +
+    snapshot counter deltas, snapshot stall attribution (seconds spent
+    rewriting, from the fragment_snapshot_seconds histogram), and the
+    churn window's version-walk kinds (kind=full must stay flat — the
+    journal-compaction acceptance, ISSUE r8 tentpole 4)."""
+    import http.client as _hc
+    import shutil
+    import tempfile
+
+    from pilosa_tpu.exec.tpu import TPUBackend
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http import Server
+
+    tmp = tempfile.mkdtemp(prefix="pilosa-tpu-ingest-")
+    holder = Holder(tmp).open()
+    srv = None
+    warm = None
+    try:
+        idx = holder.create_index("ingest")
+        rng = np.random.default_rng(47)
+        n_per_shard = max(64, int(SHARD_WIDTH * min(DENSITY, 0.01)))
+        for fname, rows_n in (("f", ROWS), ("g", ROWS), ("h", 4)):
+            fobj = idx.create_field(fname)
+            for shard in range(INGEST_SHARDS):
+                cols = (
+                    np.unique(
+                        rng.integers(0, SHARD_WIDTH, n_per_shard, dtype=np.uint64)
+                    )
+                    + shard * SHARD_WIDTH
+                )
+                fobj.import_bits(
+                    rng.integers(0, rows_n, cols.size, dtype=np.uint64), cols
+                )
+        from pilosa_tpu.core.field import options_for_int
+
+        idx.create_field("v", options_for_int(-10000, 10000))
+        be = TPUBackend(holder)
+        ex = Executor(holder, backend=be)
+        ex.batcher = ShardLegBatcher(be)
+        api = API(holder, ex)
+        srv = Server(api, host="localhost", port=0).open()
+        qpath = "/index/ingest/query"
+        rng_q = np.random.default_rng(53)
+        tri = [
+            f"Count(Intersect(Row(f={int(rng_q.integers(0, ROWS))}), "
+            f"Row(g={int(rng_q.integers(0, ROWS))}), "
+            f"Row(h={int(rng_q.integers(0, 4))})))"
+            for _ in range(BATCH)
+        ]
+        bodies = [
+            "".join(tri[i : i + HTTP_QUERIES_PER_REQ])
+            for i in range(0, len(tri), HTTP_QUERIES_PER_REQ)
+        ]
+        warm = BenchConn("localhost", srv.port, qpath)
+        warm.post(bodies[0])
+
+        def read_window(seconds: float) -> tuple[float, Optional[dict]]:
+            hist0 = global_stats.histogram_snapshot()
+            counts = [0] * INGEST_READERS
+            deadline = time.time() + seconds
+
+            def client(k: int) -> None:
+                _bench_client_loop(
+                    "localhost", srv.port, qpath,
+                    lambda j: bodies[j % len(bodies)], deadline,
+                    lambda: counts.__setitem__(
+                        k, counts[k] + HTTP_QUERIES_PER_REQ
+                    ),
+                    start=k,
+                )
+
+            t0 = time.time()
+            with concurrent.futures.ThreadPoolExecutor(INGEST_READERS) as pool:
+                list(pool.map(client, range(INGEST_READERS)))
+            elapsed = time.time() - t0
+            server_ms = hist_quantiles_ms(
+                "http_request_duration_seconds", hist0,
+                tag='route="post_query"',
+            )
+            return sum(counts) / elapsed, server_ms
+
+        # -- window A: read-only baseline ---------------------------------
+        qps_ro, ro_ms = read_window(INGEST_SECONDS)
+
+        # -- window B: the same read mix + sustained value ingest ---------
+        def import_body(r: np.random.Generator) -> bytes:
+            shard = int(r.integers(0, INGEST_SHARDS))
+            cols = (
+                r.integers(0, SHARD_WIDTH, INGEST_BATCH)
+                + shard * SHARD_WIDTH
+            ).tolist()
+            vals = r.integers(-9000, 9001, INGEST_BATCH).tolist()
+            return json.dumps({"columnIDs": cols, "values": vals}).encode()
+
+        # Size the in-flight import-bytes cap UNDER the writers' worst-
+        # case concurrent demand so bursts genuinely shed: the leg
+        # proves deliberate 429s, not just their absence.
+        sample = import_body(np.random.default_rng(1))
+        api.max_import_bytes = max(1, (INGEST_WRITERS - 1)) * len(sample)
+        ipath = "/index/ingest/field/v/import"
+        rows_acked = [0] * INGEST_WRITERS
+        sheds_seen = [0] * INGEST_WRITERS
+        stop = threading.Event()
+
+        def writer(k: int) -> None:
+            r = np.random.default_rng(100 + k)
+            conn = _hc.HTTPConnection("localhost", srv.port)
+            try:
+                while not stop.is_set():
+                    body = import_body(r)
+                    try:
+                        conn.request(
+                            "POST", ipath, body,
+                            {"Content-Type": "application/json"},
+                        )
+                        resp = conn.getresponse()
+                        raw = resp.read()
+                    except (_hc.HTTPException, OSError):
+                        conn.close()
+                        conn = _hc.HTTPConnection("localhost", srv.port)
+                        continue
+                    if resp.status == 200:
+                        rows_acked[k] += INGEST_BATCH
+                    elif resp.status in (429, 503):
+                        sheds_seen[k] += 1
+                        try:
+                            ra = float(resp.getheader("Retry-After") or 0.02)
+                        except ValueError:
+                            ra = 0.02
+                        time.sleep(min(max(ra, 0.0), 0.2))
+                    else:
+                        # Raised in a daemon thread this would vanish
+                        # into the default excepthook and the leg would
+                        # report partial traffic as healthy — record it
+                        # for the main thread to re-raise after join.
+                        writer_errors.append(
+                            AssertionError(
+                                f"import answered {resp.status}: {raw[:200]}"
+                            )
+                        )
+                        return
+            finally:
+                conn.close()
+
+        writer_errors: list = []
+        walks0 = walk_totals()
+        hist_b0 = global_stats.histogram_snapshot()
+        counters_b0 = global_stats.snapshot()["counters"]
+        writers = [
+            threading.Thread(target=writer, args=(k,), daemon=True)
+            for k in range(INGEST_WRITERS)
+        ]
+        t0 = time.time()
+        for t in writers:
+            t.start()
+        qps_churn, churn_ms = read_window(INGEST_SECONDS)
+        stop.set()
+        for t in writers:
+            t.join(timeout=10)
+        elapsed = time.time() - t0
+        api.max_import_bytes = 0
+        if writer_errors:
+            raise writer_errors[0]
+        churn_walks = walk_delta(walks0, walk_totals())
+
+        def _cdelta(prefix: str) -> int:
+            return _batch_counter_delta(counters_b0, prefix)
+
+        # Snapshot stall attribution: seconds the background rewrites
+        # spent (histogram _sum delta) — the stall the ingest path no
+        # longer pays inline.
+        snap_s = 0.0
+        for name, ent in global_stats.histogram_snapshot().items():
+            if not name.startswith("fragment_snapshot_seconds"):
+                continue
+            base = hist_b0.get(name)
+            snap_s += ent["sum"] - (base["sum"] if base else 0.0)
+        rows_per_s = sum(rows_acked) / elapsed if elapsed > 0 else 0.0
+        p99_ro = (ro_ms or {}).get("p99_ms")
+        p99_churn = (churn_ms or {}).get("p99_ms")
+        return {
+            "ingest_rows_per_s": round(rows_per_s, 1),
+            "ingest_rows_acked": int(sum(rows_acked)),
+            "ingest_read_qps_read_only": round(qps_ro, 1),
+            "ingest_read_qps_under_load": round(qps_churn, 1),
+            "ingest_read_qps_ratio": round(qps_churn / qps_ro, 3)
+            if qps_ro else None,
+            "ingest_read_p99_ms_read_only": p99_ro,
+            "ingest_read_p99_ms_under_load": p99_churn,
+            "ingest_read_p99_delta_ms": round(p99_churn - p99_ro, 3)
+            if p99_ro is not None and p99_churn is not None else None,
+            "ingest_client_sheds_seen": int(sum(sheds_seen)),
+            "ingest_import_sheds": _cdelta("import_shed_total"),
+            "ingest_snapshots": _cdelta("fragment_snapshots_total"),
+            "ingest_snapshot_stall_seconds": round(snap_s, 3),
+            "ingest_version_walks": churn_walks,
+            "ingest_shards": INGEST_SHARDS,
+            "ingest_writers": INGEST_WRITERS,
+        }
+    finally:
+        # Server first: tearing the holder/dir out from under in-flight
+        # requests would bury the leg's real error in secondary
+        # tracebacks (and leak the listener).
+        if warm is not None:
+            warm.close()
+        if srv is not None:
+            srv.close()
+        holder.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     out: dict = {
         "partial": True,
@@ -1397,6 +1639,7 @@ def main():
     sweep["client_aborts"] = RETRIES["abort"]
     checkpoint("concurrency_sweep", **sweep)
     checkpoint("degraded_qps", **bench_degraded_qps())
+    checkpoint("ingest_under_load", **bench_ingest_under_load())
 
     out.update(
         {

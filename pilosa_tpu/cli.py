@@ -78,6 +78,10 @@ def cmd_server(args) -> int:
     api.query_timeout = cfg.query_timeout
     # In-flight /query admission cap (deliberate 429 shedding past it).
     api.max_inflight_queries = cfg.max_inflight
+    # Write-side admission: in-flight import bytes + pending-WAL depth
+    # caps (deliberate 429/503 import shedding — never OOM).
+    api.max_import_bytes = cfg.max_import_bytes
+    api.max_pending_wal = cfg.max_pending_wal
 
     # TLS (reference server/tlsconfig.go): certificate+key serve HTTPS;
     # peers are dialed with a CA-verified (or skip-verify) context. A

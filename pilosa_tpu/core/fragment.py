@@ -25,8 +25,14 @@ from pilosa_tpu.core.cache import Pair, new_cache, load_cache, save_cache, top_n
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.native import xxhash64
 from pilosa_tpu.roaring import Bitmap, serialize
-from pilosa_tpu.roaring.codec import OpWriter, deserialize
+from pilosa_tpu.roaring.codec import (
+    CorruptWalError,
+    OpWriter,
+    ReplayInfo,
+    deserialize,
+)
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+from pilosa_tpu.utils.logger import StandardLogger
 
 # Maximum op-log length before a snapshot rewrite (reference fragment.go:84).
 MAX_OP_N = 10000
@@ -55,6 +61,74 @@ import itertools
 
 _fragment_uids = itertools.count(1)
 
+#: Recovery events are rare (one per crashed fragment per restart) and
+#: operator-significant: log them unconditionally. Fragments have no
+#: per-instance logger seam; stderr is where the server logger writes
+#: anyway.
+_recovery_log = StandardLogger()
+
+
+class FragmentCorruptError(Exception):
+    """A fragment file whose damage is NOT the recoverable torn-tail
+    shape: snapshot-section corruption, or op-log corruption with valid
+    records after it. Opening must fail loudly — truncating past mid-log
+    damage would silently drop every record behind it (ISSUE r8
+    tentpole 1: never silent data loss)."""
+
+    def __init__(self, path: str, reason: str, cause: Exception):
+        super().__init__(f"fragment {path} is corrupt ({reason}): {cause}")
+        self.path = path
+        self.reason = reason
+
+
+class _WalBacklog:
+    """Process-wide count of WAL ops not yet absorbed by a snapshot —
+    the pending-WAL depth the import admission gate bounds (ISSUE r8
+    tentpole 3). Fragments report op_n deltas here (under their own
+    lock); the gauge publishes inside this leaf lock so two racing
+    updates can never publish out of order (same discipline as the
+    inflight-queries gauge, server/api.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ops = 0
+
+    def adjust(self, delta: int) -> None:
+        if not delta:
+            return
+        from pilosa_tpu.utils.stats import global_stats
+
+        with self._lock:
+            self._ops = max(0, self._ops + delta)
+            global_stats.gauge("wal_pending_ops", self._ops)
+
+    @property
+    def ops(self) -> int:
+        return self._ops
+
+
+WAL_BACKLOG = _WalBacklog()
+
+
+class _SnapshotPending:
+    """Process-wide count of fragments with a snapshot in flight
+    (`snapshot_pending` gauge): sustained nonzero means the rewrite
+    plane is falling behind the ingest rate."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def adjust(self, delta: int) -> None:
+        from pilosa_tpu.utils.stats import global_stats
+
+        with self._lock:
+            self._n = max(0, self._n + delta)
+            global_stats.gauge("snapshot_pending", self._n)
+
+
+_SNAPSHOT_PENDING = _SnapshotPending()
+
 
 class _WalFile:
     """Lazy, budget-managed WAL append handle.
@@ -82,7 +156,21 @@ class _WalFile:
                 register = True
             else:
                 register = False
-            n = self._fh.write(data)
+            # buffering=0 hands back a raw FileIO whose write() may be
+            # SHORT (signal interruption, pipe-ish limits): loop until
+            # the whole record is down, or a torn record could land with
+            # the process still healthy — the recovery contract only
+            # covers torn tails from crashes (ISSUE r8 satellite). The
+            # fragment lock serializes callers, so the loop's writes are
+            # contiguous and a record is never interleaved.
+            view = memoryview(data)
+            n = 0
+            while n < len(view):
+                wrote = self._fh.write(view[n:])
+                if wrote is None:  # non-raw file object: all-or-error
+                    n = len(view)
+                    break
+                n += wrote
         # Budget bookkeeping outside self._lock (see syswrap.file_opened
         # for the lock-order rationale).
         if register:
@@ -140,6 +228,17 @@ class Fragment:
         self.max_row_id = 0
         self.lock = threading.RLock()
         self._file = None
+        # Off-hot-path snapshotting (ISSUE r8 tentpole 2): one in-flight
+        # background rewrite at a time; close() joins it. The mutex
+        # serializes the rewrite itself (a sync snapshot() racing the
+        # background one must not interleave writes into the same temp
+        # file); order is always _snapshot_mutex -> self.lock.
+        self._snapshotting = False
+        self._snapshot_thread: Optional[threading.Thread] = None
+        self._snapshot_mutex = threading.Lock()
+        # op_n already reported into the process-wide WAL_BACKLOG.
+        self._backlog_reported = 0
+        self._closed = False
         # Bumped on every mutation; the TPU block cache uses it to decide
         # when a device re-upload is needed (see pilosa_tpu/ops/blocks.py).
         # uid is process-unique (never reused, unlike id()) for cache keys.
@@ -169,6 +268,11 @@ class Fragment:
     # -- lifecycle --------------------------------------------------------
 
     def open(self) -> "Fragment":
+        replay = ReplayInfo()
+        # A closed-then-reopened fragment must snapshot again — leaving
+        # the flag set would silently disable the rewrite plane and grow
+        # the WAL without bound.
+        self._closed = False
         if self.path is not None:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             # mmap-backed read (budgeted, reference syswrap): container
@@ -178,20 +282,62 @@ class Fragment:
 
             with read_buffer(self.path) as data:
                 if len(data):
-                    self.storage = deserialize(data)
+                    try:
+                        self.storage = deserialize(data, info=replay)
+                    except (CorruptWalError, ValueError) as e:
+                        # Snapshot-section damage, or op-log corruption
+                        # BEFORE the tail (CorruptWalError): truncation
+                        # would silently drop data — refuse structured.
+                        self._count_recovery("corrupt")
+                        reason = getattr(e, "reason", "storage")
+                        _recovery_log.printf(
+                            "fragment %s refuses to open: corrupt (%s): %s",
+                            self.path, reason, e,
+                        )
+                        raise FragmentCorruptError(self.path, reason, e) from e
+            if replay.torn_offset is not None:
+                # Torn tail (SIGKILL mid-append): the replay already
+                # stopped at the last good record — make the file match
+                # by truncating the partial record away, so the next
+                # open (and the WAL appender) see a consistent prefix.
+                self._truncate_torn_tail(replay)
             if not os.path.exists(self.path) or os.path.getsize(self.path) == 0:
                 # New file: write an empty-bitmap header so the op log that
                 # follows always has a valid roaring prefix (reference
-                # fragment.go openStorage writes the marshaled bitmap first).
-                with open(self.path, "wb") as f:
+                # fragment.go openStorage writes the marshaled bitmap
+                # first). tmp + os.replace: a crash mid-header-write must
+                # leave either no file or a whole header, never a torn
+                # prefix the next open would refuse (lint: durable-write).
+                tmp = self.path + ".tmp"
+                with open(tmp, "wb") as f:
                     f.write(serialize(self.storage))
+                os.replace(tmp, self.path)
             # Lazy, budgeted WAL appender: the fd opens on first write and
             # the process-wide file budget (utils/syswrap, reference
             # syswrap/os.go:30-60) can reclaim it — a 100k-fragment holder
             # must not pin 100k open fds.
             self._file = _WalFile(self.path)
             self.storage.op_writer = OpWriter(self._file)
-            load_cache(self.cache, self.path + CACHE_EXT)
+            if replay.ops_applied == 0:
+                load_cache(self.cache, self.path + CACHE_EXT)
+            else:
+                # Crash recovery applied WAL ops the flushed .cache never
+                # saw (save_cache only runs at clean close): the file is
+                # stale by exactly those ops. Don't trust it — fall
+                # through to the rebuild below (ISSUE r8 satellite).
+                # One outcome per open: a torn-tail open already counted
+                # as truncated.
+                if replay.torn_offset is None:
+                    self._count_recovery("replayed")
+                _recovery_log.printf(
+                    "fragment %s: replayed %d WAL op record(s); rank "
+                    "cache rebuilt from storage",
+                    self.path, replay.ops_applied,
+                )
+            # Replayed-but-unsnapshotted ops are pending WAL depth: the
+            # admission gate must see a crash-looped node's backlog.
+            self._backlog_reported = 0
+            self._report_backlog()
         mx = self.storage.max()
         self.max_row_id = mx // SHARD_WIDTH if self.storage.any() else 0
         # A missing/stale .cache (e.g. after a crash — it is only flushed
@@ -199,19 +345,78 @@ class Fragment:
         # rebuild from storage. (The reference tolerates stale caches
         # because Go flushes every minute, holder.go:506; a rebuild at open
         # is cheap here and strictly better.)
-        if self.cache_type != "none" and len(self.cache) == 0 and self.storage.any():
+        if self.cache_type != "none" and self.storage.any() and (
+            len(self.cache) == 0 or replay.ops_applied
+        ):
             for r in self.row_ids():
                 self.cache.bulk_add(r, self.row_count(r))
             self.cache.invalidate()
         return self
 
+    def _count_recovery(self, outcome: str) -> None:
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats.with_tags(f"outcome:{outcome}").count(
+            "fragment_recovery_total"
+        )
+
+    def _truncate_torn_tail(self, replay: ReplayInfo) -> None:
+        """Cut the detected partial final record off the WAL so the file
+        is exactly the consistent prefix the replay recovered to."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        dropped = os.path.getsize(self.path) - replay.torn_offset
+        # lint: allow-durable-write(in-place truncate IS the recovery op: it restores the consistent prefix, never writes data)
+        with open(self.path, "rb+") as f:
+            f.truncate(replay.torn_offset)
+            f.flush()
+            os.fsync(f.fileno())
+        global_stats.count("wal_truncated_records_total")
+        self._count_recovery("truncated")
+        _recovery_log.printf(
+            "fragment %s: torn WAL tail (%s) at offset %d — truncated %d "
+            "byte(s) back to the last good record",
+            self.path, replay.torn_reason, replay.torn_offset, dropped,
+        )
+
+    def _report_backlog(self) -> None:
+        """Publish this fragment's un-snapshotted op delta into the
+        process-wide WAL backlog. Called with self.lock held (or before
+        the fragment is shared, in open)."""
+        d = self.storage.op_n - self._backlog_reported
+        if d:
+            WAL_BACKLOG.adjust(d)
+            self._backlog_reported = self.storage.op_n
+
     def close(self) -> None:
+        # Mark closed FIRST so an in-flight background snapshot aborts
+        # at its next phase checkpoint instead of close() waiting out a
+        # full pointless O(storage) rewrite (delete_fragment holds
+        # view.lock across this call — stalling it stalls every new
+        # shard of the view). Then join outside the lock (the rewrite's
+        # splice phase needs the lock to observe the flag).
+        with self.lock:
+            self._closed = True
+        t = self._snapshot_thread
+        if t is not None and t is not threading.current_thread():
+            t.join()
         with self.lock:
             self.flush_cache()
             if self._file is not None:
+                # Flush before detaching: a buffered writer handed in by
+                # a test/tool must not lose its tail records on a clean
+                # close (ISSUE r8 satellite; the default unbuffered
+                # appender makes this a no-op).
+                if self.storage.op_writer is not None:
+                    self.storage.op_writer.flush()
                 self._file.close()
                 self._file = None
                 self.storage.op_writer = None
+            # This fragment's pending ops leave the live backlog with it
+            # (they are on disk and will replay at the next open).
+            if self._backlog_reported:
+                WAL_BACKLOG.adjust(-self._backlog_reported)
+                self._backlog_reported = 0
 
     def flush_cache(self) -> None:
         if self.path is not None and self.cache_type != "none":
@@ -220,31 +425,176 @@ class Fragment:
     # -- snapshotting -----------------------------------------------------
 
     def _increment_op_n(self) -> None:
-        if self.storage.op_n > MAX_OP_N:
-            self.snapshot()
+        # Called with self.lock held by every mutator. Past the op-log
+        # bound the rewrite runs OFF the ingest hot path (ISSUE r8
+        # tentpole 2): the old inline snapshot serialized the whole
+        # storage under the fragment lock, stalling the triggering
+        # import — and everything queued behind the lock — for a full
+        # rewrite. In-memory fragments keep the cheap inline reset.
+        self._report_backlog()
+        if self.storage.op_n <= MAX_OP_N:
+            return
+        if self.path is None:
+            # Memory-only: nothing to rewrite — reset inline under the
+            # already-held fragment lock. (Never route through
+            # snapshot() here: that takes _snapshot_mutex, and
+            # mutex-under-lock is the reverse of the snapshot path's
+            # mutex -> lock order — an AB/BA deadlock.)
+            self.storage.optimize()
+            self.storage.op_n = 0
+            self._report_backlog()
+            return
+        if not self._snapshotting:
+            self._snapshotting = True
+            _SNAPSHOT_PENDING.adjust(+1)
+            t = threading.Thread(
+                target=self._snapshot_bg,
+                name=f"snapshot-{self.index}/{self.field}/{self.view}/{self.shard}",
+                daemon=True,
+            )
+            self._snapshot_thread = t
+            t.start()
+
+    def _snapshot_bg(self) -> None:
+        try:
+            self._snapshot_once()
+        except Exception as e:  # noqa: BLE001 — counted crash barrier
+            from pilosa_tpu.utils.stats import global_stats
+
+            global_stats.count("fragment_snapshot_failures_total")
+            _recovery_log.printf("fragment %s: snapshot failed: %s",
+                                 self.path, e)
+        finally:
+            with self.lock:
+                self._snapshotting = False
+            _SNAPSHOT_PENDING.adjust(-1)
+
+    def await_snapshot(self) -> None:
+        """Block until any in-flight background snapshot has finished —
+        the write-path acknowledgment contract does NOT include the
+        rewrite, so tests/maintenance that need the compacted file wait
+        here instead of spinning on op_n."""
+        t = self._snapshot_thread
+        if t is not None and t is not threading.current_thread():
+            t.join()
 
     def snapshot(self) -> None:
-        """Atomically rewrite the storage file without the op log
-        (reference fragment.go:2311-2394)."""
+        """Synchronously rewrite the storage file without the op log
+        (reference fragment.go:2311-2394). Waits out any in-flight
+        background rewrite first so callers (tests, maintenance) observe
+        a fully-compacted file on return."""
+        t = self._snapshot_thread
+        if t is not None and t is not threading.current_thread():
+            t.join()
+        self._snapshot_once()
+
+    def _snapshot_once(self) -> None:
+        """The rewrite itself, structured so the fragment lock is never
+        held across the O(storage) serialize:
+
+        phase 1 (lock):    clone the storage — container copy-on-write
+                           makes this a dict copy — and note the current
+                           file size (where post-clone WAL records start)
+                           and op_n.
+        phase 2 (no lock): optimize + serialize the clone into the
+                           `.snapshotting` temp, fsync. Imports keep
+                           landing in the live WAL meanwhile.
+        phase 3 (lock):    splice the WAL records appended since phase 1
+                           onto the temp (they are self-contained
+                           checksummed records; snapshot + tail replay
+                           equals live state), fsync, release the WAL fd
+                           and os.replace — the same atomicity contract
+                           as before. op_n drops by what the snapshot
+                           absorbed; the spliced tail remains pending.
+        """
+        import time as _time
+
+        from pilosa_tpu.utils.stats import global_stats
+
+        t0 = _time.perf_counter()
+        with self._snapshot_mutex:
+            self._snapshot_locked(t0, global_stats)
+
+    def _snapshot_locked(self, t0, global_stats) -> None:
+        import time as _time
+
         with self.lock:
-            # Re-pack runny containers as RLE while we're already paying
-            # a full-storage pass (reference calls Optimize on snapshot;
-            # mutating ops leave array/bitmap forms behind).
-            self.storage.optimize()
-            if self.path is None:
-                self.storage.op_n = 0
+            if self._closed:
+                # A rewrite that lost the start race with close() (or
+                # delete_fragment) must not resurrect the file.
                 return
-            tmp = self.path + ".snapshotting"
-            with open(tmp, "wb") as f:
-                f.write(serialize(self.storage))
-                f.flush()
-                os.fsync(f.fileno())
+            if self.path is None:
+                # Re-pack runny containers as RLE while we're already
+                # paying attention (reference calls Optimize on
+                # snapshot); memory-only fragments have no file to
+                # rewrite.
+                self.storage.optimize()
+                self.storage.op_n = 0
+                self._report_backlog()
+                return
+            clone = self.storage.clone()
+            clone.flags = self.storage.flags
+            op_n_at_clone = self.storage.op_n
+            wal_base = os.path.getsize(self.path)
+        # -- phase 2: O(storage) work with NO fragment lock held --------
+        pre = dict(clone._cs)  # pre-optimize containers (shared w/ live)
+        clone.optimize()
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            f.write(serialize(clone))
+            f.flush()
+            os.fsync(f.fileno())
+        with self.lock:
+            if self._closed:
+                # close() landed during the unlocked serialize: abandon
+                # the temp; the WAL on disk still holds every record.
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return
+            tail = b""
+            size_now = os.path.getsize(self.path)
+            if size_now > wal_base:
+                with open(self.path, "rb") as src:
+                    src.seek(wal_base)
+                    tail = src.read(size_now - wal_base)
+            if tail:
+                with open(tmp, "ab", buffering=0) as f:
+                    # Same short-write loop as _WalFile.write: a raw
+                    # unbuffered write may land a prefix, and a cut
+                    # tail here would be fsynced + published as a
+                    # legitimate-looking torn tail — silent loss of
+                    # acknowledged records.
+                    view = memoryview(tail)
+                    n = 0
+                    while n < len(view):
+                        n += f.write(view[n:])
+                    os.fsync(f.fileno())
             if self._file is not None:
                 # Release the fd across the rename; the next WAL write
                 # reopens against the NEW file.
                 self._file.release()
             os.replace(tmp, self.path)
-            self.storage.op_n = 0
+            self.storage.op_n -= op_n_at_clone
+            self._report_backlog()
+            # Adopt the clone's RLE-repacked containers into LIVE
+            # storage wherever the live container is still the exact
+            # object the clone snapshotted (no write touched it since):
+            # same bits, smaller host form — the RAM-reclaim the old
+            # inline `storage.optimize()` provided, without an
+            # O(storage) runs() scan under the lock. Containers are
+            # immutable, and the key set is unchanged, so readers
+            # holding old refs and the cached key sort both stay valid.
+            live_cs = self.storage._cs
+            for k, oc in clone._cs.items():
+                old = pre.get(k)
+                if oc is not old and live_cs.get(k) is old:
+                    live_cs[k] = oc
+        global_stats.count("fragment_snapshots_total")
+        global_stats.timing(
+            "fragment_snapshot_seconds", _time.perf_counter() - t0
+        )
 
     # -- mutation ---------------------------------------------------------
 

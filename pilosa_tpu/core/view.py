@@ -73,16 +73,22 @@ class View:
         # the owning field's available-shards cache without paying for it
         # on every data write.
         self.on_structure_change: Optional[Callable[[], None]] = None
-        # Mutation journal: (generation, shard) per data bump, shard None
-        # for structural events. Lets epoch-incremental stats tiers
-        # discover WHICH shards moved in O(writes) instead of walking
-        # every fragment's (uid, version) per epoch — at 954 shards the
-        # walk cost ~1.8 ms x3 aggregate kinds per write epoch, the
-        # bench minmax churn leg's dominant cost (r5). Journal-complete
-        # since r7: every serving tier consumes it (Sum/Min/Max, pair,
-        # TopN, GroupN — exec/tpu.py _epoch_versions), so JOURNAL_MAX
-        # bounds how many writes may land between two freshness checks
-        # of ANY hot tier before that check degrades to a full walk.
+        # Mutation journal: (gen_first, gen_last, shard) RUNS of data
+        # bumps, shard None for structural events. Lets epoch-incremental
+        # stats tiers discover WHICH shards moved in O(writes) instead of
+        # walking every fragment's (uid, version) per epoch — at 954
+        # shards the walk cost ~1.8 ms x3 aggregate kinds per write
+        # epoch, the bench minmax churn leg's dominant cost (r5).
+        # Journal-complete since r7: every serving tier consumes it
+        # (Sum/Min/Max, pair, TopN, GroupN — exec/tpu.py
+        # _epoch_versions). Run-compacted since r8 (ISSUE r8 tentpole
+        # 4): contiguous bumps of the SAME shard extend one run instead
+        # of appending entries, so a sustained per-fragment import storm
+        # occupies O(distinct dirty shards) journal slots — JOURNAL_MAX
+        # then bounds the INTERLEAVING depth (shard alternations), not
+        # the raw write count, before a freshness check degrades to a
+        # full walk. Correctness: dirty_shards_since only needs "did
+        # this shard bump after gen", which a run's gen_last answers.
         self._journal: deque = deque()
         self._journal_floor = 0  # newest generation ever evicted
         # Journal lock invariant (ADVICE r5): this is a strict LEAF
@@ -102,9 +108,18 @@ class View:
     def _bump_data(self, shard: Optional[int] = None) -> None:
         with self._journal_lock:
             self.generation = next(_generation_counter)
-            self._journal.append((self.generation, shard))
-            while len(self._journal) > self.JOURNAL_MAX:
-                self._journal_floor = self._journal.popleft()[0]
+            j = self._journal
+            if j and shard is not None and j[-1][2] == shard:
+                # Contiguous same-shard run: extend in place. Any
+                # generation this VIEW minted between gen_first and the
+                # new gen_last belongs to this shard — other views'
+                # interleaved generations never enter this journal, so
+                # the run claims nothing it didn't do.
+                j[-1] = (j[-1][0], self.generation, shard)
+            else:
+                j.append((self.generation, self.generation, shard))
+            while len(j) > self.JOURNAL_MAX:
+                self._journal_floor = j.popleft()[1]
 
     def dirty_shards_since(self, gen: int) -> Optional[set]:
         """Shards mutated after generation `gen`, or None when the
@@ -119,8 +134,8 @@ class View:
                 return None
             snapshot = list(self._journal)
         out: set = set()
-        for g, s in reversed(snapshot):
-            if g <= gen:
+        for _g0, g1, s in reversed(snapshot):
+            if g1 <= gen:
                 break
             if s is None:
                 return None

@@ -21,6 +21,7 @@ reference roaring/unmarshal_binary.go readOfficialHeader at roaring.go:5315).
 from __future__ import annotations
 
 import struct
+from dataclasses import dataclass
 from typing import BinaryIO, Optional
 
 import numpy as np
@@ -52,6 +53,44 @@ OP_ADD_ROARING = 4
 OP_REMOVE_ROARING = 5
 
 _MIN_OP_SIZE = 13
+
+
+class CorruptWalError(ValueError):
+    """Op-log corruption BEFORE the tail: a record that fails its
+    checksum (or is structurally impossible) while more valid bytes
+    follow it. Unlike a torn tail — which a crash mid-append produces
+    legitimately and recovery truncates away — mid-log corruption means
+    records AFTER the damage would be lost by truncation, so the caller
+    must refuse to open the fragment rather than silently drop data
+    (ISSUE r8 tentpole 1).
+
+    `offset` is the file offset of the bad record; `reason` is a short
+    machine-stable token (checksum | op-type | bounds)."""
+
+    def __init__(self, msg: str, offset: int, reason: str):
+        super().__init__(msg)
+        self.offset = offset
+        self.reason = reason
+
+
+@dataclass
+class ReplayInfo:
+    """What a WAL replay actually did — the recovery contract's receipt.
+
+    ops_applied:   op records applied (each batch record is ONE op here;
+                   Bitmap.op_n still advances by changed-value counts).
+    torn_offset:   file offset of a detected torn FINAL record (the
+                   SIGKILL-mid-append shape: truncated, or checksum-
+                   failing with nothing after it), or None when the log
+                   replayed clean to EOF. The caller truncates the file
+                   back to this offset to restore the consistent prefix.
+    torn_reason:   short token for the torn detection (truncated |
+                   checksum | short-record), "" when torn_offset is None.
+    """
+
+    ops_applied: int = 0
+    torn_offset: Optional[int] = None
+    torn_reason: str = ""
 
 
 def _encoded_container(c: Container) -> tuple[int, bytes]:
@@ -100,8 +139,13 @@ def serialized_size(b: Bitmap) -> int:
     return len(serialize(b))
 
 
-def deserialize(data: bytes, b: Optional[Bitmap] = None) -> Bitmap:
-    """Parse either Pilosa or official roaring format, applying any op log."""
+def deserialize(data: bytes, b: Optional[Bitmap] = None,
+                info: Optional[ReplayInfo] = None) -> Bitmap:
+    """Parse either Pilosa or official roaring format, applying any op log.
+
+    `info` (fragment recovery only) opts the op-log replay into the
+    torn-tail contract documented on apply_ops and receives the replay
+    receipt; without it any damage raises, as wire payloads require."""
     if b is None:
         b = Bitmap()
     if len(data) == 0:
@@ -111,14 +155,15 @@ def deserialize(data: bytes, b: Optional[Bitmap] = None) -> Bitmap:
     file_magic = struct.unpack_from("<H", data, 0)[0]
     try:
         if file_magic == MAGIC_NUMBER:
-            return _deserialize_pilosa(data, b)
+            return _deserialize_pilosa(data, b, info)
         return _deserialize_official(data, b)
     except struct.error as e:
         # Truncated inputs surface as the module's documented error type.
         raise ValueError(f"malformed roaring data: {e}") from e
 
 
-def _deserialize_pilosa(data: bytes, b: Bitmap) -> Bitmap:
+def _deserialize_pilosa(data: bytes, b: Bitmap,
+                        info: Optional[ReplayInfo] = None) -> Bitmap:
     if len(data) < 8:
         raise ValueError("data too small")
     version = data[2]
@@ -180,7 +225,7 @@ def _deserialize_pilosa(data: bytes, b: Bitmap) -> Bitmap:
         else:
             raise ValueError(f"unsupported container type {typ}")
 
-    apply_ops(b, data, ops_offset)
+    apply_ops(b, data, ops_offset, info)
     return b
 
 
@@ -302,29 +347,62 @@ def _op_size(typ: int, value: int) -> int:
     return 17 + value  # roaring ops: value is payload length
 
 
-def apply_ops(b: Bitmap, data: bytes, offset: int) -> int:
+def apply_ops(b: Bitmap, data: bytes, offset: int,
+              info: Optional[ReplayInfo] = None) -> int:
     """Replay the op log from offset to EOF. Returns number of ops applied.
 
     reference roaring/unmarshal_binary.go:207-228 (checksum-verified replay,
     op.apply at roaring/roaring.go:4669).
+
+    Torn-tail contract (ISSUE r8): with `info` supplied (the fragment
+    recovery path), a damaged FINAL record — truncated mid-append, or
+    checksum-failing with nothing after it, the shapes a SIGKILL during
+    the WAL append produces — stops the replay at the last good record
+    and reports the torn offset in `info` instead of raising; the caller
+    truncates the file there. Damage with MORE bytes after it (a
+    checksum-failing or structurally impossible record before the tail)
+    is mid-log corruption: truncating there would drop the records
+    behind it, so it always raises CorruptWalError and the fragment
+    refuses to open. Without `info` (wire payloads, block merges) every
+    damage class raises, exactly as before — a peer's serialized bitmap
+    has no legitimate torn tail.
     """
     n_ops = 0
     pos = offset
     while pos < len(data):
         if len(data) - pos < _MIN_OP_SIZE:
+            if info is not None:
+                info.torn_offset, info.torn_reason = pos, "short-record"
+                break
             raise ValueError(f"op data out of bounds: len={len(data) - pos}")
         typ = data[pos]
         if typ > OP_REMOVE_ROARING:
-            raise ValueError(f"unknown op type: {typ}")
+            # Never a torn shape: a partial append is a PREFIX of a valid
+            # record, whose first byte is a valid type — an impossible
+            # type is a flipped bit, and record boundaries past it are
+            # unknowable, so even at the tail this refuses.
+            raise CorruptWalError(
+                f"unknown op type {typ} at offset {pos}", pos, "op-type"
+            )
         value = struct.unpack_from("<Q", data, pos + 1)[0]
         size = _op_size(typ, value)
         if pos + size > len(data):
+            if info is not None:
+                info.torn_offset, info.torn_reason = pos, "truncated"
+                break
             raise ValueError("op data truncated")
         want = struct.unpack_from("<I", data, pos + 9)[0]
         h = fnv32a(data[pos : pos + 9])
         h = fnv32a(data[pos + 13 : pos + size], h)
         if h != want:
-            raise ValueError(f"op checksum mismatch at offset {pos}")
+            if info is not None and pos + size == len(data):
+                # Checksum-failing FINAL record: the mid-append crash
+                # shape (payload bytes landed, some garbage/stale).
+                info.torn_offset, info.torn_reason = pos, "checksum"
+                break
+            raise CorruptWalError(
+                f"op checksum mismatch at offset {pos}", pos, "checksum"
+            )
         if typ == OP_ADD:
             b.add(value, log=False)
             b.op_n += 1
@@ -347,6 +425,8 @@ def apply_ops(b: Bitmap, data: bytes, offset: int) -> int:
             b.op_n += op_n
         pos += size
         n_ops += 1
+    if info is not None:
+        info.ops_applied += n_ops
     return n_ops
 
 

@@ -78,6 +78,65 @@ class API:
         self.max_inflight_queries = 0
         self._inflight_lock = threading.Lock()
         self._inflight_queries = 0
+        # Write-side admission (ISSUE r8 tentpole 3, mirroring the read
+        # gate above): bounded in-flight import bytes + a pending-WAL
+        # depth cap. Over either, imports are shed deliberately
+        # (429/503 + Retry-After + code) — the node degrades by
+        # contract, never by OOM. 0 = unbounded (defaults).
+        self.max_import_bytes = 0
+        self.max_pending_wal = 0
+        self._import_lock = threading.Lock()
+        self._import_inflight_bytes = 0
+
+    # -- import admission (wired by server/http.py around /import) ---------
+
+    def begin_import(self, nbytes: int):
+        """Admit one import request of `nbytes` body bytes, or refuse:
+        returns None when admitted (caller MUST call end_import(nbytes)
+        in a finally block), else (status, code, reason) for the shed
+        response. Sheds are counted as import_shed_total{reason}."""
+        from pilosa_tpu.core.fragment import WAL_BACKLOG
+        from pilosa_tpu.utils.stats import global_stats
+
+        if self.max_pending_wal > 0 and WAL_BACKLOG.ops > self.max_pending_wal:
+            # The WAL/snapshot plane is behind: admitting more writes
+            # only deepens the un-snapshotted backlog (and the recovery
+            # replay a crash would pay). 503: retry after the background
+            # snapshots drain, not after an in-flight request finishes.
+            global_stats.with_tags("reason:wal-backlog").count(
+                "import_shed_total"
+            )
+            return (503, "wal-backlog", "wal-backlog")
+        with self._import_lock:
+            over = (
+                self.max_import_bytes > 0
+                and self._import_inflight_bytes + nbytes > self.max_import_bytes
+                # A single request larger than the whole cap must still
+                # be admitted when nothing else is in flight, or it
+                # could never succeed at any retry pace.
+                and self._import_inflight_bytes > 0
+            )
+            if not over:
+                self._import_inflight_bytes += nbytes
+                global_stats.gauge(
+                    "import_inflight_bytes", self._import_inflight_bytes
+                )
+                return None
+        global_stats.with_tags("reason:inflight-bytes").count(
+            "import_shed_total"
+        )
+        return (429, "import-overloaded", "inflight-bytes")
+
+    def end_import(self, nbytes: int) -> None:
+        from pilosa_tpu.utils.stats import global_stats
+
+        with self._import_lock:
+            self._import_inflight_bytes = max(
+                0, self._import_inflight_bytes - nbytes
+            )
+            global_stats.gauge(
+                "import_inflight_bytes", self._import_inflight_bytes
+            )
 
     # -- admission control (wired by server/http.py around /query) ---------
 
@@ -385,7 +444,13 @@ class API:
                 raise APIError("field does not use string keys")
             row_ids = f.translate_store.translate_keys(row_keys)
         if self.cluster is not None and not remote:
-            self._route_import(index, field, row_ids, column_ids, timestamps, clear)
+            from pilosa_tpu.cluster.client import ClientError
+
+            try:
+                self._route_import(index, field, row_ids, column_ids,
+                                   timestamps, clear)
+            except ClientError as e:
+                raise self._map_import_client_error(e) from e
             return
         rows = np.asarray(row_ids, dtype=np.uint64)
         cols = np.asarray(column_ids, dtype=np.uint64)
@@ -427,7 +492,13 @@ class API:
                 raise APIError("index does not use string keys")
             column_ids = idx.translate_store.translate_keys(column_keys)
         if self.cluster is not None and not remote:
-            self._route_import_values(index, field, column_ids, values, clear)
+            from pilosa_tpu.cluster.client import ClientError
+
+            try:
+                self._route_import_values(index, field, column_ids, values,
+                                          clear)
+            except ClientError as e:
+                raise self._map_import_client_error(e) from e
             return
         cols = np.asarray(column_ids, dtype=np.uint64)
         try:
@@ -437,6 +508,23 @@ class API:
         ef = idx.existence_field()
         if ef is not None and not clear and cols.size:
             ef.import_bits(np.zeros(cols.size, dtype=np.uint64), cols)
+
+    @staticmethod
+    def _map_import_client_error(e) -> "APIError":
+        """A fanned-out import leg's peer refusal, translated so the
+        originating client sees the peer's backpressure contract —
+        429/503/504 + Retry-After + code — instead of an opaque 500
+        (ISSUE r8: remote legs propagate the budget like read legs do)."""
+        code = getattr(e, "code", "")
+        status = getattr(e, "status", 0)
+        if code in ("import-overloaded", "overloaded") or status == 429:
+            return APIError(str(e), status=429, code=code or "overloaded")
+        if code in ("wal-backlog", "unavailable") or status == 503:
+            return APIError(str(e), status=503, code=code or "unavailable")
+        if code == "deadline-exceeded" or status == 504:
+            return APIError(str(e), status=504, code="deadline-exceeded")
+        return APIError(f"remote import error: {e}", status=502,
+                        code="peer-error")
 
     # -- cluster import routing (reference api.go:920-1127: bits grouped by
     # shard, each group sent to every owning node) ------------------------
@@ -499,14 +587,19 @@ class API:
         if f is None:
             raise NotFoundError(f"field not found: {field}")
         if self.cluster is not None and not remote:
-            for node, is_local, _ in self._owners_by_node(index, {shard}):
-                if is_local:
-                    self.import_roaring(index, field, shard, views,
-                                        clear=clear, remote=True)
-                else:
-                    self.cluster.client.import_roaring(
-                        node, index, field, shard, views, clear=clear
-                    )
+            from pilosa_tpu.cluster.client import ClientError
+
+            try:
+                for node, is_local, _ in self._owners_by_node(index, {shard}):
+                    if is_local:
+                        self.import_roaring(index, field, shard, views,
+                                            clear=clear, remote=True)
+                    else:
+                        self.cluster.client.import_roaring(
+                            node, index, field, shard, views, clear=clear
+                        )
+            except ClientError as e:
+                raise self._map_import_client_error(e) from e
             return
         for view_name, data in views.items():
             name = view_name or "standard"

@@ -115,6 +115,18 @@ class Config:
     # Retry-After + code=overloaded (http_requests_shed_total) instead
     # of queueing until the kernel RSTs the accept backlog. 0 = no cap.
     max_inflight: int = 0
+    # -- write-plane backpressure (ISSUE r8) -------------------------------
+    # Cap on concurrently in-flight import request bytes per node: past
+    # it new /import bodies are shed with 429 + Retry-After +
+    # code=import-overloaded (import_shed_total{reason=inflight-bytes})
+    # instead of buffering toward OOM. A single request larger than the
+    # cap is still admitted when nothing else is in flight. 0 = no cap.
+    max_import_bytes: int = 0
+    # Cap on the node's pending-WAL depth (un-snapshotted op records,
+    # the wal_pending_ops gauge): past it imports answer 503 +
+    # Retry-After + code=wal-backlog until the background snapshot
+    # plane catches up. 0 = no cap.
+    max_pending_wal: int = 0
     # HBM residency budget in bytes for the TPU backend's field stacks
     # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
     # via row paging instead of whole-stack residency.
@@ -214,6 +226,8 @@ class Config:
             "batch-window": self.batch_window,
             "preheat": self.preheat,
             "max-inflight": self.max_inflight,
+            "max-import-bytes": self.max_import_bytes,
+            "max-pending-wal": self.max_pending_wal,
             "max-hbm-bytes": self.max_hbm_bytes,
             "profile": {"port": self.profile_port},
             "query-timeout": self.query_timeout,
@@ -253,6 +267,8 @@ class Config:
             "preheat": "preheat",
             "client-timeout": "client_timeout",
             "max-inflight": "max_inflight",
+            "max-import-bytes": "max_import_bytes",
+            "max-pending-wal": "max_pending_wal",
             "max-hbm-bytes": "max_hbm_bytes",
             "query-timeout": "query_timeout",
             "client-retries": "client_retries",
@@ -300,6 +316,8 @@ class Config:
             pre + "PROFILE_PORT": ("profile_port", int),
             pre + "CLIENT_TIMEOUT": ("client_timeout", float),
             pre + "MAX_INFLIGHT": ("max_inflight", int),
+            pre + "MAX_IMPORT_BYTES": ("max_import_bytes", int),
+            pre + "MAX_PENDING_WAL": ("max_pending_wal", int),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
             pre + "QUERY_TIMEOUT": ("query_timeout", float),
             pre + "CLIENT_RETRIES": ("client_retries", int),
@@ -341,6 +359,8 @@ class Config:
             f"preheat = {str(c.preheat).lower()}\n"
             f"client-timeout = {c.client_timeout}\n"
             f"max-inflight = {c.max_inflight}\n"
+            f"max-import-bytes = {c.max_import_bytes}\n"
+            f"max-pending-wal = {c.max_pending_wal}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
             f"query-timeout = {c.query_timeout}\n"
             f"client-retries = {c.client_retries}\n"

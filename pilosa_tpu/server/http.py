@@ -713,12 +713,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.api.delete_field(index, field)
         self._reply({"success": True})
 
-    def _request_deadline(self):
+    def _request_deadline(self, use_default: bool = True):
         """The request's Deadline, or None (no budget). Precedence:
         X-Pilosa-Deadline (the internal propagation header — a remote leg
         must inherit the coordinator's remaining budget, never restart a
         full client budget), then ?timeout= (the public knob), then the
-        server's query-timeout config default."""
+        server's query-timeout config default. Import routes pass
+        use_default=False: query-timeout is sized for READ SLOs, and
+        silently applying it to a long bulk import would 504 a write
+        that used to complete — explicit budgets still propagate."""
         from pilosa_tpu.utils.deadline import Deadline
 
         raw = self.headers.get("X-Pilosa-Deadline")
@@ -729,6 +732,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return Deadline.parse(raw)
             except ValueError:
                 raise APIError(f"invalid timeout: {raw!r}") from None
+        if not use_default:
+            return None
         default = getattr(self.api, "query_timeout", 0.0)
         return Deadline(default) if default and default > 0 else None
 
@@ -825,9 +830,64 @@ class _Handler(BaseHTTPRequestHandler):
             with prof.phase("serialize"):
                 self._reply(out)
 
+    #: On a shed, bodies up to this size are drained to keep the
+    #: keep-alive connection framed; larger ones are NOT read (reading
+    #: would buffer exactly the bytes the cap refuses) — the connection
+    #: closes instead.
+    SHED_DRAIN_MAX = 1 << 20
+
+    def _import_request_bytes(self) -> int:
+        """The import body size WITHOUT buffering it: the declared
+        Content-Length, or the decoded chunked body when parse_request
+        already read one. Known carve-out: chunked bodies are decoded
+        eagerly at parse time (before the route is known), so they are
+        buffered — bounded to MAX_CHUNKED_BODY (64 MiB) each — BEFORE
+        the gate sees them; only Content-Length bodies are refused
+        entirely unread. Documented in docs/administration.md."""
+        if getattr(self, "_chunked_body", None) is not None:
+            return len(self._chunked_body)
+        return int(self.headers.get("Content-Length") or 0)
+
+    def _shed_import(self, refuse, nbytes: int) -> None:
+        """Answer a refused import through the _error funnel (429/503 +
+        Retry-After + code) WITHOUT having buffered the body: a small
+        unread body is drained to keep the keep-alive connection
+        framed; a large one would be the very buffering the cap exists
+        to refuse, so the connection closes after the error instead."""
+        status, code, reason = refuse
+        if getattr(self, "_chunked_body", None) is None:
+            if nbytes <= self.SHED_DRAIN_MAX:
+                self._body()
+            else:
+                self.close_connection = True
+        self._error(
+            f"import shed ({reason}): write-side admission cap reached",
+            status=status,
+            code=code,
+        )
+
     @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
     def handle_post_import(self, index, field):
-        body = self._body()
+        # Write-side admission FIRST (ISSUE r8 tentpole 3, the mirror of
+        # handle_post_query's gate), consulted BEFORE the body is read:
+        # gating after buffering would let N concurrent over-cap bodies
+        # occupy RAM anyway — the OOM shape the cap refuses. The
+        # deadline scope opens like the query path's so fanned-out
+        # remote legs inherit the remaining budget via X-Pilosa-Deadline.
+        nbytes = self._import_request_bytes()
+        refuse = self.api.begin_import(nbytes)
+        if refuse is not None:
+            self._shed_import(refuse, nbytes)
+            return
+        try:
+            from pilosa_tpu.utils.deadline import deadline_scope
+
+            with deadline_scope(self._request_deadline(use_default=False)):
+                self._serve_import(index, field, self._body())
+        finally:
+            self.api.end_import(nbytes)
+
+    def _serve_import(self, index, field, body):
         ctype = (self.headers.get("Content-Type") or "").split(";")[0]
         clear = self.query.get("clear") == "true"
         remote = self.query.get("remote") == "true"
@@ -870,7 +930,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>\d+)")
     def handle_post_import_roaring(self, index, field, shard):
-        body = self._body()
+        nbytes = self._import_request_bytes()
+        refuse = self.api.begin_import(nbytes)
+        if refuse is not None:
+            self._shed_import(refuse, nbytes)
+            return
+        try:
+            from pilosa_tpu.utils.deadline import deadline_scope
+
+            with deadline_scope(self._request_deadline(use_default=False)):
+                self._serve_import_roaring(index, field, shard, self._body())
+        finally:
+            self.api.end_import(nbytes)
+
+    def _serve_import_roaring(self, index, field, shard, body):
         ctype = (self.headers.get("Content-Type") or "").split(";")[0]
         if ctype == "application/x-protobuf":
             req = ImportRoaringRequest.from_bytes(body)

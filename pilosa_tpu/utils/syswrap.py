@@ -114,7 +114,15 @@ def read_buffer(path: str):
         try:
             yield mm
         finally:
-            mm.close()
+            try:
+                mm.close()
+            except BufferError:
+                # An error path (e.g. a corrupt-fragment refusal) can
+                # leave numpy views of the map alive in the in-flight
+                # exception's traceback frames; closing would replace
+                # the structured error with a BufferError. The map
+                # closes when those views are collected.
+                pass
     finally:
         with _lock:
             _map_count -= 1

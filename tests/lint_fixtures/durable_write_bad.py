@@ -1,0 +1,39 @@
+"""Seeded durable-write violations (tests/test_lint.py asserts the
+checker fires on each): a truncating rewrite with no os.replace, and a
+buffered append outside the OpWriter idiom. The waivered site and the
+two compliant functions must NOT fire."""
+
+import json
+import os
+
+
+def bad_truncating_write(path, meta):
+    # VIOLATION: a crash mid-write leaves a torn file the next open
+    # refuses — no tmp + os.replace.
+    with open(path, "w") as f:
+        json.dump(meta, f)
+
+
+def bad_buffered_append(path, record):
+    # VIOLATION: a buffered append can tear a record across the crash
+    # boundary in ways torn-tail recovery was never specified for.
+    with open(path, "ab") as f:
+        f.write(record)
+
+
+def waivered_write(path, data):
+    # lint: allow-durable-write(fixture: demonstrates a consumed waiver)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def good_atomic_rewrite(path, meta):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+
+
+def good_wal_append(path, record):
+    with open(path, "ab", buffering=0) as f:
+        f.write(record)

@@ -31,6 +31,12 @@ SMOKE_ENV = {
     # A failed background warm must degrade the wire (dense fallback),
     # never hang the smoke on the warm poll.
     "BENCH_WARM_TIMEOUT": "120",
+    # Tiny ingest-under-load leg (r8): machinery smoke, not a rate.
+    "BENCH_INGEST_SECONDS": "0.5",
+    "BENCH_INGEST_WRITERS": "2",
+    "BENCH_INGEST_READERS": "2",
+    "BENCH_INGEST_BATCH": "32",
+    "BENCH_INGEST_SHARDS": "2",
 }
 
 
@@ -61,10 +67,15 @@ def test_bench_smoke(tmp_path):
     assert "batch_occupancy_mean_at_clients" in blob
     assert "device_launches_at_clients" in blob
     assert "client_retries" in blob and "client_aborts" in blob
+    # The r8 ingest-under-load keys the driver's acceptance reads.
+    assert blob["ingest_rows_per_s"] > 0
+    assert blob["ingest_read_qps_under_load"] > 0
+    assert "ingest_read_p99_delta_ms" in blob
+    assert "ingest_version_walks" in blob
     # Every leg checkpointed along the way.
     for leg in ("build", "cold_build", "tpu_batch", "single_query",
                 "minmax_churn", "http", "qps@1", "qps@4",
-                "concurrency_sweep"):
+                "concurrency_sweep", "ingest_under_load"):
         assert leg in blob["legs_done"], blob["legs_done"]
     # The partial artifact also landed complete on disk.
     disk = json.loads(open(env["BENCH_PARTIAL_PATH"]).read())

@@ -160,10 +160,13 @@ class TestFragmentDurability:
     def test_snapshot_on_op_threshold(self, tmp_path):
         p = str(tmp_path / "frag" / "0")
         f = Fragment(p, "i", "f", "standard", 0).open()
-        # A single large batch exceeds MAX_OP_N and triggers a snapshot.
+        # A single large batch exceeds MAX_OP_N and triggers a snapshot —
+        # now a BACKGROUND rewrite (ISSUE r8: off the ingest hot path),
+        # so the import returns before op_n resets; await it.
         vals = np.arange(MAX_OP_N + 10, dtype=np.uint64)
         f.bulk_import(np.zeros(vals.size, dtype=np.uint64), vals)
-        assert f.storage.op_n == 0  # snapshot reset
+        f.await_snapshot()
+        assert f.storage.op_n == 0  # snapshot absorbed the whole log
         f.close()
         f2 = Fragment(p, "i", "f", "standard", 0).open()
         assert f2.row_count(0) == MAX_OP_N + 10

@@ -123,6 +123,19 @@ class TestCheckersFire:
         assert "swallows" in msgs
         assert "bare `except:`" in msgs
 
+    def test_durable_write_fixture(self):
+        from tools.lint.checkers.durable_write import DurableWriteChecker
+
+        f = load_fixture("durable_write_bad.py")
+        got = list(DurableWriteChecker().check_file(f))
+        assert len(got) == 2  # truncating write + buffered append
+        msgs = " | ".join(v.message for v in got)
+        assert "'w'" in msgs
+        assert "'ab'" in msgs
+        # The waivered site is consumed; the tmp+replace and unbuffered-
+        # append functions do not fire.
+        assert any(w.used for w in f.waivers)
+
     def test_metric_tags_fixture(self):
         f = load_fixture("metric_tags_bad.py")
         got = list(TagCardinalityChecker().check_file(f))
@@ -193,7 +206,7 @@ class TestFramework:
     def test_registry_rules_unique_and_documented(self):
         checkers = make_checkers()
         rules = [c.rule for c in checkers]
-        assert len(rules) == len(set(rules)) == 7
+        assert len(rules) == len(set(rules)) == 8
         for c in checkers:
             assert c.rule and c.doc, f"{type(c).__name__} lacks rule/doc"
 

@@ -12,6 +12,7 @@ docs/development.md "Lint plane").
 
 from __future__ import annotations
 
+from tools.lint.checkers.durable_write import DurableWriteChecker
 from tools.lint.checkers.error_codes import ErrorCodeChecker
 from tools.lint.checkers.exceptions import ExceptDisciplineChecker
 from tools.lint.checkers.jax_dispatch import JaxDispatchChecker
@@ -30,4 +31,5 @@ def make_checkers():
         ExceptDisciplineChecker(),
         MetricDocsChecker(),
         TagCardinalityChecker(),
+        DurableWriteChecker(),
     ]

@@ -1,0 +1,111 @@
+"""durable-write: holder-data-dir writes use crash-safe idioms only.
+
+ISSUE r8's recovery contract (core/fragment.py open) only holds if every
+byte under the data dir got there one of two ways:
+
+- **tmp file + os.replace** — whole-file rewrites (snapshots, .meta,
+  .cache, .available.shards) land atomically: a crash leaves either the
+  old complete file or the new complete file, never a torn prefix the
+  next open refuses.
+- **unbuffered append** (`open(..., "a?b", buffering=0)`) — the WAL
+  idiom (`_WalFile`/`OpWriter`): each checksummed record hits the OS in
+  order, and a crash mid-append produces exactly the torn-tail shape
+  replay recovery truncates away.
+
+Anything else — a truncating write with no rename, a buffered append —
+is a write a crash can tear into a state recovery was never specified
+for. The rule is structural, per enclosing function: a write-mode
+`open()` must share its function with an `os.replace(...)` call, and an
+append-mode `open()` must pass `buffering=0` (or share the function
+with an `os.replace`, for the snapshot's tail splice into the temp
+file). Reads are ignored. Scope: the packages that write under the
+holder data dir (core/, roaring/, store/).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.lint.core import Checker, SourceFile, Violation, dotted_name
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of a builtin open() call, or None when it is not
+    an open() / the mode is not a string constant (default 'r')."""
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return None
+    mode_node: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+    if mode_node is None:
+        return "r"
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        return mode_node.value
+    return None  # computed mode: out of static reach
+
+
+def _has_unbuffered(call: ast.Call) -> bool:
+    if len(call.args) >= 3:
+        a = call.args[2]
+        return isinstance(a, ast.Constant) and a.value == 0
+    for kw in call.keywords:
+        if kw.arg == "buffering":
+            return isinstance(kw.value, ast.Constant) and kw.value.value == 0
+    return False
+
+
+class DurableWriteChecker(Checker):
+    rule = "durable-write"
+    doc = ("data-dir writes must be tmp-file + os.replace (atomic "
+           "rewrite) or unbuffered append (the OpWriter WAL idiom)")
+    #: The holder-data-dir writers. Other packages (bench artifacts,
+    #: profiler dumps) are not under the recovery contract.
+    scope = (
+        "pilosa_tpu/core/",
+        "pilosa_tpu/roaring/",
+        "pilosa_tpu/store/",
+        "tests/lint_fixtures/",  # so the seeded fixture stays checkable
+    )
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_replace = any(
+                isinstance(n, ast.Call)
+                and dotted_name(n.func) == "os.replace"
+                for n in ast.walk(fn)
+            )
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                mode = _open_mode(node)
+                if mode is None or not set(mode) & set("wxa+"):
+                    continue
+                if "a" in mode and "+" not in mode and (
+                    _has_unbuffered(node) or has_replace
+                ):
+                    continue  # WAL append / snapshot tail splice
+                if "a" not in mode and has_replace:
+                    continue  # tmp + os.replace rewrite
+                if f.waive(self.rule, node.lineno, node.end_lineno):
+                    continue
+                yield Violation(
+                    rule=self.rule, path=f.rel, line=node.lineno,
+                    message=(
+                        f"open(..., {mode!r}) under the holder data dir "
+                        "without a crash-safe idiom"
+                    ),
+                    hint=(
+                        "write a tmp file and os.replace() it in the same "
+                        "function (atomic rewrite), or append unbuffered "
+                        "(buffering=0) through an attached OpWriter; if "
+                        "this write is genuinely outside the recovery "
+                        "contract: # lint: allow-durable-write(<why>)"
+                    ),
+                )

@@ -41,7 +41,7 @@ METRIC_SUFFIXES = (
     "_total", "_seconds", "_bytes", "_pending", "_done",
     "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
     "_shards", "_evictions", "_rederives", "_state",
-    "_occupancy", "_queries",
+    "_occupancy", "_queries", "_ops",
 )
 
 _CALL_RE = re.compile(
@@ -155,7 +155,8 @@ ALLOWED_TAG_KEYS = {
     "state",   # cluster state enum
     "to",      # state-transition target enum
     "won",     # hedge winner (hedge/primary)
-    "reason",  # bounded failure-reason enum (device fallback paths)
+    "reason",  # bounded failure-reason enum (device fallback, import shed)
+    "outcome", # recovery outcome enum (replayed/truncated/corrupt)
     "le",      # histogram bucket bound (static BUCKET_BOUNDS)
 }
 
