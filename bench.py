@@ -138,6 +138,13 @@ INGEST_WRITERS = int(os.environ.get("BENCH_INGEST_WRITERS", "4"))
 INGEST_READERS = int(os.environ.get("BENCH_INGEST_READERS", "8"))
 INGEST_BATCH = int(os.environ.get("BENCH_INGEST_BATCH", "256"))
 INGEST_SHARDS = int(os.environ.get("BENCH_INGEST_SHARDS", "8"))
+# Rolling-restart drill (ISSUE r9): reader client count, settle window
+# between restarts, and the per-node reconvergence timeout.
+ROLLING_READERS = int(os.environ.get("BENCH_ROLLING_READERS", "4"))
+ROLLING_SETTLE = float(os.environ.get("BENCH_ROLLING_SETTLE", "1.0"))
+ROLLING_CONVERGE_TIMEOUT = float(
+    os.environ.get("BENCH_ROLLING_CONVERGE_TIMEOUT", "45")
+)
 
 WORDS = SHARD_WIDTH // 32
 
@@ -418,6 +425,13 @@ LEG_COUNTER_FAMILIES = (
     "fragment_recovery_total",
     "fragment_snapshots_total",
     "fragment_snapshot_failures_total",
+    # Cluster-lifecycle families (ISSUE r9): resize job/fetch/lease
+    # accounting and the anti-entropy repair loop — the rolling-restart
+    # drill's convergence attribution.
+    "resize_",
+    "anti_entropy_",
+    "cluster_state_transitions_total",
+    "cluster_coordinator_promotions_total",
 )
 
 
@@ -1417,6 +1431,301 @@ def bench_ingest_under_load() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_rolling_restart() -> dict:
+    """Rolling-restart chaos drill (ISSUE r9 tentpole 4): a 3-node
+    replica_n=2 cluster of REAL server subprocesses serves the 3-ary
+    read mix plus import_value churn while each node is SIGKILLed and
+    restarted in sequence on its own data dir. The restarted node boots
+    WITHOUT any cluster config — it must reconverge purely from its
+    persisted `.topology` file (tentpole 3), the production
+    rolling-restart shape.
+
+    Captures per-restart availability (client error rate inside the
+    kill→reconverged window), reconvergence seconds (kill → the
+    restarted node answering /status NORMAL with full membership AND a
+    correct query), and end-of-drill resize/anti-entropy counter totals
+    scraped from every node's /debug/vars (subprocess registries are
+    not this process's global_stats). Returns a skipped=<reason> result
+    where subprocess networking is restricted, keeping the artifact
+    complete."""
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="pilosa-tpu-rolling-")
+    n_nodes = 3
+    ports = []
+    for _ in range(n_nodes):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+
+    def spawn(i: int, clustered: bool) -> subprocess.Popen:
+        env = dict(
+            os.environ,
+            PYTHONPATH=repo,
+            JAX_PLATFORMS="cpu",
+            PILOSA_TPU_ANTI_ENTROPY_INTERVAL="2",
+            PILOSA_TPU_RESIZE_LEASE="5",
+        )
+        if clustered:
+            env["PILOSA_TPU_CLUSTER_HOSTS"] = hosts
+            env["PILOSA_TPU_CLUSTER_REPLICAS"] = "2"
+        else:
+            # The restart boots with NO cluster config: membership must
+            # come back from the persisted .topology file alone.
+            env.pop("PILOSA_TPU_CLUSTER_HOSTS", None)
+            env.pop("PILOSA_TPU_CLUSTER_REPLICAS", None)
+        return subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", f"{tmp}/node{i}", "-b", f"127.0.0.1:{ports[i]}",
+             "--executor", "cpu"],
+            env=env, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+    def req(port: int, method: str, path: str, body=None, timeout=3.0):
+        data = (
+            body if isinstance(body, (bytes, type(None)))
+            else json.dumps(body).encode()
+        )
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method
+        )
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            raw = resp.read()
+        return json.loads(raw) if raw else {}
+
+    def node_converged(port: int) -> bool:
+        try:
+            st = req(port, "GET", "/status", timeout=2)
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+        return st.get("state") == "NORMAL" and len(st.get("nodes", [])) == n_nodes
+
+    skipped = {
+        "rolling_restart_skipped": None,
+        "rolling_restart_lost_writes": None,  # drill never ran
+        "rolling_restart_windows": [],
+        "rolling_restart_reconverge_seconds": [],
+        "rolling_restart_reconverge_max_s": None,
+        "rolling_restart_read_qps": None,
+        "rolling_restart_availability_min": None,
+        "rolling_restart_counters": {},
+    }
+    procs: list = [None] * n_nodes
+    try:
+        for i in range(n_nodes):
+            procs[i] = spawn(i, clustered=True)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(node_converged(p) for p in ports):
+                break
+            if any(pr.poll() is not None for pr in procs):
+                break
+            time.sleep(0.2)
+        else:
+            pass
+        if not all(node_converged(p) for p in ports):
+            skipped["rolling_restart_skipped"] = (
+                "subprocess cluster never became ready "
+                "(networking restricted?)"
+            )
+            return skipped
+
+        # -- schema + seed data -------------------------------------------
+        req(ports[0], "POST", "/index/roll", {})
+        for fname in ("f", "g", "h"):
+            req(ports[0], "POST", f"/index/roll/field/{fname}", {})
+        req(ports[0], "POST", "/index/roll/field/v",
+            {"options": {"type": "int", "min": -10000, "max": 10000}})
+        rng = np.random.default_rng(59)
+        seed_shards = 4
+        for fname, rows_n in (("f", ROWS), ("g", ROWS), ("h", 4)):
+            for shard in range(seed_shards):
+                cols = (
+                    np.unique(rng.integers(0, SHARD_WIDTH, 128, dtype=np.uint64))
+                    + shard * SHARD_WIDTH
+                ).tolist()
+                rows = rng.integers(0, rows_n, len(cols)).tolist()
+                req(ports[0], "POST", "/index/roll/field/" + fname + "/import",
+                    {"rowIDs": rows, "columnIDs": cols}, timeout=10)
+        # Acknowledged-write oracle: Count(Row(f=r)) per row, pre-drill.
+        oracle = {}
+        for r in range(ROWS):
+            oracle[r] = req(
+                ports[0], "POST", "/index/roll/query",
+                f"Count(Row(f={r}))".encode(),
+            )["results"][0]
+
+        # -- background traffic -------------------------------------------
+        rng_q = np.random.default_rng(61)
+        queries = [
+            f"Count(Intersect(Row(f={int(rng_q.integers(0, ROWS))}), "
+            f"Row(g={int(rng_q.integers(0, ROWS))}), "
+            f"Row(h={int(rng_q.integers(0, 4))})))".encode()
+            for _ in range(32)
+        ]
+        events: list = []  # (monotonic_t, ok)
+        ev_lock = threading.Lock()
+        stop = threading.Event()
+
+        def reader(k: int) -> None:
+            j = k
+            while not stop.is_set():
+                port = ports[j % n_nodes]
+                j += 1
+                try:
+                    out = req(port, "POST", "/index/roll/query",
+                              queries[j % len(queries)], timeout=2)
+                    ok = "results" in out
+                except (urllib.error.URLError, OSError, ValueError,
+                        ConnectionError):
+                    ok = False
+                with ev_lock:
+                    events.append((time.monotonic(), ok))
+
+        def writer() -> None:
+            r = np.random.default_rng(67)
+            j = 0
+            while not stop.is_set():
+                port = ports[j % n_nodes]
+                j += 1
+                shard = int(r.integers(0, seed_shards))
+                cols = (r.integers(0, SHARD_WIDTH, 32) + shard * SHARD_WIDTH
+                        ).tolist()
+                vals = r.integers(-9000, 9001, 32).tolist()
+                try:
+                    req(port, "POST", "/index/roll/field/v/import",
+                        {"columnIDs": cols, "values": vals}, timeout=2)
+                except (urllib.error.URLError, OSError, ValueError,
+                        ConnectionError):
+                    pass  # churn is best-effort; reads carry availability
+                time.sleep(0.02)
+
+        threads = [
+            threading.Thread(target=reader, args=(k,), daemon=True)
+            for k in range(ROLLING_READERS)
+        ] + [threading.Thread(target=writer, daemon=True)]
+        t_traffic = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(ROLLING_SETTLE)
+
+        # -- the drill: restart each node in sequence ---------------------
+        windows = []
+        for i in range(n_nodes):
+            t_kill = time.monotonic()
+            procs[i].send_signal(signal.SIGKILL)
+            procs[i].wait(timeout=10)
+            procs[i] = spawn(i, clustered=False)
+            conv_deadline = time.monotonic() + ROLLING_CONVERGE_TIMEOUT
+            converged = False
+            while time.monotonic() < conv_deadline:
+                if node_converged(ports[i]):
+                    try:
+                        got = req(ports[i], "POST", "/index/roll/query",
+                                  b"Count(Row(f=0))", timeout=2)["results"][0]
+                        if got == oracle[0]:
+                            converged = True
+                            break
+                    except (urllib.error.URLError, OSError, ValueError,
+                            KeyError):
+                        pass
+                time.sleep(0.1)
+            t_conv = time.monotonic()
+            with ev_lock:
+                win = [(t, ok) for t, ok in events if t_kill <= t <= t_conv]
+            n_req = len(win)
+            n_err = sum(1 for _, ok in win if not ok)
+            windows.append({
+                "node": i,
+                "reconverged": converged,
+                "reconverge_seconds": round(t_conv - t_kill, 2),
+                "requests": n_req,
+                "errors": n_err,
+                "availability": round(1.0 - n_err / n_req, 4) if n_req else None,
+            })
+            time.sleep(ROLLING_SETTLE)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        elapsed = time.monotonic() - t_traffic
+
+        # -- no lost acknowledged writes ----------------------------------
+        # f was never written during the drill: every pre-drill count
+        # must survive all three restarts, on every node. Mismatches are
+        # REPORTED (not raised): the artifact must carry the verdict,
+        # not convert it into a skipped leg.
+        lost = []
+        for p in ports:
+            for r, want in oracle.items():
+                try:
+                    got = req(p, "POST", "/index/roll/query",
+                              f"Count(Row(f={r}))".encode(),
+                              timeout=5)["results"][0]
+                except (urllib.error.URLError, OSError, ValueError,
+                        KeyError, ConnectionError):
+                    # An unreachable node is REPORTED, not allowed to
+                    # discard the drill's measured windows as skipped.
+                    got = None
+                if got != want:
+                    lost.append({"port": p, "row": r, "got": got, "want": want})
+
+        # -- counter totals scraped from the subprocess registries --------
+        counters: dict = {}
+        for p in ports:
+            try:
+                snap = req(p, "GET", "/debug/vars", timeout=5).get("counters", {})
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+            for k, v in snap.items():
+                if k.startswith(("resize_", "anti_entropy_", "cluster_",
+                                 "fragment_recovery_total",
+                                 "wal_truncated_records_total")):
+                    counters[k] = counters.get(k, 0) + round(v)
+
+        with ev_lock:
+            total = len(events)
+            errs = sum(1 for _, ok in events if not ok)
+        avail = [w["availability"] for w in windows if w["availability"] is not None]
+        return {
+            "rolling_restart_lost_writes": lost,
+            "rolling_restart_skipped": None,
+            "rolling_restart_windows": windows,
+            "rolling_restart_reconverge_seconds": [
+                w["reconverge_seconds"] for w in windows
+            ],
+            "rolling_restart_reconverge_max_s": max(
+                (w["reconverge_seconds"] for w in windows), default=None
+            ),
+            "rolling_restart_read_qps": round(total / elapsed, 1)
+            if elapsed > 0 else None,
+            "rolling_restart_availability_min": min(avail) if avail else None,
+            "rolling_restart_counters": counters,
+        }
+    except Exception as e:  # noqa: BLE001 — the artifact must stay complete
+        skipped["rolling_restart_skipped"] = f"{type(e).__name__}: {e}"
+        return skipped
+    finally:
+        for pr in procs:
+            if pr is not None and pr.poll() is None:
+                pr.kill()
+                try:
+                    pr.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     out: dict = {
         "partial": True,
@@ -1640,6 +1949,7 @@ def main():
     checkpoint("concurrency_sweep", **sweep)
     checkpoint("degraded_qps", **bench_degraded_qps())
     checkpoint("ingest_under_load", **bench_ingest_under_load())
+    checkpoint("rolling_restart", **bench_rolling_restart())
 
     out.update(
         {

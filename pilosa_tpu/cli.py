@@ -94,14 +94,63 @@ def cmd_server(args) -> int:
         else None
     )
 
-    def wire_cluster(topo_nodes, local_id):
-        """Shared cluster bootstrap for both the static-hosts and --join
-        paths: build the topology, attach seams, start daemons."""
+    # Persisted topology (ISSUE r9 tentpole 3): membership survives
+    # restarts in <data-dir>/.topology, written atomically on every
+    # durable change, so a restarting node rejoins with its same
+    # identity and a full-cluster restart reconverges without operator
+    # re-seeding.
+    from pilosa_tpu.cluster.topology import TOPOLOGY_FILE, load_topology
+
+    topo_path = os.path.join(data_dir, TOPOLOGY_FILE)
+    saved = load_topology(topo_path)  # None on absent/corrupt: reseed
+    saved_nodes = []
+    saved_local = None
+    if saved:
+        from pilosa_tpu.cluster import Node
+        from pilosa_tpu.cluster.topology import NODE_STATE_READY
+
+        saved_nodes = [Node.from_json(d) for d in saved["nodes"]]
+        for n in saved_nodes:
+            # Persisted liveness is stale by definition: every member
+            # boots READY and the failure detector re-learns the truth.
+            n.state = NODE_STATE_READY
+        saved_local = next(
+            (
+                n
+                for n in saved_nodes
+                if n.uri.host == cfg.host and n.uri.port == cfg.port
+            ),
+            None,
+        )
+
+    def restore_saved_cluster():
+        """Boot from the persisted topology: the one restore sequence
+        both the --join-restart and no-cluster-config paths share."""
+        if saved.get("replicaN"):
+            cfg.cluster.replicas = int(saved["replicaN"])
+        cluster = wire_cluster(
+            saved_nodes, saved_local.id, partition_n=saved.get("partitionN")
+        )
+        log.printf(
+            "restored topology from %s: %d nodes, replicas=%d, local id %s",
+            topo_path, len(saved_nodes), cfg.cluster.replicas, saved_local.id,
+        )
+        return cluster
+
+    def wire_cluster(topo_nodes, local_id, partition_n=None):
+        """Shared cluster bootstrap for the static-hosts, --join, and
+        persisted-topology paths: build the topology, attach seams,
+        start daemons."""
         from pilosa_tpu.cluster import Cluster, InternalClient, Topology
         from pilosa_tpu.cluster.breaker import BreakerRegistry
         from pilosa_tpu.cluster.sync import FailureDetector, SyncDaemon
+        from pilosa_tpu.cluster.topology import DEFAULT_PARTITION_N
 
-        topo = Topology(topo_nodes, replica_n=cfg.cluster.replicas)
+        topo = Topology(
+            topo_nodes,
+            replica_n=cfg.cluster.replicas,
+            partition_n=partition_n or DEFAULT_PARTITION_N,
+        )
         local = topo.node_by_id(local_id)
         if local is None:
             return None
@@ -121,7 +170,20 @@ def cmd_server(args) -> int:
         cluster.logger = log
         cluster.attach(executor, api)
         api.cluster = cluster
-        cluster.attach_resizer(log)
+        resizer = cluster.attach_resizer(log)
+        # Cluster-lifecycle knobs (ISSUE r9): follower rollback lease +
+        # migration throttles.
+        resizer.lease_timeout = cfg.resize_lease
+        resizer.fetch_concurrency = cfg.migration_concurrency
+        resizer.bandwidth_limit = cfg.migration_bandwidth
+        resizer.fetch_timeout = cfg.client_timeout
+        if saved:
+            # The resize epoch survives restarts: a rebooted
+            # coordinator's fresh jobs must outrank any dead job whose
+            # completion reports are still in retry flight.
+            resizer._epoch = int(saved.get("resizeEpoch") or 0)
+        cluster.topology_file = topo_path
+        cluster.persist_topology()
         daemons.append(
             SyncDaemon(cluster, interval=cfg.anti_entropy_interval, logger=log).start()
         )
@@ -147,12 +209,21 @@ def cmd_server(args) -> int:
         # machinery delivers schema + fragments + the real topology.
         from pilosa_tpu.cluster import Node, URI
 
-        local_id = f"node-{cfg.host}-{cfg.port}"
-        local = Node(
-            id=local_id,
-            uri=URI(scheme=local_scheme, host=cfg.host, port=cfg.port),
-        )
-        join_cluster_ref = wire_cluster([local], local_id)
+        if saved_local is not None and len(saved_nodes) > 1:
+            # Restart of a previously joined node: come back with the
+            # SAME identity and the last known membership — the cluster
+            # still routes shards to us, so booting as a blank
+            # single-node would orphan them until a fresh resize. The
+            # announce below re-syncs schema/shards (handle_join's
+            # restarted-member path) without moving any data.
+            join_cluster_ref = restore_saved_cluster()
+        else:
+            local_id = f"node-{cfg.host}-{cfg.port}"
+            local = Node(
+                id=local_id,
+                uri=URI(scheme=local_scheme, host=cfg.host, port=cfg.port),
+            )
+            join_cluster_ref = wire_cluster([local], local_id)
     elif cfg.cluster.hosts:
         from pilosa_tpu.cluster import Node, URI
 
@@ -186,6 +257,12 @@ def cmd_server(args) -> int:
             "clustered: %d nodes, replicas=%d, coordinator=%s",
             len(nodes), cfg.cluster.replicas, cluster.coordinator().id,
         )
+    elif saved_local is not None and len(saved_nodes) > 1:
+        # No cluster config at all, but a persisted topology: a
+        # full-cluster restart reconverges straight from the file —
+        # every member boots with the membership it last agreed on, no
+        # operator re-seeding (ISSUE r9 tentpole 3).
+        restore_saved_cluster()
 
     server = Server(api, host=cfg.host, port=cfg.port, tls=cfg.tls)  # binds
     log.printf(

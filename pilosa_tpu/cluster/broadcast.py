@@ -30,6 +30,10 @@ MSG_NODE_STATE = "node-state"
 MSG_NODE_STATUS = "node-status"
 MSG_RECALCULATE_CACHES = "recalculate-caches"
 MSG_RESIZE_ABORT = "resize-abort"
+# Coordinator liveness while a resize job is in flight (ISSUE r9):
+# followers renew their rollback lease on each one; when the coordinator
+# dies the heartbeats die with it and every follower's lease expires.
+MSG_RESIZE_HEARTBEAT = "resize-heartbeat"
 
 # Node events (reference event.go).
 EVENT_JOIN = "join"

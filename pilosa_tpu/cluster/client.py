@@ -140,6 +140,7 @@ class InternalClient:
         content_type: str = "application/json",
         raw: bool = False,
         op: str = "",
+        want_headers: bool = False,
     ):
         """One RPC with bounded jittered-backoff retries for idempotent
         GETs on transport errors. Retries stop early when the peer's
@@ -151,7 +152,8 @@ class InternalClient:
         for attempt in range(attempts):
             try:
                 return self._do_once(method, uri, path, body=body,
-                                     content_type=content_type, raw=raw, op=op)
+                                     content_type=content_type, raw=raw, op=op,
+                                     want_headers=want_headers)
             except ClientError as e:
                 if not e.transport or attempt + 1 >= attempts:
                     raise
@@ -175,6 +177,7 @@ class InternalClient:
         content_type: str = "application/json",
         raw: bool = False,
         op: str = "",
+        want_headers: bool = False,
     ):
         url = self._connect_uri(uri) + path
         # Per-peer, per-method RPC telemetry (ISSUE r8 tentpole 2): the
@@ -223,6 +226,9 @@ class InternalClient:
                     req, timeout=timeout, context=self.ssl_context
                 ) as resp:
                     data = resp.read()
+                    # email.message.Message: case-insensitive .get(),
+                    # captured only on request (checksum verification).
+                    resp_headers = resp.headers if want_headers else None
             except urllib.error.HTTPError as e:
                 detail = ""
                 err_code = ""
@@ -271,7 +277,7 @@ class InternalClient:
             stats.timing("peer_rpc_seconds", time.perf_counter() - t0)
             _track_inflight(peer, -1)
         if raw:
-            return data
+            return (data, resp_headers) if want_headers else data
         if not data:
             return {}
         try:
@@ -399,13 +405,40 @@ class InternalClient:
 
     def retrieve_shard(self, uri, index: str, field: str, view: str, shard: int) -> bytes:
         """Whole-fragment roaring payload (reference RetrieveShardFromURI
-        http/client.go:742, used by resize cluster.go:1297)."""
-        return self._do(
+        http/client.go:742, used by resize cluster.go:1297).
+
+        Verified (ISSUE r9 tentpole 2): the server stamps an
+        X-Pilosa-Content-Checksum header and the payload is checked here
+        BEFORE any caller can import_roaring it — a corrupt transfer
+        raises code=checksum-mismatch so the resize fetcher retries /
+        fails over instead of silently ingesting garbage. Peers too old
+        to send the header skip verification (rolling-upgrade safe)."""
+        import zlib
+
+        out = self._do(
             "GET", uri,
             f"/internal/fragment/data?index={index}&field={field}&view={view}&shard={shard}",
             raw=True,
             op="retrieve_shard",
+            want_headers=True,
         )
+        data, headers = out
+        want = (headers.get("X-Pilosa-Content-Checksum") or "") if headers else ""
+        if want:
+            got = f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+            if got != want:
+                # Same integrity class as an unparseable JSON body: the
+                # bytes that arrived are not the bytes the peer meant.
+                global_stats.with_tags(
+                    f"peer:{peer_label(uri)}", "method:retrieve_shard",
+                    "class:decode",
+                ).count("peer_rpc_errors_total")
+                raise ClientError(
+                    f"fragment payload checksum mismatch from "
+                    f"{peer_label(uri)}: got {got}, want {want}",
+                    code="checksum-mismatch",
+                )
+        return data
 
     def field_state(self, uri, index: str, field: str) -> dict:
         """Peer field state: view names + available shards (anti-entropy

@@ -115,6 +115,40 @@ class Cluster:
         # Monotonic leg ids for the hedged gather (shared across
         # concurrent map_shards calls; uniqueness is all that matters).
         self._leg_ids = itertools.count(1)
+        # Path of the persisted-topology file (ISSUE r9 tentpole 3):
+        # when set (the CLI points it at <data-dir>/.topology), every
+        # durable membership change — CLUSTER_STATUS node lists,
+        # coordinator moves — rewrites it atomically so a restarting
+        # node rejoins with its same identity and a full-cluster restart
+        # reconverges without operator re-seeding.
+        self.topology_file: Optional[str] = None
+        self._topology_file_lock = threading.Lock()
+
+    def persist_topology(self) -> None:
+        """Best-effort atomic rewrite of the topology file; a failed
+        persist is logged (the live cluster keeps working — the file
+        only matters at the NEXT boot). Serialized: during a failover
+        the broadcast handler and the failure detector both persist, and
+        two writers sharing the one tmp file would interleave into torn
+        JSON — losing the identity the file exists to preserve."""
+        if not self.topology_file:
+            return
+        from pilosa_tpu.cluster.topology import save_topology
+
+        epoch = 0
+        if self.resizer is not None:
+            # The resize epoch survives coordinator restarts through the
+            # same file, so a rebooted coordinator's fresh jobs can never
+            # reuse a dead job's (job, epoch) identity.
+            epoch = self.resizer._epoch
+        try:
+            with self._topology_file_lock:
+                save_topology(
+                    self.topology_file, self.topology, self.local_node.id,
+                    resize_epoch=epoch,
+                )
+        except OSError as e:
+            self._log("topology persist to %s failed: %s", self.topology_file, e)
 
     # -- wiring ------------------------------------------------------------
 
@@ -881,8 +915,24 @@ class Cluster:
                 mine = next((n for n in new_nodes if n.id == self.local_node.id), None)
                 if mine is not None:
                     self.local_node = mine
+                # Membership is durable state: persist so a restart
+                # rejoins with the same identity (ISSUE r9 tentpole 3).
+                self.persist_topology()
+            if self.resizer is not None:
+                from pilosa_tpu.cluster.topology import STATE_RESIZING
+
+                if msg.get("state") == STATE_RESIZING:
+                    # The freeze arms the follower's rollback lease: a
+                    # coordinator that dies right after freezing must not
+                    # strand this node in RESIZING forever.
+                    self.resizer.renew_lease(msg)
+                elif "state" in msg:
+                    self.resizer.cancel_lease()
             if msg.get("state") == STATE_NORMAL and self.resizer is not None:
                 self.resizer.clean_holder()
+        elif typ == bc.MSG_RESIZE_HEARTBEAT:
+            if self.resizer is not None:
+                self.resizer.renew_lease(msg)
         elif typ == bc.MSG_RECALCULATE_CACHES:
             if self.api is not None:
                 self.api.recalculate_caches()
@@ -899,7 +949,10 @@ class Cluster:
                 self.resizer.mark_complete(msg)
         elif typ == bc.MSG_RESIZE_ABORT:
             if self.resizer is not None:
-                self.resizer.abort()
+                # local=True: a received abort is applied, never echoed —
+                # two nodes both holding the coordinator flag during a
+                # failover window would otherwise ping-pong it forever.
+                self.resizer.abort(local=True)
         elif typ == bc.MSG_NODE_EVENT:
             self._handle_node_event(msg)
         elif typ == bc.MSG_NODE_STATE:
@@ -933,9 +986,19 @@ class Cluster:
                 target.state = state
         elif typ == bc.MSG_SET_COORDINATOR:
             new_id = msg.get("id")
+            was_coordinator = self.local_node.is_coordinator
             for n in self.topology.nodes:
                 n.is_coordinator = n.id == new_id
             self.local_node.is_coordinator = self.local_node.id == new_id
+            self.persist_topology()
+            if (
+                self.local_node.is_coordinator
+                and not was_coordinator
+                and self.resizer is not None
+            ):
+                # A promotion mid-resize adopts (and aborts) the dead
+                # coordinator's orphaned job (ISSUE r9 tentpole 1).
+                self.resizer.on_promoted()
         # unknown types ignored (forward compatibility)
 
     def _handle_node_event(self, msg: Message) -> None:

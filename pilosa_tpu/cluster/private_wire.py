@@ -224,11 +224,15 @@ def _enc_source(src: dict) -> bytes:
     out += _encode_string(2, src.get("field", ""))
     out += _encode_uint64(3, int(src.get("shard", 0)))
     out += _encode_string(4, str(src.get("from", "")))
+    # Alternate surviving owners the fetcher fails over to (ISSUE r9);
+    # repeated string, absent on frames from older builds.
+    for alt in src.get("alts") or []:
+        out += _encode_string(5, str(alt))
     return out
 
 
 def _dec_source(data: bytes) -> dict:
-    src = {"index": "", "field": "", "shard": 0, "from": ""}
+    src: dict = {"index": "", "field": "", "shard": 0, "from": "", "alts": []}
     for fnum, _w, v in _iter_fields(data):
         if fnum == 1:
             src["index"] = _field_str(v)
@@ -238,6 +242,8 @@ def _dec_source(data: bytes) -> dict:
             src["shard"] = int(v)
         elif fnum == 4:
             src["from"] = _field_str(v)
+        elif fnum == 5:
+            src["alts"].append(_field_str(v))
     return src
 
 
@@ -362,12 +368,16 @@ def _enc_resize_instruction(m: dict) -> bytes:
     for src in m.get("sources") or []:
         out += _encode_bytes(4, _enc_source(src))
     out += _encode_string(5, str(m.get("node", "")))
+    # Job epoch (ISSUE r9): completions must echo it or the coordinator
+    # rejects them as stale — dropping it on the wire would reject EVERY
+    # completion and wedge the job at its timeout.
+    out += _encode_uint64(6, int(m.get("epoch") or 0))
     out += _enc_avail(m.get("available") or {})
     return out
 
 
 def _dec_resize_instruction(data: bytes) -> dict:
-    m: dict = {"job": 0, "sources": []}
+    m: dict = {"job": 0, "epoch": 0, "sources": []}
     avail: dict = {}
     for fnum, _w, v in _iter_fields(data):
         if fnum == 1:
@@ -380,6 +390,8 @@ def _dec_resize_instruction(data: bytes) -> dict:
             m["sources"].append(_dec_source(v))
         elif fnum == 5:
             m["node"] = _field_str(v)
+        elif fnum == 6:
+            m["epoch"] = int(v)
         elif fnum == 15:
             _dec_avail_entry(v, avail)
     if avail:
@@ -392,11 +404,12 @@ def _enc_resize_complete(m: dict) -> bytes:
     out += _encode_string(2, m.get("node", ""))
     if m.get("error"):
         out += _encode_string(3, str(m["error"]))
+    out += _encode_uint64(4, int(m.get("epoch") or 0))
     return out
 
 
 def _dec_resize_complete(data: bytes) -> dict:
-    m: dict = {"job": 0, "node": ""}
+    m: dict = {"job": 0, "epoch": 0, "node": ""}
     for fnum, _w, v in _iter_fields(data):
         if fnum == 1:
             m["job"] = int(v)
@@ -404,6 +417,8 @@ def _dec_resize_complete(data: bytes) -> dict:
             m["node"] = _field_str(v)
         elif fnum == 3:
             m["error"] = _field_str(v)
+        elif fnum == 4:
+            m["epoch"] = int(v)
     return m
 
 

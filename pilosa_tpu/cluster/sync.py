@@ -24,7 +24,9 @@ Three loops the reference runs as background monitors:
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from typing import Optional
 
 from pilosa_tpu.cluster.client import ClientError
@@ -206,10 +208,19 @@ class HolderSyncer:
     def sync_holder(self) -> int:
         """Walk schema, diff checksums vs replicas, merge differing blocks.
         Returns the number of blocks repaired (reference SyncHolder
-        holder.go:911)."""
+        holder.go:911).
+
+        Observability (ISSUE r9 satellite): each pass counts
+        anti_entropy_runs_total, times itself into the
+        anti_entropy_run_seconds histogram, and stamps the
+        anti_entropy_last_run_seconds gauge (monotonic clock, same base
+        as the exported uptime: `uptime - value` is the run's age) — a
+        stalled syncer on one node used to be invisible except as the
+        absence of log lines."""
         holder = self.cluster.holder
         if holder is None:
             return 0
+        t0 = time.monotonic()
         repaired = 0
         self._sync_schema()
         for index_name in list(holder.indexes):
@@ -235,6 +246,9 @@ class HolderSyncer:
                         )
         # Drain any control messages that failed to broadcast earlier.
         self.cluster.flush_pending_broadcasts()
+        global_stats.count("anti_entropy_runs_total")
+        global_stats.timing("anti_entropy_run_seconds", time.monotonic() - t0)
+        global_stats.gauge("anti_entropy_last_run_seconds", time.monotonic())
         return repaired
 
     def _live_replicas(self, index: str, shard: int):
@@ -315,6 +329,9 @@ class HolderSyncer:
                 added, _ = frag.merge_block(block_id, data)
                 if added:
                     repaired += 1
+                    global_stats.with_tags("kind:fragment").count(
+                        "anti_entropy_blocks_repaired_total"
+                    )
         return repaired
 
     def _sync_attrs(self, index: str, field_name: Optional[str], store) -> int:
@@ -341,6 +358,9 @@ class HolderSyncer:
                     if attrs:
                         store.set_attrs(int(id_), attrs)
                         repaired += 1
+                        global_stats.with_tags("kind:attr").count(
+                            "anti_entropy_blocks_repaired_total"
+                        )
         return repaired
 
     def _sync_translation(self) -> None:
@@ -383,7 +403,11 @@ class SyncDaemon:
         return self
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+        # ±25% jitter per cycle (ISSUE r9 satellite): a fleet restarted
+        # together would otherwise run synchronized cluster-wide checksum
+        # storms at every interval, forever — the phases decorrelate
+        # within a few cycles instead.
+        while not self._stop.wait(self.interval * (0.75 + 0.5 * random.random())):
             try:
                 n = self.syncer.sync_holder()
                 self.syncer._sync_translation()
@@ -542,12 +566,34 @@ class FailureDetector:
                 and (ours is None or ours.state == NODE_STATE_DOWN)
                 and (ours is None or ours.id != peer_coord)
             ):
+                was_coordinator = self.cluster.local_node.is_coordinator
                 for n in self.cluster.topology.nodes:
                     n.is_coordinator = n.id == peer_coord
                 self.cluster.local_node.is_coordinator = local_id == peer_coord
+                self.cluster.persist_topology()
                 self.log.printf(
                     "adopted coordinator %s from peer %s's view", peer_coord, peer.id
                 )
+                if (
+                    self.cluster.local_node.is_coordinator
+                    and not was_coordinator
+                    and self.cluster.resizer is not None
+                ):
+                    self.cluster.resizer.on_promoted()
+        # A peer frozen in RESIZING on a job this (coordinator) node
+        # doesn't own reports the orphaned job in its /status; adopt and
+        # abort it so the follower unfreezes before its own lease fires
+        # (ISSUE r9 tentpole 1).
+        from pilosa_tpu.cluster.topology import STATE_RESIZING
+
+        rz_info = st.get("resize")
+        if (
+            rz_info
+            and st.get("state") == STATE_RESIZING
+            and self.cluster.is_coordinator()
+            and self.cluster.resizer is not None
+        ):
+            self.cluster.resizer.observe_follower(rz_info)
 
     def _heal_returning_node(self, node) -> None:
         """A node that comes back READY missed every broadcast while it
@@ -607,9 +653,15 @@ class FailureDetector:
         for n in topo.nodes:
             n.is_coordinator = n.id == successor.id
         self.cluster.local_node.is_coordinator = True
+        self.cluster.persist_topology()
         self.cluster.broadcaster.send_async(
             bc.Message.make(bc.MSG_SET_COORDINATOR, id=successor.id)
         )
+        # A promotion mid-resize adopts (and aborts) the dead
+        # coordinator's orphaned job so followers unfreeze without
+        # waiting out their leases (ISSUE r9 tentpole 1).
+        if self.cluster.resizer is not None:
+            self.cluster.resizer.on_promoted()
 
     def _disseminate(self, node_id: str, state: str) -> None:
         """Share the observed transition over the broadcast bus so every

@@ -188,3 +188,60 @@ class Topology:
             if any(n.id == node.id for n in self.shard_nodes(index, s)):
                 out.append(s)
         return out
+
+
+# ---------------------------------------------------------------------------
+# persisted topology (ISSUE r9 tentpole 3)
+# ---------------------------------------------------------------------------
+
+#: File name under the data dir; the reference persists .topology the
+#: same way (topology.go encode/decode via holder.loadTopology).
+TOPOLOGY_FILE = ".topology"
+
+
+def save_topology(path: str, topology: Topology, local_id: str,
+                  resize_epoch: int = 0) -> None:
+    """Atomically persist membership (nodes, replicaN, partitionN) plus
+    this node's identity and the resize epoch. tmp + os.replace: a crash
+    mid-write leaves either the old complete file or the new complete
+    file, never a torn prefix (the PR 8 durable-write discipline — the
+    lint rule covers this package)."""
+    import json
+    import os
+
+    blob = json.dumps(
+        {
+            "localID": local_id,
+            "replicaN": topology.replica_n,
+            "partitionN": topology.partition_n,
+            "resizeEpoch": int(resize_epoch),
+            "nodes": [n.to_json() for n in topology.nodes],
+        }
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(blob + "\n")
+    os.replace(tmp, path)
+
+
+def load_topology(path: str) -> Optional[dict]:
+    """The persisted topology dict, or None when the file is absent,
+    unparseable, or structurally invalid (a corrupt topology file must
+    degrade to 'seed me again', never crash the boot — the operator's
+    config still works). Every node entry must round-trip through
+    Node.from_json, so callers can construct Nodes without guarding."""
+    import json
+
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(d, dict) or not isinstance(d.get("nodes"), list):
+        return None
+    try:
+        for entry in d["nodes"]:
+            Node.from_json(entry)
+    except (TypeError, KeyError, ValueError, AttributeError):
+        return None  # truncated / hand-mangled node entries
+    return d

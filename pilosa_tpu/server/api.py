@@ -530,12 +530,30 @@ class API:
     # shard, each group sent to every owning node) ------------------------
 
     def _owners_by_node(self, index: str, shards: set[int]):
-        """node id -> (node, is_local, set of its shards), over replicas."""
+        """node id -> (node, is_local, set of its shards), over replicas.
+
+        DOWN or circuit-broken replicas are skipped exactly like the
+        route_write path (anti-entropy delivers the import when they
+        return) — previously one dead replica failed the WHOLE import
+        with a 502, which made every import during a rolling restart an
+        error instead of a degraded write (ISSUE r9). A shard with NO
+        live owner still fails loudly: a silently dropped import is
+        unrepairable."""
         topo = self.cluster.topology
         local_id = self.cluster.local_node.id
         out: dict[str, tuple] = {}
         for shard in shards:
-            for node in topo.shard_nodes(index, shard):
+            reps = topo.shard_nodes(index, shard)
+            live = [
+                n for n in reps
+                if n.id == local_id or not self.cluster._peer_unwritable(n)
+            ]
+            if reps and not live:
+                err = self.cluster._no_live_replica(index, shard)
+                raise APIError(
+                    str(err), status=503, code="replicas-unavailable"
+                )
+            for node in live:
                 entry = out.setdefault(node.id, (node, node.id == local_id, set()))
                 entry[2].add(shard)
         return out.values()
@@ -688,11 +706,19 @@ class API:
                            "port": self.local_port},
                    "isCoordinator": True, "state": "READY"}]
         )
-        return {
+        out = {
             "state": self.cluster.state() if self.cluster is not None else "NORMAL",
             "nodes": nodes,
             "localID": self.cluster.node_id if self.cluster is not None else "local",
         }
+        if self.cluster is not None and self.cluster.resizer is not None:
+            # A follower frozen mid-resize reports the job it is frozen
+            # on; a promoted coordinator's probes read this and abort the
+            # orphan for it (ISSUE r9 tentpole 1).
+            rz = self.cluster.resizer.follower_status()
+            if rz:
+                out["resize"] = rz
+        return out
 
     def info(self) -> dict:
         import os

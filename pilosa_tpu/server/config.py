@@ -9,9 +9,13 @@ from __future__ import annotations
 
 import json
 import os
-import tomllib
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+try:  # py3.11+ stdlib; gated so a 3.10 runtime still boots servers
+    import tomllib  # configured via env/flags (TOML files raise clearly)
+except ModuleNotFoundError:  # pragma: no cover — interpreter-dependent
+    tomllib = None
 
 
 @dataclass
@@ -110,6 +114,17 @@ class Config:
     # many seconds is re-launched at the next live replica (first result
     # wins). 0 disables hedging.
     hedge_delay: float = 0.25
+    # -- cluster lifecycle (ISSUE r9) --------------------------------------
+    # Follower-side resize lease in seconds: a node frozen in RESIZING
+    # that hears neither a coordinator heartbeat nor a terminal status
+    # for this long rolls itself back to NORMAL on the old topology
+    # (the coordinator-crash escape hatch).
+    resize_lease: float = 90.0
+    # Concurrent fragment fetches while following a resize instruction.
+    migration_concurrency: int = 2
+    # Aggregate migration fetch bandwidth cap in bytes/s (0 = uncapped)
+    # so a resize cannot saturate the links the serving path shares.
+    migration_bandwidth: int = 0
     # In-flight /query admission cap (server/http.py): past this many
     # concurrently executing queries, new ones are shed with 429 +
     # Retry-After + code=overloaded (http_requests_shed_total) instead
@@ -235,6 +250,9 @@ class Config:
             "breaker-threshold": self.breaker_threshold,
             "breaker-cooldown": self.breaker_cooldown,
             "hedge-delay": self.hedge_delay,
+            "resize-lease": self.resize_lease,
+            "migration-concurrency": self.migration_concurrency,
+            "migration-bandwidth": self.migration_bandwidth,
             "slo": [dict(o) for o in self.slo],
         }
 
@@ -244,6 +262,11 @@ class Config:
     ) -> "Config":
         cfg = Config()
         if toml_path:
+            if tomllib is None:
+                raise RuntimeError(
+                    "TOML config files need Python 3.11+ (tomllib); "
+                    "use PILOSA_TPU_* env vars or flags on this runtime"
+                )
             with open(toml_path, "rb") as f:
                 data = tomllib.load(f)
             cfg._apply_toml(data)
@@ -275,6 +298,9 @@ class Config:
             "breaker-threshold": "breaker_threshold",
             "breaker-cooldown": "breaker_cooldown",
             "hedge-delay": "hedge_delay",
+            "resize-lease": "resize_lease",
+            "migration-concurrency": "migration_concurrency",
+            "migration-bandwidth": "migration_bandwidth",
         }
         for k, attr in simple.items():
             if k in data:
@@ -324,6 +350,9 @@ class Config:
             pre + "BREAKER_THRESHOLD": ("breaker_threshold", int),
             pre + "BREAKER_COOLDOWN": ("breaker_cooldown", float),
             pre + "HEDGE_DELAY": ("hedge_delay", float),
+            pre + "RESIZE_LEASE": ("resize_lease", float),
+            pre + "MIGRATION_CONCURRENCY": ("migration_concurrency", int),
+            pre + "MIGRATION_BANDWIDTH": ("migration_bandwidth", int),
             pre + "SLO": (
                 "slo",
                 lambda v: Config._normalize_slo(json.loads(v)) if v else [],
@@ -367,6 +396,9 @@ class Config:
             f"breaker-threshold = {c.breaker_threshold}\n"
             f"breaker-cooldown = {c.breaker_cooldown}\n"
             f"hedge-delay = {c.hedge_delay}\n"
+            f"resize-lease = {c.resize_lease}\n"
+            f"migration-concurrency = {c.migration_concurrency}\n"
+            f"migration-bandwidth = {c.migration_bandwidth}\n"
             + "".join(
                 "\n[[slo]]\n"
                 # json.dumps: a tagged metric spelling like

@@ -1451,9 +1451,22 @@ class _Handler(BaseHTTPRequestHandler):
         if frag is None:
             self._error("fragment not found", status=404)
             return
+        import zlib
+
         from pilosa_tpu.roaring import serialize
 
-        self._reply(serialize(frag.storage), content_type="application/octet-stream")
+        data = serialize(frag.storage)
+        # Content checksum (ISSUE r9 tentpole 2): the resize fetcher
+        # verifies this before import_roaring, so a corrupt transfer is
+        # retried from another source instead of silently ingested.
+        self._reply(
+            data,
+            content_type="application/octet-stream",
+            headers={
+                "X-Pilosa-Content-Checksum":
+                    f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+            },
+        )
 
     @route("GET", r"/internal/fragment/blocks")
     def handle_get_fragment_blocks(self):

@@ -37,6 +37,12 @@ SMOKE_ENV = {
     "BENCH_INGEST_READERS": "2",
     "BENCH_INGEST_BATCH": "32",
     "BENCH_INGEST_SHARDS": "2",
+    # Tiny rolling-restart drill (r9): subprocess-cluster machinery
+    # smoke; the leg self-skips (keys still present) where subprocess
+    # networking is restricted.
+    "BENCH_ROLLING_READERS": "2",
+    "BENCH_ROLLING_SETTLE": "0.3",
+    "BENCH_ROLLING_CONVERGE_TIMEOUT": "45",
 }
 
 
@@ -72,10 +78,23 @@ def test_bench_smoke(tmp_path):
     assert blob["ingest_read_qps_under_load"] > 0
     assert "ingest_read_p99_delta_ms" in blob
     assert "ingest_version_walks" in blob
+    # The r9 rolling-restart keys: present even when the environment
+    # forces a skip; when the drill ran, every restart reconverged.
+    for key in ("rolling_restart_skipped", "rolling_restart_windows",
+                "rolling_restart_reconverge_seconds",
+                "rolling_restart_reconverge_max_s",
+                "rolling_restart_availability_min",
+                "rolling_restart_counters"):
+        assert key in blob, key
+    if blob["rolling_restart_skipped"] is None:
+        assert len(blob["rolling_restart_windows"]) == 3
+        assert all(w["reconverged"] for w in blob["rolling_restart_windows"])
+        assert blob["rolling_restart_lost_writes"] == []
     # Every leg checkpointed along the way.
     for leg in ("build", "cold_build", "tpu_batch", "single_query",
                 "minmax_churn", "http", "qps@1", "qps@4",
-                "concurrency_sweep", "ingest_under_load"):
+                "concurrency_sweep", "ingest_under_load",
+                "rolling_restart"):
         assert leg in blob["legs_done"], blob["legs_done"]
     # The partial artifact also landed complete on disk.
     disk = json.loads(open(env["BENCH_PARTIAL_PATH"]).read())

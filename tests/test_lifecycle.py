@@ -1,0 +1,1030 @@
+"""Cluster lifecycle robustness (ISSUE r9): failover-safe resize
+(follower leases, coordinator heartbeats, completion-report retry,
+promoted-coordinator adoption + job epochs), verified & throttled shard
+migration, persisted topology, anti-entropy observability, and the
+union-repair limitation pin.
+
+Chaos coverage: an in-process coordinator-death-mid-resize simulation
+(tier-1-safe) plus a real-subprocess SIGKILL-the-coordinator-mid-resize
+drill (skips cleanly where subprocess networking is restricted).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import pytest
+
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.broadcast import Message
+from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.cluster.resize import ResizeError
+from pilosa_tpu.cluster.topology import (
+    Node,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    Topology,
+    URI,
+    load_topology,
+    save_topology,
+)
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.stats import global_stats
+from tests.cluster_harness import TestCluster
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+VIEW_STANDARD = "standard"
+
+
+def _counter(name: str) -> float:
+    snap = global_stats.snapshot()["counters"]
+    return sum(v for k, v in snap.items() if k.startswith(name))
+
+
+def _frag(cn, index, field, shard):
+    idx = cn.holder.index(index)
+    f = idx.field(field) if idx else None
+    v = f.view(VIEW_STANDARD) if f else None
+    return v.fragment(shard) if v else None
+
+
+def _await(cond, timeout=10.0, every=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    raise TimeoutError(f"{what} never held within {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# Follower lease / coordinator heartbeats
+# ---------------------------------------------------------------------------
+
+
+class TestFollowerLease:
+    def test_lease_expiry_rolls_back_to_normal(self):
+        """A follower frozen in RESIZING with no coordinator heartbeat
+        rolls itself back to NORMAL on the old topology within the lease
+        window — the coordinator-crash escape hatch."""
+        with TestCluster(2) as c:
+            rz = c[1].cluster.resizer
+            rz.lease_timeout = 0.3
+            exp0 = _counter("resize_lease_expirations_total")
+            old_nodes = list(c[1].cluster.topology.nodes)
+            c[1].cluster.apply_message(
+                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
+            )
+            assert c[1].cluster.state() == STATE_RESIZING
+            _await(
+                lambda: c[1].cluster.state() == STATE_NORMAL,
+                timeout=3, what="lease rollback",
+            )
+            # Old topology intact: the lease reverts STATE only.
+            assert c[1].cluster.topology.nodes == old_nodes
+            assert _counter("resize_lease_expirations_total") - exp0 == 1
+
+    def test_heartbeats_keep_the_lease_alive(self):
+        with TestCluster(2) as c:
+            rz = c[1].cluster.resizer
+            rz.lease_timeout = 0.3
+            c[1].cluster.apply_message(
+                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
+            )
+            for _ in range(4):
+                time.sleep(0.15)
+                c[1].cluster.apply_message(
+                    Message.make(bc.MSG_RESIZE_HEARTBEAT, job=1, epoch=0)
+                )
+                assert c[1].cluster.state() == STATE_RESIZING
+            # Heartbeats stop: the lease fires.
+            _await(
+                lambda: c[1].cluster.state() == STATE_NORMAL,
+                timeout=3, what="lease rollback after heartbeats stopped",
+            )
+
+    def test_terminal_status_cancels_lease(self):
+        with TestCluster(2) as c:
+            rz = c[1].cluster.resizer
+            rz.lease_timeout = 0.3
+            exp0 = _counter("resize_lease_expirations_total")
+            c[1].cluster.apply_message(
+                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
+            )
+            c[1].cluster.apply_message(
+                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_NORMAL)
+            )
+            time.sleep(0.6)
+            assert c[1].cluster.state() == STATE_NORMAL
+            assert _counter("resize_lease_expirations_total") == exp0
+
+    def test_coordinator_own_job_arms_no_lease(self):
+        """The coordinator's job is terminated by its job_timeout, never
+        by a self-lease racing its own heartbeats."""
+        with TestCluster(2) as c:
+            rz = c[0].cluster.resizer
+            rz.lease_timeout = 0.2
+            with rz._lock:
+                rz._new_nodes = list(c[0].cluster.topology.nodes)
+            rz.renew_lease()
+            assert rz._lease is None
+            with rz._lock:
+                rz._new_nodes = None
+
+    def test_coordinator_heartbeats_reach_followers(self):
+        """A live job's heartbeat loop actually renews follower leases
+        over the real broadcast surface."""
+        with TestCluster(2) as c:
+            for cn in c.nodes:
+                cn.cluster.resizer.lease_timeout = 0.6
+            rz0 = c[0].cluster.resizer
+            # Arm an artificial live job on the coordinator and freeze
+            # the follower; the heartbeat loop must keep node1 frozen
+            # well past its lease window.
+            with rz0._lock:
+                rz0._active_job = 99
+                rz0._new_nodes = list(c[0].cluster.topology.nodes)
+                rz0._notify_nodes = list(c[0].cluster.topology.nodes)
+            c[1].cluster.apply_message(
+                Message.make(bc.MSG_CLUSTER_STATUS, state=STATE_RESIZING)
+            )
+            rz0._start_heartbeats(99)
+            try:
+                time.sleep(1.5)  # > 2 lease windows
+                assert c[1].cluster.state() == STATE_RESIZING
+            finally:
+                with rz0._lock:
+                    rz0._active_job = None
+                    rz0._new_nodes = None
+                    rz0._notify_nodes = []
+                rz0._stop_heartbeats()
+                c[1].cluster.resizer.cancel_lease()
+                c[1].cluster.set_state(STATE_NORMAL)
+
+
+# ---------------------------------------------------------------------------
+# Completion-report retry + coordinator re-resolution
+# ---------------------------------------------------------------------------
+
+
+class TestCompletionRetry:
+    def test_report_rides_out_coordinator_failover(self):
+        """The completion report retries against the CURRENTLY resolved
+        coordinator: a report addressed to a dead coordinator lands on
+        the promoted successor once the coordinator flag moves."""
+        with TestCluster(3) as c:
+            rz2 = c[2].cluster.resizer
+            rz2.lease_timeout = 10.0
+            c[2].cluster.set_state(STATE_RESIZING)
+            # Ghost coordinator: instruction came from a node that died.
+            ghost = Node("ghost", URI(host="127.0.0.1", port=1), True)
+            instruction = Message.make(
+                bc.MSG_RESIZE_INSTRUCTION, job=3, epoch=0,
+                coordinator=ghost.to_json(), sources=[],
+            )
+            # node2's local view still flags the ghost as coordinator.
+            for n in c[2].cluster.topology.nodes:
+                n.is_coordinator = False
+            got: list = []
+            orig = c[1].cluster.resizer.mark_complete
+            c[1].cluster.resizer.mark_complete = lambda m: got.append(m)
+            retries0 = _counter("resize_complete_retries_total")
+            done = Message.make(
+                bc.MSG_RESIZE_COMPLETE, job=3, epoch=0, node="node2"
+            )
+            t = threading.Thread(
+                target=rz2._report_complete, args=(done, instruction),
+                daemon=True,
+            )
+            t.start()
+            time.sleep(0.4)  # a few failed attempts against the ghost
+            # Failover: node1 becomes the flagged coordinator.
+            for n in c[2].cluster.topology.nodes:
+                n.is_coordinator = n.id == "node1"
+            t.join(timeout=10)
+            assert not t.is_alive()
+            c[1].cluster.resizer.mark_complete = orig
+            c[2].cluster.set_state(STATE_NORMAL)
+            assert [m.get("node") for m in got] == ["node2"]
+            assert _counter("resize_complete_retries_total") > retries0
+
+    def test_report_gives_up_when_cluster_left_resizing(self):
+        """An abort (or lease rollback) mid-retry ends the loop: recovery
+        belongs to the rollback, not to a report nobody is waiting on."""
+        with TestCluster(2) as c:
+            rz1 = c[1].cluster.resizer
+            rz1.lease_timeout = 30.0
+            ghost = Node("ghost", URI(host="127.0.0.1", port=1), True)
+            instruction = Message.make(
+                bc.MSG_RESIZE_INSTRUCTION, job=4, epoch=0,
+                coordinator=ghost.to_json(), sources=[],
+            )
+            for n in c[1].cluster.topology.nodes:
+                n.is_coordinator = False
+            c[1].cluster.set_state(STATE_RESIZING)
+            done = Message.make(
+                bc.MSG_RESIZE_COMPLETE, job=4, epoch=0, node="node1"
+            )
+            t = threading.Thread(
+                target=rz1._report_complete, args=(done, instruction),
+                daemon=True,
+            )
+            t.start()
+            time.sleep(0.3)
+            c[1].cluster.set_state(STATE_NORMAL)  # the rollback
+            t.join(timeout=10)
+            assert not t.is_alive()
+            for n in c[1].cluster.topology.nodes:
+                n.is_coordinator = n.id == "node0"
+
+
+# ---------------------------------------------------------------------------
+# Promotion adopts (and aborts) the orphaned job; epochs reject staleness
+# ---------------------------------------------------------------------------
+
+
+class TestPromotionAdoption:
+    def test_promoted_coordinator_aborts_orphaned_job(self):
+        with TestCluster(2) as c:
+            for cn in c.nodes:
+                cn.cluster.set_state(STATE_RESIZING)
+            rz1 = c[1].cluster.resizer
+            with rz1._lock:
+                rz1._observed_epoch = 5
+                rz1._observed_job = 7
+            adopted0 = _counter("resize_jobs_adopted_total")
+            # The failover: node1 learns it is now the coordinator.
+            c[1].cluster.apply_message(
+                Message.make(bc.MSG_SET_COORDINATOR, id="node1")
+            )
+            assert c[1].cluster.state() == STATE_NORMAL
+            _await(
+                lambda: c[0].cluster.state() == STATE_NORMAL,
+                timeout=5, what="abort broadcast unfreezing node0",
+            )
+            assert _counter("resize_jobs_adopted_total") - adopted0 == 1
+            # Epoch bumped past the dead job's: its COMPLETEs are stale.
+            assert rz1._epoch == 6
+
+    def test_stale_epoch_complete_rejected(self):
+        """A COMPLETE carrying the dead coordinator's epoch must not
+        satisfy the promoted coordinator's same-numbered job."""
+        with TestCluster(2) as c:
+            rz = c[0].cluster.resizer
+            with rz._lock:
+                rz._epoch = 2
+                rz._active_job = 1
+                rz._pending_nodes = {"node0", "node1"}
+                rz._new_nodes = list(c[0].cluster.topology.nodes)
+                rz._notify_nodes = []
+            rz.mark_complete(
+                Message.make(bc.MSG_RESIZE_COMPLETE, job=1, epoch=1, node="node0")
+            )
+            assert rz._pending_nodes == {"node0", "node1"}  # rejected
+            rz.mark_complete(
+                Message.make(bc.MSG_RESIZE_COMPLETE, job=1, epoch=2, node="node0")
+            )
+            assert rz._pending_nodes == {"node1"}  # matching epoch lands
+            with rz._lock:
+                rz._pending_nodes = set()
+                rz._new_nodes = None
+                rz._active_job = None
+                rz._epoch = 0
+
+    def test_observe_follower_aborts_from_probe_status(self):
+        """A coordinator that never saw the job (promoted after the
+        freeze reached the followers) adopts it from a follower's
+        /status and aborts it."""
+        with TestCluster(2) as c:
+            c[1].cluster.set_state(STATE_RESIZING)
+            with c[1].cluster.resizer._lock:
+                c[1].cluster.resizer._observed_epoch = 3
+                c[1].cluster.resizer._observed_job = 9
+            # node1's /status carries the orphan report...
+            st = c[1].api.status()
+            assert st["resize"] == {"job": 9, "epoch": 3}
+            # ...and the coordinator's probe merge adopts + aborts it.
+            adopted0 = _counter("resize_jobs_adopted_total")
+            c[0].cluster.resizer.observe_follower(st["resize"])
+            _await(
+                lambda: c[1].cluster.state() == STATE_NORMAL,
+                timeout=5, what="observe_follower abort",
+            )
+            assert _counter("resize_jobs_adopted_total") - adopted0 == 1
+            assert c[0].cluster.resizer._epoch == 4
+
+    def test_follower_status_absent_when_normal(self):
+        with TestCluster(2) as c:
+            assert "resize" not in c[0].api.status()
+
+
+# ---------------------------------------------------------------------------
+# In-process chaos: coordinator dies mid-resize
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestCoordinatorDeathMidResize:
+    def test_survivors_exit_resizing_with_no_lost_writes(self):
+        """Tier-1-safe coordinator-death simulation: the coordinator
+        freezes the cluster and delivers instructions, then dies (timer
+        and heartbeats die with it, its server stops answering). Every
+        survivor must exit RESIZING within the lease window via its own
+        rollback, writes must stop answering 503, and every acknowledged
+        pre-resize write must survive."""
+        with TestCluster(3, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            cols = list(range(0, 6 * SHARD_WIDTH, SHARD_WIDTH // 2))
+            c[0].api.import_bits("i", "f", [1] * len(cols), cols)
+            want = c.query(1, "i", "Count(Row(f=1))")["results"][0]
+            assert want == len(cols)
+            for cn in c.nodes:
+                cn.cluster.resizer.lease_timeout = 0.6
+            rz0 = c[0].cluster.resizer
+            # The coordinator freezes everyone, then dies before any
+            # instruction goes out: stop its announce mid-job by making
+            # instruction delivery hang forever is overkill — drop the
+            # instructions, then kill the coordinator's control plane.
+            orig_send = c[0].cluster.broadcaster.send_to
+
+            def drop_instructions(node, msg):
+                if msg.get("type") == bc.MSG_RESIZE_INSTRUCTION:
+                    return  # "delivered", never followed
+                return orig_send(node, msg)
+
+            c[0].cluster.broadcaster.send_to = drop_instructions
+            cn_new = c.spawn_node()
+            rz0.job_timeout = 600  # its timer "dies" with it anyway
+            rz0.add_node(Node(cn_new.node.id, cn_new.node.uri, False))
+            assert c[0].cluster.state() == STATE_RESIZING
+            assert c[1].cluster.state() == STATE_RESIZING
+            # -- the coordinator dies -------------------------------------
+            rz0._stop_heartbeats()
+            if rz0._timer is not None:
+                rz0._timer.cancel()
+            c[0].server.close()
+            # -- survivors roll back on their leases ----------------------
+            _await(
+                lambda: c[1].cluster.state() == STATE_NORMAL
+                and c[2].cluster.state() == STATE_NORMAL,
+                timeout=5, what="survivor lease rollback",
+            )
+            # What the survivors' failure detectors would do next (no
+            # detector runs in the harness): confirm the dead
+            # coordinator DOWN so routing skips it.
+            for cn in (c[1], c[2]):
+                dead = cn.cluster.topology.node_by_id("node0")
+                dead.state = "DOWN"
+            # Writes are accepted again (no 503) on a survivor whose
+            # replicas are alive, and no acknowledged write was lost.
+            c[1].api.import_bits("i", "f", [2], [3])
+            assert c.query(1, "i", "Count(Row(f=1))")["results"][0] == want
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(port, method, path, body=None, timeout=5):
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method
+    )
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else {}
+
+
+@pytest.mark.chaos
+class TestCoordinatorSigkillSubprocess:
+    """The real thing: SIGKILL the coordinator PROCESS mid-resize and
+    assert the surviving nodes exit RESIZING within the lease window
+    with no lost acknowledged writes (ISSUE r9 chaos acceptance). Skips
+    cleanly where subprocess networking is restricted."""
+
+    def _spawn(self, port, data_dir, hosts=None, join=None, extra=None):
+        env = dict(
+            os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+            PILOSA_TPU_RESIZE_LEASE="4",
+        )
+        env.pop("PILOSA_TPU_CLUSTER_HOSTS", None)
+        env.pop("PILOSA_TPU_CLUSTER_REPLICAS", None)
+        if hosts:
+            env["PILOSA_TPU_CLUSTER_HOSTS"] = hosts
+            env["PILOSA_TPU_CLUSTER_REPLICAS"] = "2"
+        env.update(extra or {})
+        cmd = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+               "-d", data_dir, "-b", f"127.0.0.1:{port}",
+               "--executor", "cpu"]
+        if join:
+            cmd += ["--join", join]
+        return subprocess.Popen(
+            cmd, env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+    def _ready(self, proc, port, timeout=25) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                return False
+            try:
+                _http(port, "GET", "/status", timeout=2)
+                return True
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+        return False
+
+    def test_sigkill_coordinator_mid_resize(self, tmp_path):
+        pa, pb, pc = _free_port(), _free_port(), _free_port()
+        hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+        procs = []
+        try:
+            a = self._spawn(pa, str(tmp_path / "a"), hosts=hosts)
+            b = self._spawn(pb, str(tmp_path / "b"), hosts=hosts)
+            procs += [a, b]
+            if not (self._ready(a, pa) and self._ready(b, pb)):
+                pytest.skip("subprocess servers unavailable in this environment")
+            # Acknowledged pre-resize writes on the 2-node cluster.
+            _http(pa, "POST", "/index/i", {})
+            _http(pa, "POST", "/index/i/field/f", {})
+            cols = list(range(0, 4 * SHARD_WIDTH, SHARD_WIDTH // 4))
+            _http(pa, "POST", "/index/i/field/f/import",
+                  {"rowIDs": [1] * len(cols), "columnIDs": cols}, timeout=15)
+            want = _http(pa, "POST", "/index/i/query",
+                         b"Count(Row(f=1))")["results"][0]
+            assert want == len(cols)
+            # A joiner with a migration bandwidth crawl: the resize job
+            # stays in flight long enough to kill the coordinator inside
+            # it deterministically.
+            coord_port = min((pa, pb))  # lowest node id coordinates
+            other = pb if coord_port == pa else pa
+            coord = a if coord_port == pa else b
+            surv = b if coord_port == pa else a
+            c = self._spawn(
+                pc, str(tmp_path / "c"),
+                join=f"http://127.0.0.1:{coord_port}",
+                extra={"PILOSA_TPU_MIGRATION_BANDWIDTH": "500"},
+            )
+            procs.append(c)
+            if not self._ready(c, pc):
+                pytest.skip("joiner subprocess unavailable")
+            # Wait for the join-triggered resize to freeze the cluster...
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    if _http(other, "GET", "/status")["state"] == "RESIZING":
+                        break
+                except (urllib.error.URLError, OSError):
+                    pass
+                time.sleep(0.05)
+            else:
+                pytest.skip("resize never started (join lost?)")
+            # ...and SIGKILL the coordinator mid-job.
+            coord.send_signal(signal.SIGKILL)
+            coord.wait(timeout=10)
+            # The survivor exits RESIZING within the lease window
+            # (rollback or adopted abort), well under the old forever.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    if _http(other, "GET", "/status")["state"] != "RESIZING":
+                        break
+                except (urllib.error.URLError, OSError):
+                    pass
+                time.sleep(0.2)
+            st = _http(other, "GET", "/status")
+            assert st["state"] != "RESIZING", st
+            # Nothing acknowledged is lost (reads re-split off the dead
+            # replica immediately)...
+            got = _http(other, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"][0]
+            assert got == want
+            # ...and writes stop answering 503. The survivor's failure
+            # detector needs a few probe rounds to confirm the killed
+            # peer DOWN before write routing skips it, so poll for
+            # eventual acceptance instead of asserting the first try.
+            deadline = time.monotonic() + 20
+            last = None
+            while time.monotonic() < deadline:
+                try:
+                    _http(other, "POST", "/index/i/field/f/import",
+                          {"rowIDs": [2], "columnIDs": [5]}, timeout=15)
+                    last = None
+                    break
+                except urllib.error.HTTPError as e:
+                    last = e
+                    time.sleep(0.5)
+            assert last is None, f"writes never accepted again: {last}"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+
+
+# ---------------------------------------------------------------------------
+# Verified migration: checksums, 404-vs-transport, failover, throttle
+# ---------------------------------------------------------------------------
+
+
+class TestVerifiedMigration:
+    def test_fragment_data_carries_checksum_header(self):
+        with TestCluster(1) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c[0].api.import_bits("i", "f", [1], [10])
+            url = (
+                f"{c[0].cluster.local_node.uri}"
+                "/internal/fragment/data?index=i&field=f&view=standard&shard=0"
+            )
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                data = resp.read()
+                hdr = resp.headers.get("X-Pilosa-Content-Checksum")
+            assert hdr == f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+    def test_corrupt_transfer_detected_never_ingested(self):
+        """A payload whose bytes were damaged in flight raises
+        code=checksum-mismatch from retrieve_shard BEFORE any caller can
+        import it."""
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c[0].api.import_bits("i", "f", [1], [10])
+            client = c[1].cluster.client
+            orig = client.__class__._do_once
+
+            def corrupting(self_, method, uri, path, **kw):
+                out = orig(self_, method, uri, path, **kw)
+                if kw.get("want_headers") and "/fragment/data" in path:
+                    data, headers = out
+                    return bytes([data[0] ^ 0x01]) + data[1:], headers
+                return out
+
+            client._do_once = corrupting.__get__(client)
+            try:
+                with pytest.raises(ClientError) as e:
+                    client.retrieve_shard(
+                        c[0].cluster.local_node.uri, "i", "f", "standard", 0
+                    )
+                assert e.value.code == "checksum-mismatch"
+            finally:
+                del client._do_once
+
+    def test_fetch_404_is_absence_not_failure(self):
+        """`except ClientError: continue` used to conflate 404 with
+        transport failure; now 404 everywhere returns None with zero
+        fetch-error counts."""
+        with TestCluster(2) as c:
+            rz = c[1].cluster.resizer
+
+            class Stub:
+                def retrieve_shard(self, uri, *a):
+                    raise ClientError("nope", status=404, code="not-found")
+
+            rz.cluster = type(rz.cluster)(
+                c[1].cluster.local_node, c[1].cluster.topology,
+                use_broadcast=False,
+            )
+            rz.cluster.client = Stub()
+            errs0 = _counter("resize_fetch_errors_total")
+            assert rz._fetch_fragment(["u1", "u2"], "i", "f", "standard", 0) is None
+            assert _counter("resize_fetch_errors_total") == errs0
+            rz.cluster = c[1].cluster
+
+    def test_transport_failure_retries_then_fails_over(self):
+        """Transient failures burn bounded per-source retries, then the
+        fetch fails over to the next surviving old owner — counted,
+        never silently skipped."""
+        with TestCluster(2) as c:
+            rz = c[1].cluster.resizer
+            rz.fetch_retries = 1
+            calls: list = []
+
+            class Stub:
+                def retrieve_shard(self, uri, *a):
+                    calls.append(uri)
+                    if uri == "u1":
+                        raise ClientError("reset", transport=True)
+                    return b"payload"
+
+            real_cluster = rz.cluster
+            rz.cluster = type(real_cluster)(
+                c[1].cluster.local_node, c[1].cluster.topology,
+                use_broadcast=False,
+            )
+            rz.cluster.client = Stub()
+            errs0 = _counter('resize_fetch_errors_total{kind="transport"}')
+            try:
+                out = rz._fetch_fragment(["u1", "u2"], "i", "f", "standard", 0)
+            finally:
+                rz.cluster = real_cluster
+            assert out == b"payload"
+            assert calls == ["u1", "u1", "u2"]  # retry, then failover
+            assert (
+                _counter('resize_fetch_errors_total{kind="transport"}') - errs0
+                == 2
+            )
+
+    def test_all_sources_dead_raises_counted(self):
+        with TestCluster(2) as c:
+            rz = c[1].cluster.resizer
+            rz.fetch_retries = 0
+
+            class Stub:
+                def retrieve_shard(self, uri, *a):
+                    raise ClientError("boom", status=500)
+
+            real_cluster = rz.cluster
+            rz.cluster = type(real_cluster)(
+                c[1].cluster.local_node, c[1].cluster.topology,
+                use_broadcast=False,
+            )
+            rz.cluster.client = Stub()
+            errs0 = _counter('resize_fetch_errors_total{kind="http"}')
+            try:
+                with pytest.raises(ResizeError):
+                    rz._fetch_fragment(["u1", "u2"], "i", "f", "standard", 0)
+            finally:
+                rz.cluster = real_cluster
+            assert _counter('resize_fetch_errors_total{kind="http"}') - errs0 == 2
+
+    def test_bandwidth_throttle_paces_transfers(self):
+        with TestCluster(1) as c:
+            rz = c[0].cluster.resizer
+            rz.bandwidth_limit = 100_000  # bytes/s
+            t0 = time.monotonic()
+            rz._throttle(10_000)
+            rz._throttle(10_000)
+            # 20 KB at 100 KB/s: at least ~0.2 s of pacing.
+            assert time.monotonic() - t0 >= 0.15
+
+    def test_instructions_carry_alternate_sources(self):
+        """With replica_n=2 every migrating fragment names a second
+        surviving owner the fetcher can fail over to."""
+        with TestCluster(3, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            cols = list(range(0, 6 * SHARD_WIDTH, SHARD_WIDTH // 2))
+            c[0].api.import_bits("i", "f", [1] * len(cols), cols)
+            rz = c[0].cluster.resizer
+            old = c[0].cluster.topology
+            new = Topology(
+                nodes=list(old.nodes)
+                + [Node("node9", URI(host="127.0.0.1", port=9), False)],
+                replica_n=2, partition_n=old.partition_n, hasher=old.hasher,
+            )
+            instr = rz._build_instructions(old, new, None)
+            sources = [s for lst in instr.values() for s in lst]
+            assert sources, "expected at least one migrating fragment"
+            assert any(s["alts"] for s in sources)
+            for s in sources:
+                assert s["from"] not in s["alts"]
+
+    def test_abort_cancels_inflight_migration_workers(self):
+        """A lease expiry or abort stops in-flight fetch workers: they
+        must not keep migrating (or re-arm the cleanup flag) for a job
+        already declared dead."""
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            rz = c[1].cluster.resizer
+            rz.fetch_concurrency = 1
+            started = threading.Event()
+            release = threading.Event()
+
+            class SlowStub:
+                def field_state(self, uri, index, field):
+                    started.set()
+                    release.wait(5)
+                    return {"views": ["standard"]}
+
+                def retrieve_shard(self, *a):
+                    raise ClientError("absent", status=404)
+
+            real_cluster = rz.cluster
+            stub = type(real_cluster)(
+                c[1].cluster.local_node, c[1].cluster.topology,
+                holder=c[1].holder, use_broadcast=False,
+            )
+            stub.client = SlowStub()
+            rz.cluster = stub
+            stub.resizer = rz
+            sources = [
+                {"index": "i", "field": "f", "shard": s, "from": "u1"}
+                for s in range(3)
+            ]
+            msg = Message.make(
+                bc.MSG_RESIZE_INSTRUCTION, job=1, epoch=1, sources=sources,
+            )
+            result: list = []
+
+            def run():
+                try:
+                    rz._follow_instruction_inner(msg)
+                    result.append(None)
+                except ResizeError as e:
+                    result.append(e)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            try:
+                assert started.wait(5)  # first source mid-fetch
+                rz.abort(local=True)  # the job dies under the workers
+            finally:
+                release.set()
+                t.join(timeout=10)
+                rz.cluster = real_cluster
+            assert not t.is_alive()
+            assert isinstance(result[0], ResizeError)  # reported, not silent
+            assert rz._needs_clean is False  # never re-armed by workers
+
+    def test_resize_still_converges_with_concurrency(self):
+        """End-to-end: the concurrent, verified fetch plane moves a real
+        resize exactly like the old sequential loop did."""
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            cols = list(range(0, 8 * SHARD_WIDTH, SHARD_WIDTH // 2))
+            c[0].api.import_bits("i", "f", [1] * len(cols), cols)
+            for cn in c.nodes:
+                cn.cluster.resizer.fetch_concurrency = 4
+            want = c.query(0, "i", "Count(Row(f=1))")["results"][0]
+            cn = c.add_node_via_resize()
+            assert (
+                cn.api.query("i", "Count(Row(f=1))")["results"][0] == want
+            )
+
+
+# ---------------------------------------------------------------------------
+# Resize edge cases that existed untested (ISSUE r9 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestResizeEdgeCases:
+    def _arm_job(self, c, pending):
+        rz = c[0].cluster.resizer
+        with rz._lock:
+            rz._active_job = 1
+            rz._pending_nodes = set(pending)
+            rz._new_nodes = list(c[0].cluster.topology.nodes)
+            rz._notify_nodes = list(c[0].cluster.topology.nodes)
+        c[0].cluster.set_state(STATE_RESIZING)
+        return rz
+
+    def test_complete_with_error_still_flips_topology(self):
+        """The heal-via-anti-entropy contract: a follower that failed
+        mid-fetch reports an error but the job still completes — a
+        wedged RESIZING is worse than missing fragments anti-entropy
+        will copy."""
+        with TestCluster(2) as c:
+            rz = self._arm_job(c, {"node1"})
+            done0 = _counter("resize_jobs_completed_total")
+            rz.mark_complete(
+                Message.make(
+                    bc.MSG_RESIZE_COMPLETE, job=1, epoch=0, node="node1",
+                    error="injected fetch failure",
+                )
+            )
+            assert rz._active_job is None
+            assert c[0].cluster.state() == STATE_NORMAL
+            assert _counter("resize_jobs_completed_total") - done0 == 1
+
+    def test_abort_only_job_loses_race_to_completion(self):
+        """abort(only_job=) arriving AFTER the final completion is a
+        no-op: re-freezing the new topology would undo a finished job."""
+        with TestCluster(2) as c:
+            rz = self._arm_job(c, {"node1"})
+            rz.mark_complete(
+                Message.make(bc.MSG_RESIZE_COMPLETE, job=1, epoch=0, node="node1")
+            )
+            assert c[0].cluster.state() == STATE_NORMAL
+            aborts0 = _counter("resize_jobs_aborted_total")
+            rz.abort(only_job=1)  # the timeout thread losing the race
+            assert c[0].cluster.state() == STATE_NORMAL
+            assert _counter("resize_jobs_aborted_total") == aborts0
+
+    def test_stale_job_complete_rejected_after_abort(self):
+        with TestCluster(2) as c:
+            rz = self._arm_job(c, {"node1"})
+            rz.abort()
+            done0 = _counter("resize_jobs_completed_total")
+            rz.mark_complete(
+                Message.make(bc.MSG_RESIZE_COMPLETE, job=1, epoch=0, node="node1")
+            )
+            assert _counter("resize_jobs_completed_total") == done0
+
+    def test_every_job_gets_a_fresh_epoch(self):
+        """Two sequential jobs never share an epoch, so a dead job's
+        straggler COMPLETE (still in a reporter's retry backoff) cannot
+        satisfy a successor whose job counter happens to collide."""
+        with TestCluster(2) as c:
+            rz = c[0].cluster.resizer
+            n9 = Node("node9", URI(host="127.0.0.1", port=1), False)
+            epochs = []
+            orig_start = rz._start_job
+
+            def spy(new_nodes, removed=None):
+                try:
+                    return orig_start(new_nodes, removed)
+                finally:
+                    epochs.append(rz._epoch)
+
+            rz._start_job = spy
+            with pytest.raises(ResizeError):
+                rz.add_node(n9)  # dead URI: job arms, delivery aborts it
+            with pytest.raises(ResizeError):
+                rz.add_node(n9)
+            assert len(set(epochs)) == 2  # distinct epochs per job
+
+
+# ---------------------------------------------------------------------------
+# Persisted topology
+# ---------------------------------------------------------------------------
+
+
+class TestPersistedTopology:
+    def _topo(self):
+        return Topology(
+            nodes=[
+                Node("a", URI(host="h1", port=1), True),
+                Node("b", URI(host="h2", port=2), False),
+            ],
+            replica_n=2,
+            partition_n=64,
+        )
+
+    def test_save_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / ".topology")
+        save_topology(p, self._topo(), "b", resize_epoch=7)
+        d = load_topology(p)
+        assert d["localID"] == "b"
+        assert d["replicaN"] == 2
+        assert d["partitionN"] == 64
+        assert d["resizeEpoch"] == 7
+        assert [n["id"] for n in d["nodes"]] == ["a", "b"]
+        assert d["nodes"][0]["isCoordinator"] is True
+
+    def test_save_is_atomic_no_tmp_left(self, tmp_path):
+        p = str(tmp_path / ".topology")
+        save_topology(p, self._topo(), "a")
+        save_topology(p, self._topo(), "a")
+        assert not os.path.exists(p + ".tmp")
+
+    def test_corrupt_file_loads_none(self, tmp_path):
+        p = str(tmp_path / ".topology")
+        with open(p, "w") as f:
+            f.write('{"nodes": [truncated')
+        assert load_topology(p) is None
+        with open(p, "w") as f:
+            f.write('{"no": "nodes"}')
+        assert load_topology(p) is None
+        assert load_topology(str(tmp_path / "absent")) is None
+
+    def test_cluster_persists_on_membership_change(self, tmp_path):
+        with TestCluster(2) as c:
+            p = str(tmp_path / ".topology")
+            c[0].cluster.topology_file = p
+            new_nodes = [n.to_json() for n in c[0].cluster.topology.nodes] + [
+                Node("node9", URI(host="127.0.0.1", port=9), False).to_json()
+            ]
+            c[0].cluster.apply_message(
+                Message.make(
+                    bc.MSG_CLUSTER_STATUS, state=STATE_NORMAL,
+                    nodes=new_nodes, replicaN=2,
+                )
+            )
+            d = load_topology(p)
+            assert d is not None
+            assert len(d["nodes"]) == 3
+            assert d["replicaN"] == 2
+            assert d["localID"] == "node0"
+
+    def test_cluster_persists_on_coordinator_move(self, tmp_path):
+        with TestCluster(2) as c:
+            p = str(tmp_path / ".topology")
+            c[1].cluster.topology_file = p
+            c[1].cluster.apply_message(
+                Message.make(bc.MSG_SET_COORDINATOR, id="node1")
+            )
+            d = load_topology(p)
+            coords = [n["id"] for n in d["nodes"] if n["isCoordinator"]]
+            assert coords == ["node1"]
+
+    def test_persist_failure_is_nonfatal(self):
+        with TestCluster(1) as c:
+            c[0].cluster.topology_file = "/nonexistent-dir/zzz/.topology"
+            c[0].cluster.persist_topology()  # logs, does not raise
+
+
+# ---------------------------------------------------------------------------
+# Anti-entropy observability + jitter (ISSUE r9 satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestAntiEntropyObservability:
+    def test_run_counters_histogram_and_gauge(self):
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c[0].api.import_bits("i", "f", [1], [5])
+            runs0 = _counter("anti_entropy_runs_total")
+            c.sync_all()
+            assert _counter("anti_entropy_runs_total") - runs0 == 2
+            hist = global_stats.histogram_snapshot()
+            assert any(
+                k.startswith("anti_entropy_run_seconds") for k in hist
+            )
+            gauges = global_stats.snapshot()["gauges"]
+            last = gauges.get("anti_entropy_last_run_seconds")
+            assert last is not None and 0 < last <= time.monotonic()
+
+    def test_repairs_counted_by_kind(self):
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            # Diverge one replica directly (bypassing replication).
+            f0 = c[0].holder.index("i").field("f")
+            f0.create_view_if_not_exists(VIEW_STANDARD).create_fragment_if_not_exists(0)
+            _frag(c[0], "i", "f", 0).set_bit(1, 5)
+            f0.add_available_shard(0)
+            frag0 = _counter('anti_entropy_blocks_repaired_total{kind="fragment"}')
+            c.sync_all()
+            assert (
+                _counter('anti_entropy_blocks_repaired_total{kind="fragment"}')
+                > frag0
+            )
+
+    def test_daemon_interval_jitters_25_pct(self):
+        from pilosa_tpu.cluster.sync import SyncDaemon
+
+        with TestCluster(1) as c:
+            waits: list[float] = []
+
+            class Recorder:
+                def wait(self, t):
+                    waits.append(t)
+                    return True  # stop immediately
+
+                def set(self):
+                    pass
+
+            for _ in range(32):
+                d = SyncDaemon(c[0].cluster, interval=100.0)
+                d._stop = Recorder()
+                d._run()
+            assert all(75.0 <= w <= 125.0 for w in waits)
+            assert max(waits) - min(waits) > 1.0  # actually jittered
+
+
+# ---------------------------------------------------------------------------
+# Union-repair limitation pin (ISSUE r9 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestUnionRepairLimitation:
+    def test_cleared_bit_resurrects_via_anti_entropy(self):
+        """RECORDED CONTRACT, not a surprise: anti-entropy merges
+        differing blocks by UNION (_sync_fragment -> merge_block), so a
+        bit cleared on one replica while another still holds it is
+        resurrected by the next repair pass. Clears only converge when
+        they reach every replica at write time (the replicated write
+        path does this); a partitioned replica's missed clear comes
+        back. Fix direction (docs/administration.md "Cluster
+        lifecycle"): journal-epoch-aware repair — ship per-block
+        (checksum, journal epoch) pairs and let the HIGHER epoch win
+        instead of the union, so tombstones propagate."""
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1)")
+            c.await_shard_convergence("i")
+            assert _frag(c[0], "i", "f", 0).row_count(1) == 1
+            assert _frag(c[1], "i", "f", 0).row_count(1) == 1
+            # The divergence shape: a clear that reached only ONE
+            # replica (as a partition would leave it).
+            _frag(c[1], "i", "f", 0).clear_bit(1, 5)
+            assert _frag(c[1], "i", "f", 0).row_count(1) == 0
+            c.sync_all()
+            # The union repair resurrects the cleared bit.
+            assert _frag(c[1], "i", "f", 0).row_count(1) == 1
+            assert c.query(1, "i", "Count(Row(f=1))")["results"][0] == 1

@@ -79,14 +79,19 @@ MESSAGES = [
     Message.make(
         "resize-instruction",
         job=4,
+        epoch=3,
         node="node-b",
         coordinator=NODE,
         schema=SCHEMA,
         available={"i1": {"f1": [0, 2]}},
         sources=[{"index": "i1", "field": "f1", "shard": 2,
-                  "from": "http://10.0.0.1:10101"}],
+                  "from": "http://10.0.0.1:10101", "alts": []},
+                 {"index": "i1", "field": "f1", "shard": 5,
+                  "from": "http://10.0.0.1:10101",
+                  "alts": ["http://10.0.0.2:10101",
+                           "http://10.0.0.3:10101"]}],
     ),
-    Message.make("resize-complete", job=4, node="node-b"),
+    Message.make("resize-complete", job=4, epoch=3, node="node-b"),
     Message.make("resize-complete", job=4, node="node-b", error="boom"),
     Message.make("resize-abort"),
     Message.make("set-coordinator", id="node-b"),

@@ -23,10 +23,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _make_cert(tmpdir) -> tuple[str, str]:
-    """Self-signed cert for 127.0.0.1/localhost via the cryptography lib
-    (baked into the image). Returns (cert_path, key_path)."""
+    """Self-signed cert for 127.0.0.1/localhost via the cryptography lib.
+    Returns (cert_path, key_path); skips cleanly on images without the
+    lib (the TLS plane is optional there)."""
     import ipaddress
 
+    x509_mod = pytest.importorskip(
+        "cryptography.x509", reason="TLS tests need the cryptography lib"
+    )
+    del x509_mod
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
