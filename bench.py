@@ -78,13 +78,24 @@ Round-11 legs (ISSUE r11):
   a client that exhausts its budget counts a client_abort and retires
   without killing the pool.map leg (the BENCH_r05 crash class).
 
+Round-12 leg (ISSUE r12):
+- zipf_cache: a Zipf(s≈1.1) mix over a fixed pool of 3-ary Counts
+  against a server with the epoch-tagged result cache
+  (exec/rescache.py) — hit-rate vs qps at each BENCH_CONCURRENCY
+  point, a mid-leg churn burst proving hit-rate collapse + recovery, a
+  byte-identity differential (hit bodies == bypass bodies), and the
+  same mix with the cache detached in the same run
+  (zipf_cache_speedup, the >=10x acceptance figure).
+
 Env knobs: BENCH_SHARDS (default 954 = 1B cols), BENCH_ROWS (8),
 BENCH_DENSITY (0.05), BENCH_BATCH (256), BENCH_SECONDS (10),
 BENCH_LATENCY_N (30), BENCH_HTTP_CLIENTS (16),
 BENCH_HTTP_QUERIES_PER_REQ (16), BENCH_WRITE_RATES ("0,1,10,100"),
 BENCH_CHURN_SECONDS (8), BENCH_WARM_TIMEOUT (600),
 BENCH_DEGRADED_SECONDS (3), BENCH_CONCURRENCY ("1,16,64,256"),
-BENCH_CLIENT_RETRIES (3), BENCH_PARTIAL_PATH (BENCH_partial.json).
+BENCH_CLIENT_RETRIES (3), BENCH_PARTIAL_PATH (BENCH_partial.json),
+BENCH_ZIPF_S (1.1), BENCH_ZIPF_POOL (64), BENCH_ZIPF_SECONDS
+(BENCH_SECONDS), BENCH_ZIPF_CACHE_BYTES (256 MiB).
 """
 
 import concurrent.futures
@@ -138,6 +149,15 @@ INGEST_WRITERS = int(os.environ.get("BENCH_INGEST_WRITERS", "4"))
 INGEST_READERS = int(os.environ.get("BENCH_INGEST_READERS", "8"))
 INGEST_BATCH = int(os.environ.get("BENCH_INGEST_BATCH", "256"))
 INGEST_SHARDS = int(os.environ.get("BENCH_INGEST_SHARDS", "8"))
+# Zipf result-cache leg (ISSUE r12): skew exponent, distinct-query pool
+# size, per-window seconds (defaults to BENCH_SECONDS), and the cache
+# byte budget the leg's server runs with.
+ZIPF_S = float(os.environ.get("BENCH_ZIPF_S", "1.1"))
+ZIPF_POOL = int(os.environ.get("BENCH_ZIPF_POOL", "64"))
+ZIPF_SECONDS = float(os.environ.get("BENCH_ZIPF_SECONDS") or SECONDS)
+ZIPF_CACHE_BYTES = int(
+    os.environ.get("BENCH_ZIPF_CACHE_BYTES", str(256 << 20))
+)
 # Rolling-restart drill (ISSUE r9): reader client count, settle window
 # between restarts, and the per-node reconvergence timeout.
 ROLLING_READERS = int(os.environ.get("BENCH_ROLLING_READERS", "4"))
@@ -425,6 +445,10 @@ LEG_COUNTER_FAMILIES = (
     "fragment_recovery_total",
     "fragment_snapshots_total",
     "fragment_snapshot_failures_total",
+    # Result-cache family (ISSUE r12): the zipf_cache leg's hit/miss/
+    # insert/eviction attribution — a window's hit rate is
+    # rescache_hits / (hits + misses) from these deltas.
+    "rescache_",
     # Cluster-lifecycle families (ISSUE r9): resize job/fetch/lease
     # accounting and the anti-entropy repair loop — the rolling-restart
     # drill's convergence attribution.
@@ -1034,6 +1058,188 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
             k: round(v / base, 2) for k, v in qps_at.items()
         }
     return out
+
+
+def bench_zipf_cache(holder, be, checkpoint) -> dict:
+    """Zipf result-cache leg (ISSUE r12 acceptance): a Zipf(s≈1.1) mix
+    over a fixed pool of 3-ary Intersect Counts served through the real
+    HTTP surface with the epoch-tagged result cache
+    (exec/rescache.py) wired, at each BENCH_CONCURRENCY point —
+    reporting hit-rate vs qps — then, at the top concurrency:
+
+    - a churn-burst phase triptych (pre / burst / post): a writer posts
+      Set() against the queried field mid-leg, so every covered entry
+      stops being addressable and the hit rate collapses, then
+      recovers as misses repopulate at the new epoch;
+    - a byte-identity differential: every pool query's cache-hit
+      response body must equal its X-Pilosa-Cache: bypass response at
+      the same epoch (mismatches reported, expected 0);
+    - the SAME mix with the cache detached (cache-enabled=false
+      equivalent) in the SAME run — zipf_cache_speedup is
+      enabled-vs-disabled qps at equal concurrency, the >=10x
+      acceptance figure.
+
+    3-ary intersects are deliberate (same reasoning as the concurrency
+    sweep): misses pay real device launches, so the speedup measures
+    answers-from-memory vs the dispatch-bound path, not one cache
+    against another. Exact-epoch mode (max-staleness=0) throughout."""
+    from pilosa_tpu.exec.rescache import ResultCache
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http import Server
+
+    ex = Executor(holder, backend=be)
+    ex.batcher = ShardLegBatcher(be)
+    cache = ResultCache(holder, max_bytes=ZIPF_CACHE_BYTES, max_staleness=0)
+    ex.rescache = cache
+    srv = Server(API(holder, ex), host="localhost", port=0).open()
+    path = "/index/bench/query"
+    rng = np.random.default_rng(23)
+
+    combos = [
+        (i, j, k) for i in range(ROWS) for j in range(ROWS) for k in range(4)
+    ]
+    order = rng.permutation(len(combos))
+    pool = [combos[t] for t in order[: min(ZIPF_POOL, len(combos))]]
+    queries = [
+        f"Count(Intersect(Row(f={i}), Row(g={j}), Row(h={k})))"
+        for i, j, k in pool
+    ]
+    probs = 1.0 / np.arange(1, len(queries) + 1, dtype=np.float64) ** ZIPF_S
+    probs /= probs.sum()
+    per_req = HTTP_QUERIES_PER_REQ
+    bodies = [
+        "".join(
+            queries[t] for t in rng.choice(len(queries), per_req, p=probs)
+        )
+        for _ in range(256)
+    ]
+    warm = BenchConn("localhost", srv.port, path)
+    warm.post(bodies[0])
+
+    def run_window(n: int, seconds: float):
+        """(qps, hit_rate or None) for one client window; hit rate from
+        the cache's own lifetime totals (torn-read-free int deltas)."""
+        h0, m0 = cache.hits, cache.misses
+        counts = [0] * n
+        deadline = time.time() + seconds
+
+        def client(k: int, _counts=counts) -> None:
+            _bench_client_loop(
+                "localhost", srv.port, path,
+                lambda j: bodies[j % len(bodies)], deadline,
+                lambda: _counts.__setitem__(k, _counts[k] + per_req),
+                start=k * 7,
+            )
+
+        t0 = time.time()
+        with concurrent.futures.ThreadPoolExecutor(n) as tp:
+            list(tp.map(client, range(n)))
+        elapsed = time.time() - t0
+        dh, dm = cache.hits - h0, cache.misses - m0
+        rate = (dh / (dh + dm)) if (dh + dm) else None
+        return sum(counts) / elapsed, rate
+
+    qps_at: dict[str, float] = {}
+    hit_at: dict[str, Optional[float]] = {}
+    try:
+        for n in CONCURRENCY:
+            q, r = run_window(n, ZIPF_SECONDS)
+            key = str(n)
+            qps_at[key] = round(q, 1)
+            hit_at[key] = round(r, 4) if r is not None else None
+            checkpoint(
+                f"zipf@{n}",
+                **{
+                    f"zipf_qps_at_{n}_clients": qps_at[key],
+                    f"zipf_hit_rate_at_{n}": hit_at[key],
+                },
+            )
+        nmax = max(CONCURRENCY)
+
+        # Churn-burst triptych at the top concurrency: the hit rate
+        # must collapse while Set() churn makes covered entries
+        # unaddressable, then recover once the epoch settles.
+        stop = threading.Event()
+        wrote = [0]
+
+        def churn_writer():
+            conn = BenchConn("localhost", srv.port, path)
+            wr = np.random.default_rng(31)
+            while not stop.is_set():
+                body = "".join(
+                    f"Set({int(wr.integers(0, SHARD_WIDTH))}, "
+                    f"f={int(wr.integers(0, ROWS))})"
+                    for _ in range(4)
+                )
+                conn.post(body)
+                wrote[0] += 4
+                time.sleep(0.01)
+            conn.close()
+
+        phase_qps: dict[str, float] = {}
+        phase_hit: dict[str, Optional[float]] = {}
+        for phase in ("pre", "burst", "post"):
+            wt = None
+            if phase == "burst":
+                wt = threading.Thread(target=churn_writer, daemon=True)
+                wt.start()
+            q, r = run_window(nmax, ZIPF_SECONDS)
+            if wt is not None:
+                stop.set()
+                wt.join(timeout=5)
+            phase_qps[phase] = round(q, 1)
+            phase_hit[phase] = round(r, 4) if r is not None else None
+
+        # Byte-identity differential at the settled epoch: hit bodies
+        # must equal bypass (always-fresh) bodies, byte for byte.
+        import http.client as _hc
+
+        mismatches = 0
+        conn = _hc.HTTPConnection("localhost", srv.port)
+
+        def post_raw(q: str, hdrs: dict) -> tuple[Optional[str], bytes]:
+            conn.request(
+                "POST", path, q,
+                {"Content-Type": "application/json", **hdrs},
+            )
+            resp = conn.getresponse()
+            return resp.getheader("X-Pilosa-Cache"), resp.read()
+
+        for q in queries:
+            post_raw(q, {})  # populate at the current epoch
+            marker, cached_body = post_raw(q, {})
+            _, fresh_body = post_raw(q, {"X-Pilosa-Cache": "bypass"})
+            if marker != "hit" or cached_body != fresh_body:
+                mismatches += 1
+        conn.close()
+        resident = cache.resident_bytes()
+
+        # Cache-disabled comparison, SAME run, SAME mix, SAME
+        # concurrency: the executor consults nothing, every repeat pays
+        # the full resolve path.
+        ex.rescache = None
+        qps_disabled, _ = run_window(nmax, ZIPF_SECONDS)
+        ex.rescache = cache
+    finally:
+        warm.close()
+        srv.close()
+
+    key_max = str(nmax)
+    return {
+        "zipf_s": ZIPF_S,
+        "zipf_pool": len(queries),
+        "zipf_qps_at_clients": qps_at,
+        "zipf_hit_rate_at_clients": hit_at,
+        "zipf_churn_phase_qps": phase_qps,
+        "zipf_hit_rate_phases": phase_hit,
+        "zipf_churn_writes": wrote[0],
+        "zipf_qps_disabled": round(qps_disabled, 1),
+        "zipf_cache_speedup": (
+            round(qps_at[key_max] / qps_disabled, 2) if qps_disabled else None
+        ),
+        "zipf_differential_mismatches": mismatches,
+        "zipf_resident_bytes": resident,
+    }
 
 
 def bench_group_by(holder, be) -> tuple[float, float]:
@@ -1947,6 +2153,7 @@ def main():
     )
     sweep["client_aborts"] = RETRIES["abort"]
     checkpoint("concurrency_sweep", **sweep)
+    checkpoint("zipf_cache", **bench_zipf_cache(h, be, checkpoint))
     checkpoint("degraded_qps", **bench_degraded_qps())
     checkpoint("ingest_under_load", **bench_ingest_under_load())
     checkpoint("rolling_restart", **bench_rolling_restart())

@@ -57,6 +57,22 @@ def cmd_server(args) -> int:
                 log.printf("preheat: %d stacks resident", n)
 
             _threading.Thread(target=_preheat, daemon=True).start()
+    # Epoch-tagged result cache (exec/rescache.py, ISSUE r12): serve hot
+    # terminal answers from memory while their journal-derived epoch
+    # vector matches. 0 bytes = disabled (the max-inflight convention);
+    # cache-enabled=false keeps it out even with a budget set.
+    if cfg.cache_enabled and cfg.max_result_cache_bytes > 0:
+        from pilosa_tpu.exec.rescache import ResultCache
+
+        executor.rescache = ResultCache(
+            holder,
+            max_bytes=cfg.max_result_cache_bytes,
+            max_staleness=cfg.max_staleness,
+        )
+        log.printf(
+            "result cache: %.1f MiB budget, max-staleness=%d",
+            cfg.max_result_cache_bytes / (1 << 20), cfg.max_staleness,
+        )
     executor.logger = log
     if backend is not None:
         # Device-fallback one-line logs (exec/tpu.py _count_device_fallback)

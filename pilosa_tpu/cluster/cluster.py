@@ -585,7 +585,10 @@ class Cluster:
                         first = False
                     resp.result = result
                 else:
-                    resp.result = self._remote_exec(node, index, c, node_shards)
+                    resp.result = self._remote_exec(
+                        node, index, c, node_shards,
+                        bypass=getattr(opt, "cache_bypass", False),
+                    )
         except Exception as e:  # transport or peer error -> retried upstream
             resp.err = e
             if span is not None:
@@ -595,10 +598,11 @@ class Cluster:
                 span.finish()
         ch.put(resp)
 
-    def _remote_exec(self, node, index, c, shards):
+    def _remote_exec(self, node, index, c, shards, bypass=False):
         try:
             out = self.client.query_node(
-                node, index, c.to_string(), shards=shards, remote=True
+                node, index, c.to_string(), shards=shards, remote=True,
+                bypass=bypass,
             )
         except ClientError as e:
             # A peer that missed a DDL broadcast answers code=not-found:
@@ -624,7 +628,8 @@ class Cluster:
 
             count_rpc_retry(peer_label(node), "query_node")
             out = self.client.query_node(
-                node, index, c.to_string(), shards=shards, remote=True
+                node, index, c.to_string(), shards=shards, remote=True,
+                bypass=bypass,
             )
             # The retry succeeded: the peer genuinely lacked schema and is
             # now repaired. Forget the attempt so a FUTURE missed DDL on

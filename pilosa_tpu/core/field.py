@@ -212,6 +212,16 @@ class Field:
         os.replace(tmp, self._meta_path())
 
     def _load_available_shards(self) -> None:
+        # Sweep tmp orphans first: a crash between the tmp write and its
+        # os.replace leaves `.available.shards.tmp.<tid>` behind, and
+        # per-thread names (the rename-race fix below) never
+        # self-overwrite across restarts the way one fixed name did.
+        for entry in os.listdir(self.path):
+            if entry.startswith(".available.shards.tmp"):
+                try:
+                    os.remove(os.path.join(self.path, entry))
+                except OSError:
+                    pass  # already gone / racing sibling: nothing lost
         p = os.path.join(self.path, ".available.shards")
         if os.path.exists(p):
             with open(p, "rb") as f:
@@ -223,7 +233,14 @@ class Field:
         if self.path is None:
             return
         p = os.path.join(self.path, ".available.shards")
-        tmp = p + ".tmp"
+        # Per-thread tmp name: two import threads landing NEW shards
+        # concurrently both enter here, and with one shared ".tmp" the
+        # loser's os.replace finds its source already renamed away
+        # (ENOENT -> a 500 mid-import; BENCH_r10's first ingest run).
+        # Unique names keep every replace atomic and sourced; a stale
+        # last-writer-wins image self-heals at open(), which unions the
+        # persisted bitmap with the fragment directory scan.
+        tmp = p + f".tmp.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(serialize(self._available_shards))
         os.replace(tmp, p)

@@ -52,6 +52,10 @@ class ExecOptions:
     exclude_columns: bool = False
     column_attrs: bool = False
     shards: Optional[list[int]] = None
+    # Per-query result-cache bypass (HTTP `X-Pilosa-Cache: bypass`):
+    # skips lookup AND population — the always-fresh escape hatch the
+    # staleness contract documents (counted rescache_bypass_total).
+    cache_bypass: bool = False
 
 
 class Executor:
@@ -79,6 +83,12 @@ class Executor:
         # requests coalesce into shared device launches. Wired by the
         # CLI when the device backend is enabled.
         self.batcher = None
+        # Epoch-tagged result cache (exec/rescache.py, ISSUE r12): when
+        # set, terminal answers are consulted/populated around planning
+        # and batching, keyed on (index, canonical PQL, shard set) and
+        # revalidated against the journal-derived epoch vector. Wired by
+        # the CLI from the cache-enabled/max-result-cache-bytes knobs.
+        self.rescache = None
         # Local map_reduce worker-pool width (reference mapperLocal,
         # executor.go:2578). 1 = serial; the CPU-oracle bench raises it.
         self.local_workers: int = 1
@@ -134,6 +144,15 @@ class Executor:
         translate = self._needs_translation(idx)
         if query.calls and not prof.call:
             prof.call = query.calls[0].name
+        # Result-cache plane (exec/rescache.py): consulted only where a
+        # LOCAL epoch vector can witness every relevant write — the
+        # single-node coordinator and remote per-node legs. A clustered
+        # coordinator's answers depend on peer-held shards whose writes
+        # never bump local generations, so it must not cache.
+        cache = self.rescache
+        if cache is not None and self.mapper is not None and not opt.remote:
+            cache = None
+
         with self.tracer.start_span("executor.Execute") as span:
             span.set_tag("index", index)
             calls = query.calls
@@ -168,11 +187,44 @@ class Executor:
                     with self.tracer.start_span("executor.executeCountBatch"):
                         inner = [b.children[0] for b in batch]
                         sh = self._shards(index, shards)
-                        if self.batcher is not None:
-                            counts = self.batcher.count(index, inner, sh)
-                        else:
-                            counts = self.backend.count_batch(index, inner, sh)
-                    results.extend(int(v) for v in counts)
+                        # Cache consult BEFORE legs go to the batcher:
+                        # hits never launch; the remaining misses still
+                        # coalesce into one device dispatch.
+                        out: list = [None] * run
+                        tokens: list = [None] * run
+                        if cache is not None:
+                            if opt.cache_bypass:
+                                cache.count_bypass(index, run)
+                                prof.incr("cache_bypass", run)
+                            else:
+                                for k, b in enumerate(batch):
+                                    t = cache.begin(
+                                        index, b, sh, remote=opt.remote
+                                    )
+                                    if t is None:
+                                        prof.incr("cache_uncached")
+                                        continue
+                                    tokens[k] = t
+                                    prof.incr("cache_lookups")
+                                    if t.hit:
+                                        prof.incr("cache_hits")
+                                        out[k] = int(t.value)
+                        miss = [k for k in range(run) if out[k] is None]
+                        if miss:
+                            miss_inner = [inner[k] for k in miss]
+                            if self.batcher is not None:
+                                counts = self.batcher.count(
+                                    index, miss_inner, sh
+                                )
+                            else:
+                                counts = self.backend.count_batch(
+                                    index, miss_inner, sh
+                                )
+                            for k, v in zip(miss, counts):
+                                out[k] = int(v)
+                                if tokens[k] is not None:
+                                    cache.commit(tokens[k], int(v))
+                    results.extend(out)
                     i += run
                     continue
                 call = calls[i]
@@ -184,6 +236,33 @@ class Executor:
                 if not opt.remote and (translate or call.has_str_args):
                     with prof.phase("key_translate"):
                         call = self._translate_call(idx, call)
+                # Cache consult AFTER key translation (keys share the
+                # translated-ids spelling; id->key maps are append-only
+                # so cached key-translated results stay valid) and
+                # BEFORE planning/dispatch. The miss's answer commits
+                # fully translated, so a hit skips the whole pipeline.
+                token = None
+                if cache is not None and not opt.cache_bypass:
+                    token = cache.begin(
+                        index, call, self._shards(index, shards),
+                        exclude_row_attrs=opt.exclude_row_attrs,
+                        remote=opt.remote,
+                    )
+                    if token is not None:
+                        prof.incr("cache_lookups")
+                        if token.hit:
+                            prof.incr("cache_hits")
+                            results.append(token.value)
+                            i += 1
+                            continue
+                    else:
+                        # Fresh-computed answer the cache never held
+                        # (uncacheable call/coverage): the response
+                        # marker must not claim a pure cache serve.
+                        prof.incr("cache_uncached")
+                elif cache is not None and call.name in cache.CACHEABLE:
+                    cache.count_bypass(index)
+                    prof.incr("cache_bypass")
                 check_deadline("device_dispatch")
                 with self.tracer.start_span(f"executor.execute{call.name}"):
                     result = self.execute_call(index, call, shards, opt)
@@ -191,6 +270,8 @@ class Executor:
                     check_deadline("key_translate")
                     with prof.phase("key_translate"):
                         result = self._translate_result(idx, call, result)
+                if token is not None:
+                    cache.commit(token, result)
                 results.append(result)
                 i += 1
             # Phase breakdown on the executor span so /debug/traces shows
@@ -1104,6 +1185,11 @@ class Executor:
             raise QueryError("SetRowAttrs() row argument required")
         attrs = {k: v for k, v in c.args.items() if not is_reserved_arg(k)}
         f.row_attr_store.set_attrs(row_id, attrs)
+        # The attr plane is not versioned by view generations, so no
+        # epoch vector can witness this write: salt-bump the index's
+        # cached answers unaddressable instead (exec/rescache.py).
+        if self.rescache is not None:
+            self.rescache.invalidate_index(index)
         return None
 
     def _execute_set_column_attrs(self, index, c, opt) -> None:
@@ -1120,6 +1206,9 @@ class Executor:
             raise QueryError("SetColumnAttrs() column argument required")
         attrs = {k: v for k, v in c.args.items() if not is_reserved_arg(k)}
         idx.column_attr_store.set_attrs(col_id, attrs)
+        # Same unversioned-plane contract as SetRowAttrs above.
+        if self.rescache is not None:
+            self.rescache.invalidate_index(index)
         return None
 
     # ------------------------------------------------------------------

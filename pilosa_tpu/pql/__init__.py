@@ -17,5 +17,7 @@ from pilosa_tpu.pql.ast import (
     Call,
     Condition,
     Query,
+    canonical_key,
+    canonicalize,
 )
 from pilosa_tpu.pql.parser import ParseError, parse_string

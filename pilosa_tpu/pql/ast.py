@@ -183,6 +183,61 @@ class Call:
         )
 
 
+#: Set operations whose children commute: reordering the inputs cannot
+#: change the result, so canonicalization may sort them into one shared
+#: spelling. Difference/Not are order-sensitive and MUST stay out — an
+#: entry keyed on a sorted Difference would serve A\B for B\A.
+COMMUTATIVE_CALLS = frozenset(("Intersect", "Union", "Xor"))
+
+
+def canonicalize(c: Call) -> Call:
+    """Structural canonical form for result-cache keying (ISSUE r12):
+    syntactically different but equivalent queries share one spelling.
+    Commutative set-op children (Intersect/Union/Xor) sort by their own
+    canonical string; everything else keeps order. Copy-on-write like
+    executor._translate_call: returns `c` UNCHANGED when it is already
+    canonical, so the common single-Row/sorted case allocates nothing.
+    Literal normalization rides Call.to_string(): args print sorted by
+    key with one deterministic value formatting, so `Row(f=3)` and
+    `Row( f = 3 )` already collapse at the string layer."""
+    new_children = None
+    for i, child in enumerate(c.children):
+        nc = canonicalize(child)
+        if nc is not child:
+            if new_children is None:
+                new_children = list(c.children)
+            new_children[i] = nc
+    if c.name in COMMUTATIVE_CALLS and len(c.children) > 1:
+        kids = new_children if new_children is not None else list(c.children)
+        ordered = sorted(kids, key=Call.to_string)
+        if ordered != kids or new_children is not None:
+            new_children = ordered
+    # Nested calls in args (GroupBy filter=) canonicalize too.
+    new_args = None
+    for k, v in c.args.items():
+        if isinstance(v, Call):
+            nv = canonicalize(v)
+            if nv is not v:
+                if new_args is None:
+                    new_args = dict(c.args)
+                new_args[k] = nv
+    if new_children is None and new_args is None:
+        return c
+    return Call(
+        c.name,
+        new_args if new_args is not None else dict(c.args),
+        new_children if new_children is not None else list(c.children),
+    )
+
+
+def canonical_key(c: Call) -> str:
+    """The cache-key spelling of a call: canonical tree, stringified
+    (children first, args sorted — Call.to_string). Equivalent queries
+    map to one key; inequivalent ones (Difference order, distinct
+    literals) never collide beyond what PQL semantics guarantee."""
+    return canonicalize(c).to_string()
+
+
 def _fmt_val(v: Any) -> str:
     if v is None:
         return "null"

@@ -186,6 +186,7 @@ class API:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
         remote: bool = False,
+        cache_bypass: bool = False,
     ) -> tuple[list[Any], list[dict]]:
         """Raw executor results + column attr sets (shared by the JSON and
         protobuf response encoders)."""
@@ -197,6 +198,7 @@ class API:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
             column_attrs=column_attrs,
+            cache_bypass=cache_bypass,
         )
         from pilosa_tpu.cluster.client import ClientError
         from pilosa_tpu.cluster.cluster import ShardUnavailableError
@@ -241,11 +243,13 @@ class API:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
         remote: bool = False,
+        cache_bypass: bool = False,
     ) -> dict[str, Any]:
         results, attr_sets = self.query_results(
             index, query, shards=shards, column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns, remote=remote,
+            cache_bypass=cache_bypass,
         )
         from pilosa_tpu.utils.deadline import DeadlineExceeded, check_deadline
         from pilosa_tpu.utils.qprofile import current_profile

@@ -142,6 +142,19 @@ class Config:
     # Retry-After + code=wal-backlog until the background snapshot
     # plane catches up. 0 = no cap.
     max_pending_wal: int = 0
+    # -- result cache (ISSUE r12) ------------------------------------------
+    # Byte budget for the epoch-tagged result cache (exec/rescache.py):
+    # terminal answers (Count/Row/TopN/Sum/Min/Max/GroupBy) served from
+    # memory while their journal-derived epoch vector still matches.
+    # 0 = disabled (matching the max-inflight convention).
+    max_result_cache_bytes: int = 0
+    # Bounded-staleness contract: serve a generation-mismatched cached
+    # answer when every covered view is at most this many (process-
+    # global) write generations behind. 0 = exact-epoch only (default).
+    max_staleness: int = 0
+    # Master switch: false keeps the cache out even when a byte budget
+    # is set (the bench's enabled-vs-disabled same-run comparison).
+    cache_enabled: bool = True
     # HBM residency budget in bytes for the TPU backend's field stacks
     # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
     # via row paging instead of whole-stack residency.
@@ -244,6 +257,9 @@ class Config:
             "max-import-bytes": self.max_import_bytes,
             "max-pending-wal": self.max_pending_wal,
             "max-hbm-bytes": self.max_hbm_bytes,
+            "max-result-cache-bytes": self.max_result_cache_bytes,
+            "max-staleness": self.max_staleness,
+            "cache-enabled": self.cache_enabled,
             "profile": {"port": self.profile_port},
             "query-timeout": self.query_timeout,
             "client-retries": self.client_retries,
@@ -293,6 +309,9 @@ class Config:
             "max-import-bytes": "max_import_bytes",
             "max-pending-wal": "max_pending_wal",
             "max-hbm-bytes": "max_hbm_bytes",
+            "max-result-cache-bytes": "max_result_cache_bytes",
+            "max-staleness": "max_staleness",
+            "cache-enabled": "cache_enabled",
             "query-timeout": "query_timeout",
             "client-retries": "client_retries",
             "breaker-threshold": "breaker_threshold",
@@ -345,6 +364,12 @@ class Config:
             pre + "MAX_IMPORT_BYTES": ("max_import_bytes", int),
             pre + "MAX_PENDING_WAL": ("max_pending_wal", int),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
+            pre + "MAX_RESULT_CACHE_BYTES": ("max_result_cache_bytes", int),
+            pre + "MAX_STALENESS": ("max_staleness", int),
+            pre + "CACHE_ENABLED": (
+                "cache_enabled",
+                lambda v: v.lower() in ("1", "true"),
+            ),
             pre + "QUERY_TIMEOUT": ("query_timeout", float),
             pre + "CLIENT_RETRIES": ("client_retries", int),
             pre + "BREAKER_THRESHOLD": ("breaker_threshold", int),
@@ -391,6 +416,9 @@ class Config:
             f"max-import-bytes = {c.max_import_bytes}\n"
             f"max-pending-wal = {c.max_pending_wal}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
+            f"max-result-cache-bytes = {c.max_result_cache_bytes}\n"
+            f"max-staleness = {c.max_staleness}\n"
+            f"cache-enabled = {str(c.cache_enabled).lower()}\n"
             f"query-timeout = {c.query_timeout}\n"
             f"client-retries = {c.client_retries}\n"
             f"breaker-threshold = {c.breaker_threshold}\n"

@@ -798,6 +798,14 @@ class _Handler(BaseHTTPRequestHandler):
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
             remote=remote,
+            # Per-query result-cache bypass (docs/administration.md
+            # "Result caching"): the always-fresh escape hatch. Shed
+            # 429s never reach the executor, so refused queries can
+            # neither hit nor populate the cache by construction.
+            cache_bypass=(
+                (self.headers.get("X-Pilosa-Cache") or "").strip().lower()
+                == "bypass"
+            ),
         )
         # Content negotiation (reference handler.go: protobuf responses
         # when the client Accepts application/x-protobuf).
@@ -824,11 +832,38 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     return
                 with prof.phase("serialize"):
-                    self._reply(data, content_type="application/x-protobuf")
+                    self._reply(
+                        data, content_type="application/x-protobuf",
+                        headers=self._cache_marker(prof),
+                    )
                 return
             out = self.api.query(index, query, **kw)
             with prof.phase("serialize"):
-                self._reply(out)
+                self._reply(out, headers=self._cache_marker(prof))
+
+    @staticmethod
+    def _cache_marker(prof) -> Optional[dict]:
+        """Served-from-cache response marker: X-Pilosa-Cache is `hit`
+        when EVERY answer in the request came from the result cache,
+        `partial` when some did (misses or uncacheable calls computed
+        the rest fresh), `miss` when lookups happened but none hit, and
+        `bypass` when the request asked past the cache. Absent entirely
+        when no cache is wired or nothing was even looked up."""
+        c = getattr(prof, "counters", None) or {}
+        if c.get("cache_bypass"):
+            return {"X-Pilosa-Cache": "bypass"}
+        lookups = c.get("cache_lookups", 0)
+        if not lookups:
+            return None
+        hits = c.get("cache_hits", 0)
+        uncached = c.get("cache_uncached", 0)
+        if hits and hits == lookups and not uncached:
+            state = "hit"
+        elif hits:
+            state = "partial"
+        else:
+            state = "miss"
+        return {"X-Pilosa-Cache": state}
 
     #: On a shed, bodies up to this size are drained to keep the
     #: keep-alive connection framed; larger ones are NOT read (reading
@@ -1405,6 +1440,20 @@ class _Handler(BaseHTTPRequestHandler):
                 "entries": blocks.ledger(),
             }
         )
+
+    @route("GET", r"/debug/rescache")
+    def handle_debug_rescache(self):
+        """The result-cache ledger (exec/rescache.py): totals plus
+        entries sorted coldest-first — the LRU eviction-candidate order,
+        mirroring /debug/hbm. {enabled: false} when no cache is wired."""
+        rc = getattr(self.api.executor, "rescache", None)
+        if rc is None:
+            self._reply(
+                {"enabled": False, "residentBytes": 0, "entryCount": 0,
+                 "entries": []}
+            )
+            return
+        self._reply(rc.debug_dump())
 
     # -- internal routes (reference http/handler.go:307-318) ---------------
 

@@ -68,6 +68,16 @@ def test_bench_smoke(tmp_path):
     assert "cold_build_dense_seconds" in blob
     assert "churn_version_walks" in blob
     assert "minmax_churn_qps_ratio" in blob
+    # The r12 zipf-cache keys the driver's acceptance reads: hit-rate
+    # vs qps per concurrency point, churn-burst phases, the same-run
+    # disabled comparison, and the byte-identity differential.
+    assert set(blob["zipf_qps_at_clients"]) == {"1", "4"}
+    assert set(blob["zipf_hit_rate_at_clients"]) == {"1", "4"}
+    assert set(blob["zipf_hit_rate_phases"]) == {"pre", "burst", "post"}
+    assert "zipf_qps_disabled" in blob
+    assert "zipf_cache_speedup" in blob
+    assert blob["zipf_differential_mismatches"] == 0
+    assert blob["zipf_churn_writes"] > 0
     # The r11 concurrency-sweep keys the driver's acceptance reads.
     assert set(blob["qps_at_clients"]) == {"1", "4"}
     assert "batch_occupancy_mean_at_clients" in blob
@@ -93,8 +103,8 @@ def test_bench_smoke(tmp_path):
     # Every leg checkpointed along the way.
     for leg in ("build", "cold_build", "tpu_batch", "single_query",
                 "minmax_churn", "http", "qps@1", "qps@4",
-                "concurrency_sweep", "ingest_under_load",
-                "rolling_restart"):
+                "concurrency_sweep", "zipf@1", "zipf@4", "zipf_cache",
+                "ingest_under_load", "rolling_restart"):
         assert leg in blob["legs_done"], blob["legs_done"]
     # The partial artifact also landed complete on disk.
     disk = json.loads(open(env["BENCH_PARTIAL_PATH"]).read())

@@ -1,0 +1,545 @@
+"""Epoch-tagged result cache tests (ISSUE r12): canonicalization
+equivalence pins, epoch/journal addressability semantics, the bounded-
+staleness contract, strict LRU size accounting, the differential
+cached-vs-uncached contract under import churn (including the TopN
+rank-cache interaction), and the HTTP bypass/marker surface."""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.core.field import options_for_int
+from pilosa_tpu.exec import ExecOptions, Executor
+from pilosa_tpu.exec.rescache import ResultCache, result_nbytes
+from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.pql import canonical_key, canonicalize, parse_string
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.stats import global_stats
+
+
+def one(q):
+    return parse_string(q).calls[0]
+
+
+def encode(results):
+    return json.dumps([result_to_json(r) for r in results], sort_keys=True)
+
+
+@pytest.fixture
+def holder():
+    h = Holder(None).open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    rng = np.random.default_rng(5)
+    for shard in range(3):
+        base = shard * SHARD_WIDTH
+        for field in (f, g):
+            rows = np.repeat(np.arange(4, dtype=np.uint64), 200)
+            cols = rng.integers(0, SHARD_WIDTH, rows.size).astype(
+                np.uint64
+            ) + base
+            field.import_bits(rows, cols)
+    v = idx.create_field("v", options_for_int(-10000, 10000))
+    cols = np.arange(300, dtype=np.uint64) * 17 % (3 * SHARD_WIDTH)
+    v.import_value(
+        np.unique(cols), (np.unique(cols).astype(np.int64) % 400) - 200
+    )
+    yield h
+    h.close()
+
+
+def cached_executor(h, max_bytes=1 << 20, max_staleness=0):
+    ex = Executor(h)
+    ex.rescache = ResultCache(
+        h, max_bytes=max_bytes, max_staleness=max_staleness
+    )
+    return ex
+
+
+class TestCanonicalization:
+    def test_intersect_order_shares_key(self):
+        a = one("Count(Intersect(Row(f=1), Row(g=2)))")
+        b = one("Count(Intersect(Row(g=2), Row(f=1)))")
+        assert canonical_key(a) == canonical_key(b)
+
+    def test_union_xor_share_keys(self):
+        for op in ("Union", "Xor"):
+            a = one(f"{op}(Row(f=1), Row(g=2), Row(f=3))")
+            b = one(f"{op}(Row(f=3), Row(f=1), Row(g=2))")
+            assert canonical_key(a) == canonical_key(b), op
+
+    def test_difference_order_does_not_share(self):
+        a = one("Count(Difference(Row(f=1), Row(g=2)))")
+        b = one("Count(Difference(Row(g=2), Row(f=1)))")
+        assert canonical_key(a) != canonical_key(b)
+
+    def test_nested_commutative_sorts(self):
+        a = one("Count(Intersect(Union(Row(g=2), Row(f=1)), Row(f=3)))")
+        b = one("Count(Intersect(Row(f=3), Union(Row(f=1), Row(g=2))))")
+        assert canonical_key(a) == canonical_key(b)
+
+    def test_distinct_literals_distinct_keys(self):
+        assert canonical_key(one("Row(f=1)")) != canonical_key(one("Row(f=2)"))
+        assert canonical_key(one('Row(f="a")')) != canonical_key(
+            one('Row(f="b")')
+        )
+
+    def test_copy_on_write_identity(self):
+        # Already-canonical trees come back unchanged — no allocation on
+        # the hot path (the _translate_call discipline).
+        c = one("Count(Row(f=1))")
+        assert canonicalize(c) is c
+        swapped = one("Intersect(Row(g=1), Row(f=1))")
+        out = canonicalize(swapped)
+        assert out is not swapped
+        assert [k.to_string() for k in out.children] == sorted(
+            k.to_string() for k in swapped.children
+        )
+
+    def test_groupby_filter_arg_canonicalizes(self):
+        a = one("GroupBy(Rows(f), filter=Intersect(Row(g=2), Row(f=1)))")
+        b = one("GroupBy(Rows(f), filter=Intersect(Row(f=1), Row(g=2)))")
+        assert canonical_key(a) == canonical_key(b)
+
+
+class TestAddressability:
+    def test_hit_miss_and_negative_result(self, holder):
+        ex = cached_executor(holder)
+        # f=9 has no bits: the empty/zero answer caches like any other.
+        for q in ("Count(Row(f=1))", "Count(Row(f=9))"):
+            first = ex.execute("i", q)
+            second = ex.execute("i", q)
+            assert first == second
+        d = ex.rescache.debug_dump()
+        assert d["hits"] == 2 and d["misses"] == 2 and d["inserts"] == 2
+
+    def test_covered_write_stops_addressing(self, holder):
+        ex = cached_executor(holder)
+        q = "Count(Row(f=1))"
+        before = ex.execute("i", q)[0]
+        holder.index("i").field("f").set_bit(1, 5)
+        after = ex.execute("i", q)
+        d = ex.rescache.debug_dump()
+        assert d["misses"] == 2 and d["hits"] == 0
+        assert after[0] in (before, before + 1)  # col 5 may already be set
+
+    def test_disjoint_shard_write_keeps_entry(self, holder):
+        # The journal-refined epoch trick: a write to a shard OUTSIDE
+        # the query's pinned shard set keeps the entry addressable.
+        ex = cached_executor(holder)
+        q = "Count(Row(f=1))"
+        ex.execute("i", q, shards=[0])
+        holder.index("i").field("f").set_bit(1, 2 * SHARD_WIDTH + 9)
+        ex.execute("i", q, shards=[0])
+        d = ex.rescache.debug_dump()
+        assert d["hits"] == 1 and d["misses"] == 1
+
+    def test_unrelated_field_write_keeps_entry(self, holder):
+        ex = cached_executor(holder)
+        q = "Count(Row(f=1))"
+        ex.execute("i", q)
+        holder.index("i").field("g").set_bit(0, 3)
+        ex.execute("i", q)
+        d = ex.rescache.debug_dump()
+        assert d["hits"] == 1 and d["misses"] == 1
+
+    def test_empty_field_first_write_not_stale(self, holder):
+        # Structural axis: an entry computed over an EMPTY field (no
+        # views at all) must stop being addressable when the first
+        # write creates the view — no data generation exists to
+        # witness it, the field structure_version does.
+        idx = holder.index("i")
+        idx.create_field("fresh")
+        ex = cached_executor(holder)
+        q = "Count(Row(fresh=1))"
+        assert ex.execute("i", q) == [0]
+        idx.field("fresh").set_bit(1, 0)
+        assert ex.execute("i", q) == [1]
+
+    def test_recreated_field_not_stale(self, holder):
+        idx = holder.index("i")
+        ex = cached_executor(holder)
+        q = "Count(Row(g=1))"
+        before = ex.execute("i", q)[0]
+        assert before > 0
+        idx.delete_field("g")
+        idx.create_field("g")
+        assert ex.execute("i", q) == [0]
+
+    def test_max_staleness_contract(self, holder):
+        # Exact-epoch (0): any covered write is a miss. Bounded (large
+        # N): the same write is served stale, counted as a stale hit.
+        exact = cached_executor(holder, max_staleness=0)
+        q = "Count(Row(f=2))"
+        exact.execute("i", q)
+        holder.index("i").field("f").set_bit(2, 11)
+        exact.execute("i", q)
+        assert exact.rescache.debug_dump()["hits"] == 0
+
+        loose = cached_executor(holder, max_staleness=10_000)
+        stale_val = loose.execute("i", q)[0]
+        holder.index("i").field("f").set_bit(2, 12)
+        served = loose.execute("i", q)
+        d = loose.rescache.debug_dump()
+        assert d["hits"] == 1 and d["staleHits"] == 1
+        assert served[0] == stale_val  # the stale answer, by contract
+
+    def test_attr_write_invalidates_index(self, holder):
+        ex = cached_executor(holder)
+        q = "Row(f=1)"
+        ex.execute("i", q)
+        ex.execute("i", "SetRowAttrs(f, 1, color=\"blue\")")
+        row = ex.execute("i", q)[0]
+        assert row.attrs == {"color": "blue"}
+
+    def test_clustered_coordinator_never_consults(self, holder):
+        # A wired mapper means answers depend on peer-held shards whose
+        # writes no local generation witnesses: the cache must stay out.
+        ex = cached_executor(holder)
+        ex.mapper = lambda index, shards, c, map_fn, reduce_fn, opt: (
+            sum(map_fn(s) for s in shards)
+        )
+        ex.execute("i", "Count(Row(f=1))")
+        ex.execute("i", "Count(Row(f=1))")
+        d = ex.rescache.debug_dump()
+        assert d["hits"] == 0 and d["misses"] == 0
+
+    def test_remote_leg_key_never_collides(self, holder):
+        # Remote per-node partials (untrimmed TopN) cache under a
+        # remote-flagged key: a coordinator answer for the same PQL
+        # must never be served a partial, nor vice versa.
+        ex = cached_executor(holder)
+        q = "TopN(f, n=2)"
+        local = ex.execute("i", q)[0]
+        remote = ex.execute("i", q, opt=ExecOptions(remote=True))[0]
+        d = ex.rescache.debug_dump()
+        assert d["misses"] == 2 and d["entryCount"] == 2
+        assert ex.execute("i", q)[0] is local
+        assert (
+            ex.execute("i", q, opt=ExecOptions(remote=True))[0] is remote
+        )
+
+    def test_uncacheable_calls_pass_through(self, holder):
+        ex = cached_executor(holder)
+        # Writes and unknown-coverage calls never enter the cache.
+        ex.execute("i", "Set(3, f=1)")
+        ex.execute("i", "Rows(f)")
+        d = ex.rescache.debug_dump()
+        assert d["inserts"] == 0 and d["misses"] == 0
+
+    def test_bypass_skips_lookup_and_population(self, holder):
+        ex = cached_executor(holder)
+        opt = ExecOptions(cache_bypass=True)
+        ex.execute("i", "Count(Row(f=1))", opt=opt)
+        ex.execute("i", "Count(Row(f=1))", opt=opt)
+        d = ex.rescache.debug_dump()
+        assert d["bypass"] == 2 and d["inserts"] == 0 and d["hits"] == 0
+
+
+class TestClusterPropagation:
+    def test_bypass_rides_remote_legs(self):
+        """X-Pilosa-Cache: bypass must cross the node boundary: peers
+        consult their LOCAL result caches on remote legs, so a bypassed
+        fan-out that didn't propagate would still be served from peer
+        caches — the always-fresh contract silently inert exactly where
+        staleness is possible."""
+        import urllib.request
+
+        from cluster_harness import TestCluster
+        from pilosa_tpu.shardwidth import SHARD_WIDTH as SW
+
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            for shard in range(6):
+                c.query(0, "i", f"Set({shard * SW + 1}, f=0)")
+            c.await_shard_convergence("i")
+            caches = []
+            for cn in c.nodes:
+                cn.executor.rescache = ResultCache(
+                    cn.holder, max_bytes=1 << 20
+                )
+                caches.append(cn.executor.rescache)
+            uri = str(c[0].node.uri)
+
+            def post(headers):
+                req = urllib.request.Request(
+                    uri + "/index/i/query", data=b"Count(Row(f=0))",
+                    method="POST", headers=headers,
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return json.loads(resp.read())
+
+            assert post({})["results"] == [6]
+            # The remote node's cache served/populated its local leg...
+            assert any(cache.debug_dump()["inserts"] > 0
+                       for cache in caches)
+            base = [cache.debug_dump() for cache in caches]
+            # ...and a bypassed fan-out touches NO cache on any node.
+            assert post({"X-Pilosa-Cache": "bypass"})["results"] == [6]
+            for cache, b in zip(caches, base):
+                d = cache.debug_dump()
+                assert d["hits"] == b["hits"], "bypass leg hit a cache"
+                assert d["inserts"] == b["inserts"]
+                assert d["bypass"] >= b["bypass"]
+
+
+class TestSizeAccounting:
+    def test_resident_bytes_sums_exactly(self, holder):
+        # The ledger discipline: the gauge and the dump total are the
+        # exact sum of per-entry accounted bytes (like /debug/hbm's
+        # tier sums).
+        ex = cached_executor(holder)
+        for rid in range(4):
+            ex.execute("i", f"Count(Row(f={rid}))")
+            ex.execute("i", f"Row(g={rid})")
+        d = ex.rescache.debug_dump()
+        assert d["entryCount"] == 8
+        assert d["residentBytes"] == sum(e["bytes"] for e in d["entries"])
+        gauges = global_stats.snapshot()["gauges"]
+        assert gauges["rescache_resident_bytes"] == d["residentBytes"]
+        assert gauges["rescache_entries"] == d["entryCount"]
+
+    def test_lru_eviction_under_budget(self, holder):
+        ex = cached_executor(holder)
+        # Measure one entry's cost, then budget for ~3 of them.
+        ex.execute("i", "Count(Row(f=0))")
+        per = ex.rescache.resident_bytes()
+        ex = cached_executor(holder, max_bytes=3 * per + per // 2)
+        for rid in range(6):
+            ex.execute("i", f"Count(Row(f={rid}))")
+        d = ex.rescache.debug_dump()
+        assert d["evictions"] >= 2
+        assert d["residentBytes"] <= ex.rescache.max_bytes
+        assert d["residentBytes"] == sum(e["bytes"] for e in d["entries"])
+        # Coldest evicted first: the surviving entries are the newest.
+        queries = [e["query"] for e in d["entries"]]
+        assert "Count(Row(f=0))" not in queries
+        assert "Count(Row(f=5))" in queries
+
+    def test_oversized_answer_not_retained(self, holder):
+        # Budget sized so a Count entry fits but a Row's column array
+        # does not: the oversized answer must be dropped WITHOUT
+        # flushing the live entries on its way through.
+        ex = cached_executor(holder)
+        ex.execute("i", "Count(Row(f=0))")
+        per = ex.rescache.resident_bytes()
+        ex = cached_executor(holder, max_bytes=2 * per)
+        ex.execute("i", "Count(Row(f=0))")
+        before = ex.rescache.debug_dump()
+        assert before["entryCount"] == 1
+        ex.execute("i", "Row(f=1)")  # column array alone exceeds budget
+        d = ex.rescache.debug_dump()
+        assert d["entryCount"] == 1  # survivor intact, not flushed
+        assert d["evictions"] == 1  # the churn stays visible
+        assert d["residentBytes"] == before["residentBytes"]
+        ex.execute("i", "Count(Row(f=0))")
+        assert ex.rescache.debug_dump()["hits"] == 1  # still served
+
+    def test_result_nbytes_strictness(self):
+        # Estimator sanity: monotone in payload size, never zero.
+        from pilosa_tpu.core.row import Row
+
+        small = Row([1, 2, 3])
+        big = Row(list(range(1000)))
+        assert 0 < result_nbytes(small) < result_nbytes(big)
+        assert result_nbytes(None) > 0
+        assert result_nbytes([1, "x", None]) > 0
+
+
+class TestDifferentialUnderChurn:
+    QUERIES = (
+        "Count(Row(f=1))",
+        "Count(Intersect(Row(f=1), Row(g=2)))",
+        "Row(f=2)",
+        "Union(Row(f=0), Row(g=3))",
+        "TopN(f, n=3)",
+        "Sum(field=v)",
+        "Min(field=v)",
+        "Max(field=v)",
+        "GroupBy(Rows(f))",
+        "Count(Not(Row(f=1)))",
+    )
+
+    def test_cached_equals_uncached_across_churn(self, holder):
+        """The acceptance contract: at every churn epoch, answers served
+        through the cache are byte-identical to a cache-less executor's
+        — including TopN, whose per-fragment rank cache invalidates on
+        mutation and must never leak a pre-churn ranking through the
+        result cache."""
+        cached = cached_executor(holder, max_bytes=4 << 20)
+        plain = Executor(holder)
+        idx = holder.index("i")
+        rng = np.random.default_rng(77)
+        for epoch in range(5):
+            # Serve everything twice: the second pass is the hot path
+            # (hits at this epoch), both must equal the uncached oracle.
+            for _ in range(2):
+                got = [cached.execute("i", q)[0] for q in self.QUERIES]
+                want = [plain.execute("i", q)[0] for q in self.QUERIES]
+                assert encode(got) == encode(want), f"epoch {epoch}"
+            assert cached.rescache.debug_dump()["hits"] > 0
+            # Churn window: set-bit imports AND BSI import_value, the
+            # two write planes with distinct freshness paths.
+            shard = int(rng.integers(0, 3))
+            rows = rng.integers(0, 4, 40).astype(np.uint64)
+            cols = rng.integers(0, SHARD_WIDTH, 40).astype(
+                np.uint64
+            ) + shard * SHARD_WIDTH
+            idx.field("f").import_bits(rows, cols)
+            vcols = np.unique(
+                rng.integers(0, 3 * SHARD_WIDTH, 20).astype(np.uint64)
+            )
+            idx.field("v").import_value(
+                vcols, rng.integers(-200, 200, vcols.size)
+            )
+
+    def test_hit_rate_recovers_after_churn(self, holder):
+        cached = cached_executor(holder)
+        for q in self.QUERIES[:4]:
+            cached.execute("i", q)
+        h0 = cached.rescache.debug_dump()["hits"]
+        for q in self.QUERIES[:4]:
+            cached.execute("i", q)
+        assert cached.rescache.debug_dump()["hits"] == h0 + 4
+        # Burst: everything covered goes unaddressable...
+        holder.index("i").field("f").set_bit(0, 1)
+        holder.index("i").field("g").set_bit(0, 1)
+        for q in self.QUERIES[:4]:
+            cached.execute("i", q)
+        assert cached.rescache.debug_dump()["hits"] == h0 + 4
+        # ...and one repopulating pass restores hits.
+        for q in self.QUERIES[:4]:
+            cached.execute("i", q)
+        assert cached.rescache.debug_dump()["hits"] == h0 + 8
+
+
+class TestHTTPSurface:
+    @pytest.fixture
+    def server(self, holder):
+        from pilosa_tpu.server.api import API
+        from pilosa_tpu.server.http import Server
+
+        ex = cached_executor(holder)
+        srv = Server(API(holder, ex), host="localhost", port=0).open()
+        yield srv, ex
+        srv.close()
+
+    def _post(self, srv, body, headers=None):
+        conn = http.client.HTTPConnection("localhost", srv.port)
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        conn.request("POST", "/index/i/query", body, h)
+        resp = conn.getresponse()
+        out = (resp.getheader("X-Pilosa-Cache"), json.loads(resp.read()))
+        conn.close()
+        return out
+
+    def test_marker_and_bypass_header(self, server):
+        srv, _ = server
+        q = "Count(Row(f=1))"
+        assert self._post(srv, q)[0] == "miss"
+        marker, body = self._post(srv, q)
+        assert marker == "hit"
+        # Bypass: always-fresh, never populates, marked as such.
+        marker, bypass_body = self._post(
+            srv, q, {"X-Pilosa-Cache": "bypass"}
+        )
+        assert marker == "bypass"
+        assert bypass_body == body  # byte-identical answers
+        assert self._post(srv, q + q)[0] in ("hit", "partial")
+
+    def test_marker_mixed_uncacheable_is_partial(self, server):
+        # A request mixing a cached Count with an uncacheable Rows must
+        # NOT claim `hit`: part of the response was computed fresh.
+        srv, _ = server
+        q = "Count(Row(f=1))"
+        self._post(srv, q)  # populate
+        marker, _ = self._post(srv, q + "Rows(f)")
+        assert marker == "partial"
+
+    def test_debug_rescache_endpoint(self, server):
+        srv, ex = server
+        self._post(srv, "Count(Row(f=1))")
+        self._post(srv, "Count(Row(f=1))")
+        conn = http.client.HTTPConnection("localhost", srv.port)
+        conn.request("GET", "/debug/rescache")
+        d = json.loads(conn.getresponse().read())
+        conn.close()
+        assert d["enabled"] is True
+        assert d["hits"] >= 1 and d["entryCount"] >= 1
+        assert d["residentBytes"] == sum(e["bytes"] for e in d["entries"])
+        assert all(
+            set(e) >= {"index", "query", "bytes", "hits", "ageSeconds"}
+            for e in d["entries"]
+        )
+
+    def test_debug_rescache_disabled(self, holder):
+        from pilosa_tpu.server.api import API
+        from pilosa_tpu.server.http import Server
+
+        srv = Server(API(holder, Executor(holder)), host="localhost",
+                     port=0).open()
+        try:
+            conn = http.client.HTTPConnection("localhost", srv.port)
+            conn.request("GET", "/debug/rescache")
+            d = json.loads(conn.getresponse().read())
+            conn.close()
+            assert d["enabled"] is False
+        finally:
+            srv.close()
+
+    def test_shed_request_never_caches(self, holder):
+        # Admission gating composes: a 429-shed query must neither hit
+        # nor populate (it never reaches the executor).
+        from pilosa_tpu.server.api import API
+        from pilosa_tpu.server.http import Server
+
+        ex = cached_executor(holder)
+        api = API(holder, ex)
+        api.max_inflight_queries = 1
+        # Saturate the gate directly, then post: the request sheds.
+        assert api.begin_query()
+        srv = Server(api, host="localhost", port=0).open()
+        try:
+            conn = http.client.HTTPConnection("localhost", srv.port)
+            conn.request(
+                "POST", "/index/i/query", "Count(Row(f=1))",
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 429
+            resp.read()
+            conn.close()
+        finally:
+            srv.close()
+            api.end_query()
+        d = ex.rescache.debug_dump()
+        assert d["inserts"] == 0 and d["misses"] == 0
+
+
+class TestConfigWiring:
+    def test_knobs_parse(self):
+        from pilosa_tpu.server.config import Config
+
+        cfg = Config.from_sources(env={
+            "PILOSA_TPU_MAX_RESULT_CACHE_BYTES": "1048576",
+            "PILOSA_TPU_MAX_STALENESS": "3",
+            "PILOSA_TPU_CACHE_ENABLED": "false",
+        })
+        assert cfg.max_result_cache_bytes == 1 << 20
+        assert cfg.max_staleness == 3
+        assert cfg.cache_enabled is False
+        d = cfg.to_dict()
+        assert d["max-result-cache-bytes"] == 1 << 20
+        assert d["max-staleness"] == 3
+        assert d["cache-enabled"] is False
+        assert "max-result-cache-bytes = 1048576" in cfg.toml_text()
+
+    def test_zero_bytes_means_disabled(self):
+        with pytest.raises(ValueError):
+            ResultCache(None, max_bytes=0)

@@ -334,8 +334,22 @@ class TestAdmissionControl:
     counted — instead of queueing toward an accept-path reset."""
 
     def _fill(self, api, n):
+        self._drain(api)
         for _ in range(n):
             assert api.begin_query()
+
+    @staticmethod
+    def _drain(api, timeout: float = 2.0) -> None:
+        """Wait for the server's in-flight count to reach zero: the
+        handler's `finally: end_query()` runs ~1 ms AFTER the client
+        has read the response body, so a test that saturates the cap
+        right after a request races the decrement (pre-r12 flake)."""
+        import time
+
+        t0 = time.monotonic()
+        while api._inflight_queries and time.monotonic() - t0 < timeout:
+            time.sleep(0.002)
+        assert api._inflight_queries == 0
 
     def test_shed_past_cap_then_recover(self, server):
         from pilosa_tpu.utils.stats import global_stats
@@ -383,6 +397,7 @@ class TestAdmissionControl:
         req(server, "POST", "/index/i/query", b"Set(1, f=1)", raw=True)
         api = server.api
         api.max_inflight_queries = 1
+        self._drain(api)
         assert api.begin_query()
         try:
             conn = http.client.HTTPConnection(server.host, server.port)
@@ -715,7 +730,11 @@ class TestPprof:
         deadline = time.time() + 10
         while p._samples < 10 and time.time() < deadline:
             time.sleep(0.02)
-        rep = p.stop(top=10)
+        # top=50, not 10: the sampler records EVERY thread each tick, and
+        # blocked daemon threads accumulated across the suite all sample
+        # at one stable frame apiece — enough of them crowd a hot but
+        # frame-alternating burn loop out of a top-10 (full-suite flake).
+        rep = p.stop(top=50)
         stop.set()
         t.join()
         assert rep["samples"] >= 10

@@ -41,7 +41,7 @@ METRIC_SUFFIXES = (
     "_total", "_seconds", "_bytes", "_pending", "_done",
     "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
     "_shards", "_evictions", "_rederives", "_state",
-    "_occupancy", "_queries", "_ops",
+    "_occupancy", "_queries", "_ops", "_entries",
 )
 
 _CALL_RE = re.compile(
