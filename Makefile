@@ -1,0 +1,27 @@
+# Developer / CI entry points (ISSUE r13 satellite): lint cleanliness
+# must not depend on anyone remembering to run it.
+
+PY ?= python
+
+.PHONY: lint lint-changed check fast-tests test
+
+lint:                    ## whole-tree pilint (the CI gate)
+	$(PY) -m tools.lint
+
+lint-changed:            ## pre-commit fast path: only files changed vs git HEAD
+	$(PY) -m tools.lint --changed
+
+# The fast tier-1 subset `make check` runs on every push: the lint gate
+# plus the suites pinning the lint framework itself, the config
+# round-trip, the wire/PQL/roaring protocol contracts, and the serving
+# front door — minutes, not the full tier-1 hour.
+FAST_TESTS = tests/test_lint.py tests/test_config.py tests/test_pql.py \
+             tests/test_roaring.py tests/test_server.py
+
+check: lint fast-tests   ## lint + fast tier-1 subset (what CI runs)
+
+fast-tests:              ## the fast subset alone (CI runs lint as its own step)
+	$(PY) -m pytest -q $(FAST_TESTS)
+
+test:                    ## full tier-1
+	$(PY) -m pytest -q

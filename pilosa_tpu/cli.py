@@ -29,7 +29,16 @@ def cmd_server(args) -> int:
             "verbose": args.verbose or None,
         },
     )
-    log = StandardLogger(verbose=cfg.verbose)
+    # log-path: append server logs to a file instead of stderr
+    # (reference config.go LogPath; the config-drift rule caught the
+    # knob parsed but never consumed). Line-buffered so a crash loses
+    # at most one line.
+    log_stream = (
+        open(os.path.expanduser(cfg.log_path), "a", buffering=1)
+        if cfg.log_path
+        else None
+    )
+    log = StandardLogger(stream=log_stream, verbose=cfg.verbose)
     data_dir = os.path.expanduser(cfg.data_dir)
     holder = Holder(data_dir).open()
 
@@ -98,6 +107,10 @@ def cmd_server(args) -> int:
     # caps (deliberate 429/503 import shedding — never OOM).
     api.max_import_bytes = cfg.max_import_bytes
     api.max_pending_wal = cfg.max_pending_wal
+    # Per-request write-call cap + metric exposition switch (both knobs
+    # existed since the seed but nothing consumed them — config-drift).
+    api.max_writes_per_request = cfg.max_writes_per_request
+    api.metric_service = cfg.metric_service
 
     # TLS (reference server/tlsconfig.go): certificate+key serve HTTPS;
     # peers are dialed with a CA-verified (or skip-verify) context. A

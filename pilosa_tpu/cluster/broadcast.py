@@ -138,8 +138,11 @@ class HTTPBroadcaster:
         # to them go straight to JSON (every receiver, old or new, parses
         # JSON — receive sniffs the frame). Cleared on membership change
         # (cluster.receive_message MSG_CLUSTER_STATUS) so a replaced or
-        # upgraded-in-place node re-negotiates.
+        # upgraded-in-place node re-negotiates. Guarded by _wire_lock:
+        # the fan-out send threads read/pin concurrently with the
+        # message handler's membership-change clear (shared-state rule).
         self._json_peers: set[str] = set()
+        self._wire_lock = threading.Lock()
 
     def _peers(self):
         local_id = self.cluster.local_node.id
@@ -148,7 +151,8 @@ class HTTPBroadcaster:
     def reset_wire_negotiation(self) -> None:
         """Forget per-peer wire pins (called by the cluster on membership
         change: a replaced or upgraded-in-place node may speak binary)."""
-        self._json_peers.clear()
+        with self._wire_lock:
+            self._json_peers.clear()
 
     @staticmethod
     def _is_parse_failure(e) -> bool:
@@ -180,32 +184,45 @@ class HTTPBroadcaster:
         from pilosa_tpu.cluster.client import ClientError
         from pilosa_tpu.cluster.private_wire import JSONSerializer
 
+        from pilosa_tpu.utils.deadline import Deadline, deadline_scope
+
         node_id = getattr(node, "id", None)
         if payload is None:
             payload = msg.to_bytes()
         json_payload = None  # marshalled only on the fallback paths
-        if node_id in self._json_peers:
+        with self._wire_lock:
+            pinned_json = node_id in self._json_peers
+        if pinned_json:
             json_payload = JSONSerializer().marshal(msg)
             if json_payload == payload:
                 json_payload = None  # already JSON: nothing to negotiate
             else:
                 payload = json_payload
-        try:
-            self.client.send_message(node, payload)
-            return
-        except ClientError as e:
-            if not self._is_parse_failure(e):
-                raise
-            if json_payload is None:
-                json_payload = JSONSerializer().marshal(msg)
-            if json_payload == payload:
-                raise  # frame WAS JSON; nothing better to offer
-        from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
+        # Budget per frame (deadline-scope rule): one delivery is at
+        # most two wire attempts (default + JSON renegotiation), so 2x
+        # the client timeout bounds the frame without squeezing the
+        # fallback when the first attempt burned a full socket timeout.
+        # An outer (tighter) request deadline still wins — scopes nest.
+        # getattr: test doubles stand in for the client without a
+        # timeout attribute.
+        with deadline_scope(Deadline(getattr(self.client, "timeout", 30.0) * 2)):
+            try:
+                self.client.send_message(node, payload)
+                return
+            except ClientError as e:
+                if not self._is_parse_failure(e):
+                    raise
+                if json_payload is None:
+                    json_payload = JSONSerializer().marshal(msg)
+                if json_payload == payload:
+                    raise  # frame WAS JSON; nothing better to offer
+            from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
 
-        count_rpc_retry(peer_label(node), "send_message")
-        self.client.send_message(node, json_payload)
+            count_rpc_retry(peer_label(node), "send_message")
+            self.client.send_message(node, json_payload)
         if node_id is not None:
-            self._json_peers.add(node_id)
+            with self._wire_lock:
+                self._json_peers.add(node_id)
 
     def send_sync(self, msg: Message) -> None:
         peers = self._peers()

@@ -105,6 +105,12 @@ class Cluster:
         # disable the NotFound repair the moment one bad-field query came
         # through); cleared on membership change or successful repair.
         self._repair_attempted: dict[tuple[str, str], float] = {}
+        # Guards the throttle's check-then-arm: scatter-gather worker
+        # threads race each other (and the membership-change clear) on
+        # the same (node, index) key, and an unguarded get-then-set
+        # would let N concurrent queries all start repair pushes
+        # (shared-state rule).
+        self._repair_lock = threading.Lock()
         self.repair_retry_interval: float = 30.0
         # Hedged shard reads (ISSUE r9 tentpole 3): a remote leg that
         # hasn't answered after this many seconds is re-launched at the
@@ -615,14 +621,19 @@ class Cluster:
             # unrelated error merely containing 'not found' can no longer
             # trigger a repair storm.
             repair_key = (node.id, index)
-            last = self._repair_attempted.get(repair_key)
-            throttled = (
-                last is not None
-                and time.monotonic() - last < self.repair_retry_interval
-            )
-            if getattr(e, "code", "") != "not-found" or throttled:
+            if getattr(e, "code", "") != "not-found":
                 raise
-            self._repair_attempted[repair_key] = time.monotonic()
+            with self._repair_lock:
+                last = self._repair_attempted.get(repair_key)
+                throttled = (
+                    last is not None
+                    and time.monotonic() - last < self.repair_retry_interval
+                )
+                if throttled:
+                    raise
+                # Armed inside the lock: concurrent legs hitting the
+                # same missing schema run ONE repair, not one each.
+                self._repair_attempted[repair_key] = time.monotonic()
             self._push_state_to(node, index)
             from pilosa_tpu.cluster.client import count_rpc_retry, peer_label
 
@@ -635,7 +646,8 @@ class Cluster:
             # now repaired. Forget the attempt so a FUTURE missed DDL on
             # the same index can be repaired too; only the
             # genuinely-nonexistent-field case stays throttled.
-            self._repair_attempted.pop(repair_key, None)
+            with self._repair_lock:
+                self._repair_attempted.pop(repair_key, None)
         results = out.get("results", [])
         raw = results[0] if results else None
         return decode_result(c, raw)
@@ -905,13 +917,15 @@ class Cluster:
         elif typ == bc.MSG_CLUSTER_STATUS:
             self.set_state(msg.get("state", self.state()))
             if "replicaN" in msg:
+                # lint: allow-shared-state(membership swap: each store is a GIL-atomic publish and readers tolerate one stale view until the next CLUSTER_STATUS frame)
                 self.topology.replica_n = int(msg["replicaN"])
             if "nodes" in msg:
                 new_nodes = sorted(
                     (Node.from_json(d) for d in msg["nodes"]), key=lambda n: n.id
                 )
                 self.topology.nodes = new_nodes
-                self._repair_attempted.clear()
+                with self._repair_lock:
+                    self._repair_attempted.clear()
                 # Membership changed: re-negotiate control-plane wire
                 # format per peer (a replaced node may speak binary now).
                 self.broadcaster.reset_wire_negotiation()
@@ -919,6 +933,7 @@ class Cluster:
                 # have just become or stopped being a member/coordinator).
                 mine = next((n for n in new_nodes if n.id == self.local_node.id), None)
                 if mine is not None:
+                    # lint: allow-shared-state(identity swap: atomic publish of the replacement Node object; readers key off the stable node id)
                     self.local_node = mine
                 # Membership is durable state: persist so a restart
                 # rejoins with the same identity (ISSUE r9 tentpole 3).

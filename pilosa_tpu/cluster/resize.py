@@ -460,11 +460,12 @@ class Resizer:
                 self.cluster.persist_topology()
                 return
             job = self._observed_job
+            epoch = self._epoch  # captured under the lock for the log
         self.cluster.persist_topology()
         global_stats.count("resize_jobs_adopted_total")
         self.log.printf(
             "resize: promoted mid-job; adopting orphaned job %s "
-            "(new epoch %d) and aborting it", job, self._epoch,
+            "(new epoch %d) and aborting it", job, epoch,
         )
         self.abort()
 
@@ -480,11 +481,12 @@ class Resizer:
             if self._new_nodes is not None:
                 return  # our live job: heartbeats already cover the peer
             self._epoch = max(self._epoch, int(info.get("epoch") or 0) + 1)
+            epoch = self._epoch  # captured under the lock for the log
         self.cluster.persist_topology()
         global_stats.count("resize_jobs_adopted_total")
         self.log.printf(
             "resize: follower reports orphaned job %s; aborting it "
-            "(epoch now %d)", info.get("job"), self._epoch,
+            "(epoch now %d)", info.get("job"), epoch,
         )
         self.abort()
 
@@ -934,6 +936,7 @@ class Resizer:
             self._needs_clean = False
             # Any in-flight migration workers are fetching for the job
             # being aborted: stop them (see _lease_expired).
+            # lint: allow-shared-state(deliberately lockless cancel flag: workers poll it WITHOUT the resizer lock because the coordinator's inline follow runs under it and joining on it deadlocked, see PR 9)
             self._follow_cancel_gen = self._follow_gen
             if self._timer is not None:
                 self._timer.cancel()

@@ -407,10 +407,20 @@ class SyncDaemon:
         # together would otherwise run synchronized cluster-wide checksum
         # storms at every interval, forever — the phases decorrelate
         # within a few cycles instead.
+        from pilosa_tpu.utils.deadline import Deadline, deadline_scope
+
         while not self._stop.wait(self.interval * (0.75 + 0.5 * random.random())):
             try:
-                n = self.syncer.sync_holder()
-                self.syncer._sync_translation()
+                # Budget the whole pass (deadline-scope rule): every
+                # peer RPC below bounds its socket timeout by the
+                # remainder and rides X-Pilosa-Deadline, so a stalled
+                # peer can pin the syncer for at most one pass — the
+                # next jittered cycle starts from a clean budget. The
+                # 60 s floor keeps test-sized intervals from starving
+                # an honest pass.
+                with deadline_scope(Deadline(max(self.interval, 60.0))):
+                    n = self.syncer.sync_holder()
+                    self.syncer._sync_translation()
                 if n:
                     self.log.printf("anti-entropy: repaired %d blocks", n)
             except Exception as e:
@@ -436,6 +446,11 @@ class FailureDetector:
         self.confirm_down = confirm_down
         self.log = logger or NopLogger()
         self._fails: dict[str, int] = {}
+        # Guards the confirm counters: the probe loop's increments race
+        # the message handler's vote_down RMWs on the same key
+        # (shared-state rule), and a lost increment delays a legitimate
+        # DOWN confirmation by a whole probe sweep.
+        self._fails_lock = threading.Lock()
         # (peer id, subject id) -> last state that peer reported for the
         # subject. Peer-view DOWN observations vote only on the
         # TRANSITION to DOWN (SWIM-style), not on every repeated stale
@@ -453,10 +468,11 @@ class FailureDetector:
         code review r5). No vote at all while our probes succeed.
         Returns True when the accumulated evidence reaches
         confirm_down (the caller then applies the DOWN)."""
-        if self._fails.get(node_id, 0) <= 0:
-            return False
-        self._fails[node_id] += 1
-        return self._fails[node_id] >= self.confirm_down
+        with self._fails_lock:
+            if self._fails.get(node_id, 0) <= 0:
+                return False
+            self._fails[node_id] += 1
+            return self._fails[node_id] >= self.confirm_down
 
     def probe_once(self) -> None:
         topo = self.cluster.topology
@@ -471,7 +487,8 @@ class FailureDetector:
                 st = None
                 ok = False
             if ok:
-                self._fails[node.id] = 0
+                with self._fails_lock:
+                    self._fails[node.id] = 0
                 if node.state == NODE_STATE_DOWN:
                     node.state = NODE_STATE_READY
                     self.log.printf("node %s is back up", node.id)
@@ -483,11 +500,10 @@ class FailureDetector:
                 global_stats.with_tags(f"peer:{node.id}").count(
                     "cluster_probe_failures_total"
                 )
-                self._fails[node.id] = self._fails.get(node.id, 0) + 1
-                if (
-                    self._fails[node.id] >= self.confirm_down
-                    and node.state != NODE_STATE_DOWN
-                ):
+                with self._fails_lock:
+                    self._fails[node.id] = self._fails.get(node.id, 0) + 1
+                    confirmed = self._fails[node.id] >= self.confirm_down
+                if confirmed and node.state != NODE_STATE_DOWN:
                     node.state = NODE_STATE_DOWN
                     self.log.printf("node %s marked down", node.id)
                     _count_transition(node.id, NODE_STATE_DOWN)
@@ -539,18 +555,18 @@ class FailureDetector:
             if (
                 state == NODE_STATE_DOWN
                 and prev != NODE_STATE_DOWN  # transition, not a stale echo
-                and self._fails.get(nid, 0) > 0  # we are failing it too
                 and target.state != NODE_STATE_DOWN
+                # vote_down is the one locked counter path: "we are
+                # failing it too" + increment + confirm, atomically.
+                and self.vote_down(nid)
             ):
-                self._fails[nid] = self._fails.get(nid, 0) + 1
-                if self._fails[nid] >= self.confirm_down:
-                    target.state = NODE_STATE_DOWN
-                    self.log.printf(
-                        "node %s marked down (peer %s's observation)",
-                        nid, peer.id,
-                    )
-                    _count_transition(nid, NODE_STATE_DOWN)
-                    self._disseminate(nid, NODE_STATE_DOWN)
+                target.state = NODE_STATE_DOWN
+                self.log.printf(
+                    "node %s marked down (peer %s's observation)",
+                    nid, peer.id,
+                )
+                _count_transition(nid, NODE_STATE_DOWN)
+                self._disseminate(nid, NODE_STATE_DOWN)
         peer_coord = next(
             (nd.get("id") for nd in st.get("nodes", []) if nd.get("isCoordinator")),
             None,

@@ -71,6 +71,7 @@ class RankCache:
 
     def bulk_add(self, row_id: int, count: int) -> None:
         if count:
+            # lint: allow-shared-state(RankCache is confined to its owning Fragment: every mutating path holds Fragment.lock and TopN readers snapshot through top)
             self.entries[row_id] = count
         else:
             self.entries.pop(row_id, None)
@@ -87,6 +88,7 @@ class RankCache:
     def _recalculate(self) -> None:
         top = heapq.nlargest(self.max_entries, self.entries.items(), key=lambda kv: kv[1])
         self.entries = dict(top)
+        # lint: allow-shared-state(fragment-confined like entries above: recalculation always runs under the owning Fragment.lock)
         self.threshold_value = min((c for _, c in top), default=0)
 
     def invalidate(self) -> None:

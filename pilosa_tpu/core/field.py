@@ -233,13 +233,13 @@ class Field:
         if self.path is None:
             return
         p = os.path.join(self.path, ".available.shards")
-        # Per-thread tmp name: two import threads landing NEW shards
-        # concurrently both enter here, and with one shared ".tmp" the
-        # loser's os.replace finds its source already renamed away
-        # (ENOENT -> a 500 mid-import; BENCH_r10's first ingest run).
-        # Unique names keep every replace atomic and sourced; a stale
-        # last-writer-wins image self-heals at open(), which unions the
-        # persisted bitmap with the fragment directory scan.
+        # Every caller now holds the field RLock (ISSUE r13 shared-state
+        # fix), which is what prevents the concurrent-savers ENOENT
+        # race the per-thread tmp name was first added for (BENCH_r10's
+        # first ingest run). The unique name stays anyway: open()'s
+        # crash-orphan sweep matches the ".tmp.<tid>" pattern, and a
+        # belt under the lock costs nothing if a lock-free caller ever
+        # reappears.
         tmp = p + f".tmp.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(serialize(self._available_shards))
@@ -269,19 +269,27 @@ class Field:
             v = self.views.get(name)
             if v is None:
                 v = self._new_view(name).open()
+                # lint: allow-shared-state(writes serialized under field.lock; the lock-free view getter is one GIL-atomic dict read and a pre-insert miss just routes back through this create path)
                 self.views[name] = v
                 self._bump_structure()
             return v
 
     def add_available_shard(self, shard: int) -> None:
-        if self._available_shards.add(shard, log=False):
-            self._bump_structure()
-            self._save_available_shards()
+        # Under the field RLock: concurrent import threads land distinct
+        # shards into one shared Bitmap, and its container dict +
+        # keys-generation bookkeeping are read-modify-write (the
+        # shared-state rule; the PR 10 per-thread tmp names fixed the
+        # SAVE race, this serializes the mutation itself).
+        with self.lock:
+            if self._available_shards.add(shard, log=False):
+                self._bump_structure()
+                self._save_available_shards()
 
     def remove_available_shard(self, shard: int) -> None:
-        if self._available_shards.remove(shard, log=False):
-            self._bump_structure()
-            self._save_available_shards()
+        with self.lock:
+            if self._available_shards.remove(shard, log=False):
+                self._bump_structure()
+                self._save_available_shards()
 
     def available_shards(self) -> Bitmap:
         with self.lock:
@@ -302,9 +310,10 @@ class Field:
 
     def merge_remote_available_shards(self, other: Bitmap) -> None:
         """reference field.go AddRemoteAvailableShards :274."""
-        self._available_shards.union_in_place(other)
-        self._bump_structure()
-        self._save_available_shards()
+        with self.lock:
+            self._available_shards.union_in_place(other)
+            self._bump_structure()
+            self._save_available_shards()
 
     # -- type helpers -----------------------------------------------------
 

@@ -529,6 +529,7 @@ class Fragment:
                 # snapshot); memory-only fragments have no file to
                 # rewrite.
                 self.storage.optimize()
+                # lint: allow-shared-state(every storage mutation holds Fragment.lock; lock-free readers pin the reference once and read per the PR 8 snapshot contract)
                 self.storage.op_n = 0
                 self._report_backlog()
                 return

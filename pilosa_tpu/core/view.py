@@ -187,6 +187,7 @@ class View:
             frag = self.fragments.get(shard)
             if frag is None:
                 frag = self._new_fragment(shard).open()
+                # lint: allow-shared-state(writes serialized under the view lock; the lock-free fragment getter is one GIL-atomic dict read and a pre-insert miss routes back through this create path)
                 self.fragments[shard] = frag
                 created = True
                 self._bump_data()

@@ -101,6 +101,7 @@ def _load() -> ctypes.CDLL | None:
                     ctypes.c_void_p,
                     ctypes.c_void_p,
                 ]
+                # lint: allow-shared-state(double-checked lazy init: the build is serialized by _build_lock and unlocked readers observe either None or the fully-initialized lib)
                 _lib = lib
                 return _lib
             # lint: allow-except-exception(toolchain probe: loop retries a forced rebuild, then the fallback warns and pure-Python continues)

@@ -714,7 +714,9 @@ class Bitmap:
             # a single GIL-atomic C snapshot for int keys (no Python
             # callbacks), so the sort itself cannot tear.
             g = self._keys_gen
+            # lint: allow-shared-state(documented lock-free rebuild: the generation check above keeps a torn snapshot marked stale so the next reader re-sorts)
             self._keys = sorted(self._cs)
+            # lint: allow-shared-state(publish ordered after the rebuild under program order; a racing writer bumps _keys_gen past g and the cache stays stale)
             self._keys_built = g
         return self._keys
 
@@ -726,7 +728,9 @@ class Bitmap:
             c.validate(key)
         if c.n == 0:
             if key in self._cs:
+                # lint: allow-shared-state(a Bitmap is confined to its owning Fragment: every mutating path holds Fragment.lock; lock-free query readers follow the snapshot contract)
                 del self._cs[key]
+                # lint: allow-shared-state(generation RMW runs under the owning Fragment.lock with the mutation it stamps; unlocked keys readers only ever observe staleness)
                 self._keys_gen += 1
             return
         is_new = key not in self._cs
@@ -800,6 +804,7 @@ class Bitmap:
             # opN counts mutated values like the reference's op.count()
             # (roaring.go:1620), so it matches what a WAL replay computes.
             self.op_writer.append_add_batch(vs)
+            # lint: allow-shared-state(op_n RMW is fragment-confined: every WAL-logged write path holds the owning Fragment.lock)
             self.op_n += int(vs.size)
         return changed
 

@@ -22,6 +22,7 @@ from pilosa_tpu.core.timequantum import parse_time
 from pilosa_tpu.exec import ExecOptions, Executor
 from pilosa_tpu.exec.cpu import QueryError
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.deadline import Deadline, deadline_scope
 
 
 class APIError(Exception):
@@ -87,6 +88,14 @@ class API:
         self.max_pending_wal = 0
         self._import_lock = threading.Lock()
         self._import_inflight_bytes = 0
+        # Per-/query write-call cap (reference MaxWritesPerRequest,
+        # config max-writes-per-request; cli.py wires it). 0 = no cap so
+        # directly-constructed test APIs stay unbounded.
+        self.max_writes_per_request = 0
+        # `[metric] service` knob: "none" disables the /metrics
+        # exposition endpoint (the in-process registry still accrues —
+        # it feeds /debug/vars and the SLO plane).
+        self.metric_service = "memory"
 
     # -- import admission (wired by server/http.py around /import) ---------
 
@@ -192,6 +201,33 @@ class API:
         protobuf response encoders)."""
         self._validate_state("Query")
         from pilosa_tpu.pql import ParseError
+
+        if self.max_writes_per_request > 0:
+            # reference api.go MaxWritesPerRequest: bound the write calls
+            # one /query body may carry (Query.write_call_n existed for
+            # this; the config-drift rule caught the knob parsed but
+            # never enforced). Parse HERE, under the same profile phase
+            # the executor would use, and hand the tree down — the
+            # executor accepts pre-parsed queries, so a multi-kilobyte
+            # write batch (too big for the parse cache) is still parsed
+            # exactly once (code review r13).
+            from pilosa_tpu.pql.parser import parse_string
+            from pilosa_tpu.utils.qprofile import current_profile
+
+            try:
+                with current_profile().phase("parse"):
+                    parsed = parse_string(query)
+            except ParseError as e:
+                raise APIError(str(e)) from e
+            writes = parsed.write_call_n()
+            if writes > self.max_writes_per_request:
+                raise APIError(
+                    f"query contains {writes} write calls, over the "
+                    f"max-writes-per-request cap "
+                    f"({self.max_writes_per_request})",
+                    status=400, code="too-many-writes",
+                )
+            query = parsed
 
         opt = ExecOptions(
             remote=remote,
@@ -637,6 +673,17 @@ class API:
             raise APIError("cluster resize is not enabled", status=400)
         return self.cluster.resizer
 
+    def _forward_to_coordinator(self, path: str, body: dict) -> dict:
+        """Non-coordinator resize endpoints forward to the coordinator
+        under one client-timeout deadline (deadline-scope rule): the
+        serving thread must not pin on a hung coordinator past one
+        budget, and the remaining budget rides X-Pilosa-Deadline."""
+        coord = self.cluster.coordinator()
+        with deadline_scope(Deadline(self.cluster.client.timeout)):
+            return self.cluster.client._do(
+                "POST", coord, path, json.dumps(body).encode()
+            )
+
     def resize_add_node(self, body: dict) -> dict:
         """POST /cluster/resize/add-node {id?, uri}. Non-coordinators
         forward to the coordinator (reference routes joins there)."""
@@ -645,9 +692,8 @@ class API:
 
         rz = self._resizer()
         if not self.cluster.is_coordinator():
-            coord = self.cluster.coordinator()
-            return self.cluster.client._do(
-                "POST", coord, "/cluster/resize/add-node", json.dumps(body).encode()
+            return self._forward_to_coordinator(
+                "/cluster/resize/add-node", body
             )
         uri = URI.parse(body.get("uri", ""))
         node_id = body.get("id") or f"node-{uri.host}-{uri.port}"
@@ -662,10 +708,8 @@ class API:
 
         rz = self._resizer()
         if not self.cluster.is_coordinator():
-            coord = self.cluster.coordinator()
-            return self.cluster.client._do(
-                "POST", coord, "/cluster/resize/remove-node",
-                json.dumps({"id": node_id}).encode(),
+            return self._forward_to_coordinator(
+                "/cluster/resize/remove-node", {"id": node_id}
             )
         try:
             job = rz.remove_node(node_id)
@@ -677,8 +721,7 @@ class API:
         self._validate_state("ResizeAbort")
         rz = self._resizer()
         if not self.cluster.is_coordinator():
-            coord = self.cluster.coordinator()
-            self.cluster.client._do("POST", coord, "/cluster/resize/abort", b"{}")
+            self._forward_to_coordinator("/cluster/resize/abort", {})
             return
         rz.abort()
 
@@ -789,9 +832,14 @@ class API:
             last_err = None
             for owner in owners:  # every live replica before giving up
                 try:
-                    got = self.cluster.client.export_csv_shard(
-                        owner, index, field, s
-                    )
+                    # Per-attempt budget (deadline-scope rule): the
+                    # remote leg rides X-Pilosa-Deadline so a replica
+                    # that stalls mid-export is abandoned after one
+                    # client timeout and the next replica is tried.
+                    with deadline_scope(Deadline(self.cluster.client.timeout)):
+                        got = self.cluster.client.export_csv_shard(
+                            owner, index, field, s
+                        )
                     break
                 except ClientError as e:
                     last_err = e

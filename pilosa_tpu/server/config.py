@@ -76,7 +76,6 @@ class Config:
     tls: TLSConfig = field(default_factory=TLSConfig)
     anti_entropy_interval: float = 600.0  # seconds (reference: 10m)
     metric_service: str = "memory"  # memory | none
-    tracing: bool = False
     long_query_time: float = 0.0
     # Optional fixed Count-coalescing sleep in seconds (exec/batcher.py).
     # 0 (default) = backpressure batching: an uncontended single Count
@@ -251,6 +250,7 @@ class Config:
                 "skip-verify": self.tls.skip_verify,
             },
             "long-query-time": self.long_query_time,
+            "client-timeout": self.client_timeout,
             "batch-window": self.batch_window,
             "preheat": self.preheat,
             "max-inflight": self.max_inflight,
@@ -349,6 +349,10 @@ class Config:
             pre + "BIND": ("bind", str),
             pre + "EXECUTOR": ("executor", str),
             pre + "VERBOSE": ("verbose", lambda v: v.lower() in ("1", "true")),
+            pre + "LOG_PATH": ("log_path", str),
+            pre + "MAX_WRITES_PER_REQUEST": ("max_writes_per_request", int),
+            pre + "LONG_QUERY_TIME": ("long_query_time", float),
+            pre + "METRIC_SERVICE": ("metric_service", str),
             pre + "CLUSTER_COORDINATOR": (
                 "cluster.coordinator",
                 lambda v: v.lower() in ("1", "true"),
@@ -407,6 +411,7 @@ class Config:
             f'bind = "{c.bind}"\n'
             f'executor = "{c.executor}"\n'
             f"max-writes-per-request = {c.max_writes_per_request}\n"
+            f'log-path = "{c.log_path}"\n'
             f"verbose = {str(c.verbose).lower()}\n"
             f"long-query-time = {c.long_query_time}\n"
             f"batch-window = {c.batch_window}\n"

@@ -52,6 +52,43 @@ class _HTTPServer(ThreadingHTTPServer):
 
     request_queue_size = 128
 
+    def __init__(self, *args, **kwargs):
+        # Request-finalization barrier (ISSUE r13 satellite): the reply
+        # bytes reach a same-process client one GIL slice BEFORE the
+        # handler thread finishes its post-reply work (end_query,
+        # profile-ring insert, span finish). Tests that read that state
+        # right after a response used to poll for it; quiesce() waits
+        # for it deterministically. _active counts requests from
+        # dispatch entry to the END of all finalization.
+        self._active_cv = threading.Condition()
+        self._active = 0
+        super().__init__(*args, **kwargs)
+
+    def _request_begin(self) -> None:
+        with self._active_cv:
+            self._active += 1
+
+    def _request_end(self) -> None:
+        with self._active_cv:
+            self._active -= 1
+            if self._active <= 0:
+                self._active_cv.notify_all()
+
+    def quiesce(self, timeout: float = 5.0) -> bool:
+        """Block until every request that has entered dispatch is fully
+        finalized (reply sent AND post-reply bookkeeping done). True on
+        drained, False on timeout. New requests arriving while waiting
+        extend the wait — call from a client that has stopped sending."""
+        deadline = time.monotonic() + timeout
+        with self._active_cv:
+            while self._active > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                # lint: allow-lock-discipline(canonical Condition.wait: it RELEASES the condition lock while blocked, handlers never stall on it)
+                self._active_cv.wait(remaining)
+        return True
+
     def handle_error(self, request, client_address):
         """A client that vanishes mid-exchange can surface OUTSIDE the
         route dispatcher's abort trap (e.g. send_error during request
@@ -243,6 +280,17 @@ class Server:
             self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+    def quiesce(self, timeout: float = 5.0) -> bool:
+        """Wait until every in-flight request is FULLY finalized —
+        reply sent and post-reply bookkeeping (end_query, profile-ring
+        insert, span finish) done. The test-visible barrier for the
+        'server finalizes one GIL slice after the client has the reply
+        bytes' race class (ISSUE r13 satellite; PR 10 fixed four tests
+        with ad-hoc poll loops instead)."""
+        if self._httpd is None:
+            return True
+        return self._httpd.quiesce(timeout)
 
     @property
     def scheme(self) -> str:
@@ -588,6 +636,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(body, status=status, headers=headers)
 
     def _dispatch(self, method: str) -> None:
+        # Finalization barrier bracket: entered before any reply byte
+        # can be written, left only after ALL post-reply bookkeeping
+        # (the finally blocks below included) — Server.quiesce() waits
+        # on this.
+        begin = getattr(self.server, "_request_begin", None)
+        if begin is not None:
+            begin()
+        try:
+            self._dispatch_inner(method)
+        finally:
+            end = getattr(self.server, "_request_end", None)
+            if end is not None:
+                end()
+
+    def _dispatch_inner(self, method: str) -> None:
         parsed = urlparse(self.path)
         path = parsed.path
         self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
@@ -1048,6 +1111,15 @@ class _Handler(BaseHTTPRequestHandler):
     def handle_metrics(self):
         from pilosa_tpu.utils.stats import global_stats
 
+        if getattr(self.api, "metric_service", "memory") == "none":
+            # `[metric] service = "none"`: no exposition endpoint. The
+            # registry still accrues in-process (it feeds /debug/vars
+            # and the SLO evaluator) — this only closes the scrape
+            # surface (config-drift rule: the knob parsed but nothing
+            # consumed it).
+            self._error("metrics disabled by [metric] service config",
+                        status=404, code="metrics-disabled")
+            return
         self._refresh_device_gauges()
         self._exposition_reply(global_stats.prometheus_text())
 

@@ -39,6 +39,7 @@ class Span:
         self.duration = None
 
     def set_tag(self, k, v) -> "Span":
+        # lint: allow-shared-state(a Span is confined to the thread that opened it until finish; scatter-gather legs tag their own per-leg child spans)
         self.tags[k] = v
         return self
 

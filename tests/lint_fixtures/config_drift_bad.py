@@ -1,0 +1,32 @@
+"""Known-bad fixture for the config-drift rule (never lint-gated).
+
+A miniature server/config.py shape: `wired` round-trips every surface,
+`broken` is parseable from TOML but misses the env var, to_dict,
+toml_text, cli wiring and the docs row — one finding per missing
+surface. tests/test_lint.py feeds this text through
+config_drift_findings() with a stub cli/doc.
+"""
+
+
+class Config:
+    wired: int = 0
+    broken: str = ""
+
+    def _apply_toml(self, data):
+        simple = {"wired": "wired", "broken": "broken"}
+        for key, attr in simple.items():
+            if key in data:
+                setattr(self, attr, data[key])
+
+    def _apply_env(self, env):
+        mapping = {"PILOSA_TPU_WIRED": ("wired", int)}
+        for key, (attr, conv) in mapping.items():
+            if key in env:
+                setattr(self, attr, conv(env[key]))
+
+    def to_dict(self):
+        return {"wired": self.wired}
+
+    def toml_text(self):
+        c = self
+        return f"wired = {c.wired}\n"

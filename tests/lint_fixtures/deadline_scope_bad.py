@@ -1,0 +1,46 @@
+"""Known-bad fixture for the deadline-scope rule (never lint-gated).
+
+A daemon thread root reaches an InternalClient method two ways: one
+call is wrapped in `with deadline_scope(...)` (compliant), the other is
+bare (the finding the rule must fire on).
+"""
+
+import threading
+
+
+class Deadline:
+    def __init__(self, seconds):
+        self.seconds = seconds
+
+
+class deadline_scope:
+    def __init__(self, deadline):
+        self.deadline = deadline
+
+    def __enter__(self):
+        return self.deadline
+
+    def __exit__(self, *exc):
+        return False
+
+
+class InternalClient:
+    def _do(self, method, uri, path):
+        return {}
+
+    def status(self, uri):
+        return self._do("GET", uri, "/status")
+
+
+class Prober:
+    def __init__(self, client):
+        self.client = client
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.client.status("peer:1")  # BAD: no deadline scope on the path
+        self._covered()
+
+    def _covered(self):
+        with deadline_scope(Deadline(1.0)):
+            return self.client.status("peer:1")  # OK: budgeted

@@ -1,0 +1,58 @@
+"""Known-bad fixture for the shared-state rule (never lint-gated).
+
+Two real races the rule must fire on:
+- `Daemon.counter`: an unlocked `+=` reached from both the daemon
+  thread root (_run) and the http-request root (do_GET -> bump).
+- module global `_hits`: an unlocked RMW from the same two roots.
+
+Two blessed patterns it must NOT fire on:
+- `Daemon.published`: assigned once in start() BEFORE the thread
+  starts (setup code no root reaches) and only read afterwards.
+- `Daemon.guarded`: every access path holds self._lock.
+"""
+
+import threading
+
+_hits = 0
+
+
+def count_hit():
+    global _hits
+    _hits = _hits + 1  # BAD: two-root RMW on a module global
+
+
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+        self.guarded = 0
+        self.published = ()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        # Blessed: assign-once before thread start (publication).
+        self.published = ("a", "b")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.counter += 1  # BAD: unlocked RMW, also written by bump()
+            count_hit()
+            with self._lock:
+                self.guarded += 1  # OK: same lock on every access path
+            for item in self.published:  # OK: immutable publish
+                str(item)
+
+    def bump(self):
+        self.counter += 1
+        count_hit()
+        with self._lock:
+            self.guarded += 1
+
+
+_DAEMON = Daemon()
+
+
+class Handler:
+    def do_GET(self):
+        _DAEMON.bump()

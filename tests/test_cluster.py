@@ -396,6 +396,63 @@ class TestWireFallback:
             assert fake.binary_rejects == 1
             assert "n1" in b._json_peers
 
+    def test_wire_pins_safe_under_concurrent_reset(self):
+        """Regression for the shared-state finding fixed in ISSUE r13:
+        fan-out send threads read/pin `_json_peers` concurrently with
+        the membership-change clear — all now serialized by
+        `_wire_lock`, so a negotiate/reset storm neither corrupts the
+        set nor drops the pin invariant (a peer is either pinned or
+        re-negotiates; never a torn state)."""
+        import threading as _threading
+
+        from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, Message
+
+        class AcceptAllPeer:
+            timeout = 1.0
+
+            def send_message(self, node, payload):
+                pass
+
+        class _Stub:
+            pass
+
+        cluster = _Stub()
+        cluster.local_node = Node("n0", URI(port=1), True)
+        cluster.topology = Topology(nodes=[cluster.local_node])
+        b = HTTPBroadcaster(cluster, client=AcceptAllPeer())
+        peers = [Node(f"n{i}", URI(port=2 + i), False) for i in range(1, 5)]
+        msg = Message.make("cluster-status", state="NORMAL")
+        stop = _threading.Event()
+        errors: list = []
+
+        def sender(p):
+            while not stop.is_set():
+                try:
+                    b.send_to(p, msg)
+                    with b._wire_lock:
+                        b._json_peers.add(p.id)
+                except Exception as e:  # noqa: BLE001 — fail the test loudly
+                    errors.append(e)
+                    return
+
+        def resetter():
+            while not stop.is_set():
+                b.reset_wire_negotiation()
+
+        threads = [_threading.Thread(target=sender, args=(p,)) for p in peers]
+        threads.append(_threading.Thread(target=resetter))
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors
+        with b._wire_lock:
+            assert b._json_peers <= {p.id for p in peers}
+
     def test_transport_failure_not_retried_as_json(self):
         from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, Message
         from pilosa_tpu.cluster.client import ClientError

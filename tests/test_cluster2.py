@@ -294,6 +294,36 @@ class TestFailureDetection:
             out = c.query(0, "i", "Count(Row(f=1))")
             assert out["results"][0] == 2
 
+    def test_vote_down_counters_lose_no_increments_under_contention(self):
+        """Regression for the shared-state finding fixed in ISSUE r13:
+        the probe loop's increments race the message handler's
+        vote_down RMWs on the same key; `_fails_lock` now serializes
+        them, so N concurrent votes land as exactly N increments (a
+        lost one used to delay a legitimate DOWN by a probe sweep)."""
+        import threading
+
+        with TestCluster(2) as c:
+            det = FailureDetector(c.nodes[0].cluster, confirm_down=10_000)
+            nid = c.nodes[1].node.id
+            with det._fails_lock:
+                det._fails[nid] = 1  # "we are failing it too"
+            n_threads, per_thread = 8, 200
+            barrier = threading.Barrier(n_threads)
+
+            def vote():
+                barrier.wait()
+                for _ in range(per_thread):
+                    det.vote_down(nid)
+
+            threads = [
+                threading.Thread(target=vote) for _ in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert det._fails[nid] == 1 + n_threads * per_thread
+
 
 class TestBroadcastRecovery:
     def test_ddl_broadcast_queued_and_flushed(self):

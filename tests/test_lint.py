@@ -15,7 +15,10 @@ import pytest
 
 from tools.lint import core
 from tools.lint.checkers import make_checkers
+from tools.lint.checkers.config_drift import config_drift_findings
+from tools.lint.checkers.deadline_scope import DeadlineScopeChecker
 from tools.lint.checkers.error_codes import ErrorCodeChecker
+from tools.lint.checkers.shared_state import SharedStateChecker
 from tools.lint.checkers.exceptions import ExceptDisciplineChecker
 from tools.lint.checkers.jax_dispatch import JaxDispatchChecker
 from tools.lint.checkers.lock_discipline import LockDisciplineChecker
@@ -144,6 +147,71 @@ class TestCheckersFire:
         assert "unknown tag key" in msgs
         assert "unbounded cardinality" in msgs
 
+    def test_shared_state_fixture(self):
+        """The seeded two-root unlocked writes fire; the blessed
+        assign-once-before-start publish and the fully-locked counter
+        do not (ISSUE r13 tentpole 1)."""
+        f = load_fixture("shared_state_bad.py")
+        got = list(SharedStateChecker().finalize([f]))
+        msgs = " | ".join(v.message for v in got)
+        assert len(got) == 2
+        assert "Daemon.counter" in msgs          # unlocked self-attr RMW
+        assert "_hits" in msgs                   # unlocked module global
+        assert "http-request" in msgs            # both roots named
+        assert "published" not in msgs           # blessed immutable publish
+        assert "guarded" not in msgs             # common lock on every path
+
+    def test_deadline_scope_fixture(self):
+        """The bare client call from a thread root fires; the call
+        under `with deadline_scope(...)` does not (tentpole 2)."""
+        f = load_fixture("deadline_scope_bad.py")
+        got = list(DeadlineScopeChecker().finalize([f]))
+        assert len(got) == 1
+        assert got[0].rule == "deadline-scope"
+        assert "status()" in got[0].message
+        # The flagged line is the UNcovered call, not the covered one.
+        assert "# BAD" in f.text.splitlines()[got[0].line - 1]
+
+    def test_config_drift_fixture(self):
+        """The drifted knob yields one finding per missing surface; the
+        fully-wired knob yields none (tentpole 3)."""
+        text = (FIXTURES / "config_drift_bad.py").read_text()
+        got = config_drift_findings(
+            text,
+            cli_text="def f(cfg): return cfg.wired",
+            doc_text="| `wired` | PILOSA_TPU_WIRED |",
+        )
+        assert [a for a, _l, _m in got] == ["broken"] * 5
+        surfaces = " | ".join(m for _a, _l, m in got)
+        assert "env var" in surfaces
+        assert "to_dict" in surfaces
+        assert "toml_text" in surfaces
+        assert "cli.py" in surfaces
+        assert "docs/configuration.md" in surfaces
+
+    def test_config_drift_doc_env_mismatch(self):
+        """A docs row whose env cell lost the variable is drift too."""
+        text = (FIXTURES / "config_drift_bad.py").read_text()
+        got = config_drift_findings(
+            text,
+            cli_text="def f(cfg): return cfg.wired",
+            doc_text="| `wired` | — |",  # row exists, env cell dropped
+        )
+        assert any("omits the env var" in m for a, _l, m in got
+                   if a == "wired")
+
+    def test_repo_config_is_drift_free(self):
+        """The real config.py/cli.py/docs row set round-trips — the
+        acceptance property, asserted without the whole lint run."""
+        assert config_drift_findings(
+            core.REPO_ROOT.joinpath(
+                "pilosa_tpu", "server", "config.py").read_text(),
+            cli_text=core.REPO_ROOT.joinpath(
+                "pilosa_tpu", "cli.py").read_text(),
+            doc_text=core.REPO_ROOT.joinpath(
+                "docs", "configuration.md").read_text(),
+        ) == []
+
     def test_metric_docs_drift_detects_both_directions(self):
         doc = "catalogue: `real_total` and `phantom_total`."
         findings = metrics_docs_drift(
@@ -198,6 +266,93 @@ class TestWaivers:
 
 
 # ---------------------------------------------------------------------------
+# Waiver ratchet: the committed per-rule census (ISSUE r13 satellite).
+# ---------------------------------------------------------------------------
+
+
+class TestWaiverRatchet:
+    def test_committed_ledger_matches_live_census(self):
+        """The real gate: tools/lint/waivers.lock equals the tree's
+        waiver counts exactly (also covered by the repo-clean test,
+        but this pins WHICH property failed when it does)."""
+        files = [
+            core.SourceFile.load(p, ALL_RULES)
+            for p in core.collect_files()
+            if "__pycache__" not in p.parts
+        ]
+        census = core.waiver_census(f for f in files if f.tree is not None)
+        assert census == core.read_waiver_ledger()
+
+    def _tree_with_one_waiver(self, tmp_path):
+        tree = tmp_path / "tree"
+        tree.mkdir()
+        (tree / "m.py").write_text(
+            "import time\n"
+            "def f():\n"
+            "    return time.time()  # lint: allow-monotonic-time(test)\n"
+        )
+        return tree
+
+    def test_new_waiver_without_ledger_bump_fails(self, tmp_path, monkeypatch):
+        ledger = tmp_path / "waivers.lock"
+        ledger.write_text("monotonic-time 0\n")
+        monkeypatch.setattr(core, "WAIVER_LEDGER", ledger)
+        monkeypatch.setattr(core, "DEFAULT_TREE",
+                            str(self._tree_with_one_waiver(tmp_path)))
+        got = [v for v in core.run_lint(make_checkers())
+               if v.rule == "waiver-ratchet"]
+        assert len(got) == 1
+        assert "1 waiver(s) for 'monotonic-time'" in got[0].message
+        assert "bump" in got[0].hint
+
+    def test_stale_ledger_must_ratchet_down(self, tmp_path, monkeypatch):
+        ledger = tmp_path / "waivers.lock"
+        ledger.write_text("monotonic-time 5\nexcept-exception 2\n")
+        monkeypatch.setattr(core, "WAIVER_LEDGER", ledger)
+        monkeypatch.setattr(core, "DEFAULT_TREE",
+                            str(self._tree_with_one_waiver(tmp_path)))
+        got = [v for v in core.run_lint(make_checkers())
+               if v.rule == "waiver-ratchet"]
+        msgs = " | ".join(v.message for v in got)
+        assert "ledger records 5" in msgs       # monotonic: 5 vs 1
+        assert "ledger records 2" in msgs       # except: 2 vs 0
+        assert all("ratchet down" in v.hint for v in got)
+
+    def test_missing_ledger_is_a_violation(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(core, "WAIVER_LEDGER",
+                            tmp_path / "does_not_exist.lock")
+        monkeypatch.setattr(core, "DEFAULT_TREE",
+                            str(self._tree_with_one_waiver(tmp_path)))
+        got = [v for v in core.run_lint(make_checkers())
+               if v.rule == "waiver-ratchet"]
+        assert len(got) == 1 and "missing" in got[0].message
+
+    def test_subset_and_rule_filtered_runs_skip_the_ratchet(
+        self, tmp_path, monkeypatch
+    ):
+        """--changed / explicit paths / --rule see a partial census by
+        construction: the ratchet must not judge them."""
+        monkeypatch.setattr(core, "WAIVER_LEDGER",
+                            tmp_path / "does_not_exist.lock")
+        got = core.run_lint(
+            make_checkers(), paths=["pilosa_tpu/utils/tracing.py"]
+        )
+        assert not [v for v in got if v.rule == "waiver-ratchet"]
+        got = core.run_lint(make_checkers(), rules={"monotonic-time"})
+        assert not [v for v in got if v.rule == "waiver-ratchet"]
+
+    def test_list_waivers_cli(self, capsys):
+        from tools.lint.__main__ import main
+
+        assert main(["--list-waivers"]) == 0
+        out = capsys.readouterr().out
+        assert "shared-state 15" in out
+        # Per-site lines carry file:line, rule and the reason text.
+        assert "pilosa_tpu/utils/tracing.py" in out
+        assert "[monotonic-time]" in out
+
+
+# ---------------------------------------------------------------------------
 # Framework: registry, CLI, --changed fast mode.
 # ---------------------------------------------------------------------------
 
@@ -206,7 +361,7 @@ class TestFramework:
     def test_registry_rules_unique_and_documented(self):
         checkers = make_checkers()
         rules = [c.rule for c in checkers]
-        assert len(rules) == len(set(rules)) == 8
+        assert len(rules) == len(set(rules)) == 11
         for c in checkers:
             assert c.rule and c.doc, f"{type(c).__name__} lacks rule/doc"
 

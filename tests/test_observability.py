@@ -117,25 +117,19 @@ class TestClusterTraces:
             ]
             assert qspans
             trace_id = qspans[-1]["traceID"]
-            # Poll: spans enter the ring at finish(), and the remote
-            # node's http span finishes AFTER its reply bytes reached
-            # the coordinator — an in-process client can read the ring
-            # a GIL slice before that finalization lands (pre-r12
-            # flake; same window as the admission-test drain).
-            import time as _time
-
-            deadline = _time.monotonic() + 5
-            while True:
-                spans = global_tracer.spans_for(trace_id)
-                nodes = {
-                    s["tags"].get("node") for s in spans
-                    if "node" in s["tags"]
-                }
-                if {"node0", "node1"} <= nodes or (
-                    _time.monotonic() > deadline
-                ):
-                    break
-                _time.sleep(0.01)
+            # Spans enter the ring at finish(), and the remote node's
+            # http span finishes AFTER its reply bytes reached the
+            # coordinator — an in-process client can read the ring a
+            # GIL slice before that finalization lands. quiesce() on
+            # BOTH nodes is the deterministic barrier (ISSUE r13; this
+            # used to be an ad-hoc poll loop on the span ring).
+            assert c[1].server.quiesce(timeout=5.0)
+            assert c[0].server.quiesce(timeout=5.0)
+            spans = global_tracer.spans_for(trace_id)
+            nodes = {
+                s["tags"].get("node") for s in spans
+                if "node" in s["tags"]
+            }
             assert {"node0", "node1"} <= nodes, spans
             # The remote leg is linked, not a parallel orphan: node1's
             # http span chains to a coordinator-side mapper span.

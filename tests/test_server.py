@@ -328,28 +328,116 @@ class TestProtobufResponses:
             assert "error" in out
 
 
+class TestConfigWiredKnobs:
+    """Knobs the config-drift rule caught parsed-but-dead, now wired
+    (ISSUE r13 tentpole 3)."""
+
+    def test_max_writes_per_request_enforced(self, server):
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        server.api.max_writes_per_request = 2
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                req(server, "POST", "/index/i/query",
+                    b"Set(1, f=1) Set(2, f=1) Set(3, f=1)", raw=True)
+            assert e.value.code == 400
+            body = json.loads(e.value.read())
+            assert body["code"] == "too-many-writes"
+            assert "3 write calls" in body["error"]
+            # Exactly at the cap: admitted.
+            out = req(server, "POST", "/index/i/query",
+                      b"Set(4, f=1) Set(5, f=1)")
+            assert "results" in out
+        finally:
+            server.api.max_writes_per_request = 0
+
+    def test_metric_service_none_disables_exposition(self, server):
+        server.api.metric_service = "none"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                req(server, "GET", "/metrics", raw=True)
+            assert e.value.code == 404
+            assert json.loads(e.value.read())["code"] == "metrics-disabled"
+        finally:
+            server.api.metric_service = "memory"
+        # Back to memory: the exposition serves again.
+        text = req(server, "GET", "/metrics", raw=True)
+        assert b"http_requests_total" in text
+
+
+class TestFinalizationBarrier:
+    """Server.quiesce (ISSUE r13 satellite): the deterministic barrier
+    for the 'handler finalizes one GIL slice after the client has the
+    reply bytes' race class that PR 10 papered over with per-test poll
+    loops."""
+
+    def test_idle_server_quiesces_immediately(self, server):
+        assert server.quiesce(timeout=0.5)
+
+    def test_quiesce_blocks_until_inflight_request_finalizes(self, server):
+        """A request still executing holds quiesce open; it returns
+        only once the handler (reply AND post-reply bookkeeping) is
+        done — asserted via the in-flight query gauge being zero with
+        NO polling."""
+        import queue
+        import threading
+
+        req(server, "POST", "/index/i", {})
+        req(server, "POST", "/index/i/field/f", {})
+        results: queue.Queue = queue.Queue()
+
+        def one_query():
+            results.put(
+                req(server, "POST", "/index/i/query", b"Count(Row(f=1))",
+                    raw=True)
+            )
+
+        threads = [
+            threading.Thread(target=one_query, daemon=True)
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # The clients all HAVE their bytes; the handlers may still be
+        # in their finally blocks. After quiesce, the gauge must read
+        # zero immediately — this is the exact assertion that flaked
+        # as a poll loop before.
+        assert server.quiesce(timeout=5.0)
+        assert server.api._inflight_queries == 0
+        assert results.qsize() == 4
+
+    def test_quiesce_times_out_while_request_held_open(self, server):
+        """quiesce reports False (not a hang) when a request genuinely
+        outlives the timeout."""
+        srv = server._httpd
+        srv._request_begin()  # simulate a stuck in-flight request
+        try:
+            assert not server.quiesce(timeout=0.1)
+        finally:
+            srv._request_end()
+        assert server.quiesce(timeout=1.0)
+
+
 class TestAdmissionControl:
     """In-flight /query cap (ISSUE r11 satellite): past the cap the
     server sheds deliberately — 429 + Retry-After + code=overloaded,
     counted — instead of queueing toward an accept-path reset."""
 
-    def _fill(self, api, n):
-        self._drain(api)
+    def _fill(self, server, n):
+        self._drain(server)
         for _ in range(n):
-            assert api.begin_query()
+            assert server.api.begin_query()
 
     @staticmethod
-    def _drain(api, timeout: float = 2.0) -> None:
-        """Wait for the server's in-flight count to reach zero: the
-        handler's `finally: end_query()` runs ~1 ms AFTER the client
-        has read the response body, so a test that saturates the cap
-        right after a request races the decrement (pre-r12 flake)."""
-        import time
-
-        t0 = time.monotonic()
-        while api._inflight_queries and time.monotonic() - t0 < timeout:
-            time.sleep(0.002)
-        assert api._inflight_queries == 0
+    def _drain(server) -> None:
+        """The handler's `finally: end_query()` runs ~1 ms AFTER the
+        client has read the response body; quiesce() is the server's
+        finalization barrier for exactly this race (ISSUE r13 — this
+        used to be an ad-hoc poll loop on the gauge)."""
+        assert server.quiesce(timeout=5.0)
+        assert server.api._inflight_queries == 0
 
     def test_shed_past_cap_then_recover(self, server):
         from pilosa_tpu.utils.stats import global_stats
@@ -362,7 +450,7 @@ class TestAdmissionControl:
         before = global_stats.snapshot()["counters"].get(
             "http_requests_shed_total", 0.0
         )
-        self._fill(api, 2)  # saturate the cap deterministically
+        self._fill(server, 2)  # saturate the cap deterministically
         try:
             with pytest.raises(urllib.error.HTTPError) as e:
                 req(server, "POST", "/index/i/query", b"Count(Row(f=1))", raw=True)
@@ -397,7 +485,7 @@ class TestAdmissionControl:
         req(server, "POST", "/index/i/query", b"Set(1, f=1)", raw=True)
         api = server.api
         api.max_inflight_queries = 1
-        self._drain(api)
+        self._drain(server)
         assert api.begin_query()
         try:
             conn = http.client.HTTPConnection(server.host, server.port)

@@ -100,22 +100,18 @@ class TestDebugEndpoints:
         req(server, "POST", "/index/i/query", "Set(10, f=1)")
         out = req(server, "POST", "/index/i/query", "Count(Row(f=1))")
         assert out == {"results": [1]}
-        # Poll: the Count's profile enters `recent` when its scope
-        # exits, which happens AFTER the reply bytes reached this
-        # in-process client — one GIL slice later (pre-r12 flake).
-        import time as _time
-
-        deadline = _time.monotonic() + 5
-        counts: list = []
-        while not counts and _time.monotonic() < deadline:
-            dbg = req(server, "GET", "/debug/queries?n=10")
-            assert "inflight" in dbg and "recent" in dbg
-            counts = [
-                r for r in dbg["recent"]
-                if r["call"] == "Count" and r["query"].startswith("Count(")
-            ]
-            if not counts:
-                _time.sleep(0.01)
+        # The Count's profile enters `recent` when its scope exits,
+        # which happens AFTER the reply bytes reached this in-process
+        # client — one GIL slice later. quiesce() is the server's
+        # finalization barrier for exactly that window (ISSUE r13;
+        # this used to be an ad-hoc poll loop).
+        assert server.quiesce(timeout=5.0)
+        dbg = req(server, "GET", "/debug/queries?n=10")
+        assert "inflight" in dbg and "recent" in dbg
+        counts = [
+            r for r in dbg["recent"]
+            if r["call"] == "Count" and r["query"].startswith("Count(")
+        ]
         assert counts, dbg["recent"]
         entry = counts[0]
         assert entry["index"] == "i"

@@ -29,9 +29,36 @@ def main(argv=None) -> int:
                     help="run only this rule id (repeatable)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--list-waivers", action="store_true",
+                    help="print the live full-tree waiver census (the "
+                         "ratchet ledger's source of truth) and exit")
     args = ap.parse_args(argv)
 
     checkers = make_checkers()
+    if args.list_waivers:
+        from tools.lint.core import (
+            SourceFile, collect_files, waiver_census,
+        )
+
+        if args.paths or args.changed:
+            # The census is the ratchet ledger's source of truth: a
+            # partial count pasted into waivers.lock would fail every
+            # subsequent full run with spurious ratchet-down findings.
+            print("--list-waivers always censuses the full default "
+                  "tree; ignoring paths/--changed")
+        known = {c.rule for c in checkers}
+        files = [
+            SourceFile.load(p, known)
+            for p in collect_files()
+            if "__pycache__" not in p.parts
+        ]
+        census = waiver_census(files)
+        for rule in sorted(census):
+            print(f"{rule} {census[rule]}")
+        for f in files:
+            for w in sorted(f.waivers, key=lambda w: w.line):
+                print(f"  {f.rel}:{w.line} [{w.rule}] {w.reason}")
+        return 0
     if args.list_rules:
         width = max(len(c.rule) for c in checkers)
         for c in checkers:

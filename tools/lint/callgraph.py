@@ -1,0 +1,393 @@
+"""Shared whole-program call graph + lock index for the concurrency
+checkers (lock-discipline, shared-state, deadline-scope).
+
+Extracted from lock_discipline.py (PR 7) when shared-state and
+deadline-scope arrived (ISSUE r13): all three rules need the same
+conservatively-resolved call graph, the same function inventory, and
+(for the first two) the same lock inventory — one resolver means one
+set of precision bugs instead of three drifting copies.
+
+Resolution is deliberately an under-approximation of dynamic Python:
+
+- `self.m()` resolves to the enclosing class's method first;
+- a bare name resolves to the same module's function, else to the
+  unique project-wide function of that name;
+- an attribute call resolves to the unique project-wide method of that
+  name, or to a small SAME-MODULE union (<= 4 candidates) rendered as a
+  `a|b` union key — cross-module unions are refused (merging roaring's
+  `_put` with the TPU cache's `_put` would invent call edges and, from
+  them, phantom findings);
+- names too generic to mean anything (`get`, `append`, `execute`, ...)
+  are skipped entirely.
+
+That misses exotic dispatch; it does NOT miss the direct-call patterns
+real deadlocks and races are made of.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from tools.lint.core import SourceFile, dotted_name
+
+#: Attribute/method names far too generic to resolve by name union —
+#: resolving `d.get(...)` to some class's `get` method would invent
+#: call-graph edges (and from them, phantom deadlocks/races).
+GENERIC_NAMES = {
+    "get", "set", "pop", "popitem", "popleft", "appendleft", "items",
+    "keys", "values", "append", "extend", "insert", "remove", "sort",
+    "reverse", "copy", "clear", "update", "setdefault", "add",
+    "discard", "count", "index", "join", "split", "rsplit", "strip",
+    "lstrip", "rstrip", "startswith", "endswith", "encode", "decode",
+    "format", "replace", "read", "write", "readline", "readlines",
+    "close", "flush", "open", "search", "match", "fullmatch",
+    "findall", "finditer", "sub", "group", "groups", "start", "end",
+    "partition", "rpartition", "lower", "upper", "title", "tolist",
+    "astype", "reshape", "sum", "max", "min", "any", "all", "mean",
+    "nonzero", "item", "wait", "acquire", "release", "locked", "name",
+    "cancel", "put", "empty", "full", "qsize", "result", "submit",
+    "sleep", "is_set",
+    # DB-API cursor/connection methods (sqlite in store/): never the
+    # project's Executor.execute, which self-resolves above.
+    "execute", "executemany", "fetchone", "fetchall", "commit",
+    "rollback", "cursor",
+}
+
+LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+
+
+def module_name(rel: str) -> str:
+    """Repo-relative path -> short module id used in func/lock ids."""
+    name = rel
+    for prefix in ("pilosa_tpu/",):
+        if name.startswith(prefix):
+            name = name[len(prefix):]
+    return name[:-3].replace("/", ".") if name.endswith(".py") else name
+
+
+@dataclass
+class FuncInfo:
+    func_id: str                  # module.(Class.)name(.nested)
+    rel: str
+    node: ast.AST
+    cls: Optional[str]            # enclosing class name
+    #: (callee key, lineno) for every conservatively-resolved call —
+    #: populated by CallGraph.collect_calls(); checkers that need more
+    #: context at the call site (held locks, deadline cover) rescan the
+    #: body themselves via walk_own/iter_own_calls.
+    calls: list = field(default_factory=list)
+
+
+def walk_own(node: ast.AST) -> Iterable[ast.AST]:
+    """Yield the nodes that execute as part of THIS function's body:
+    nested function/class/lambda bodies are skipped (they run later,
+    under their own FuncInfo)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        yield child
+        yield from walk_own(child)
+
+
+class CallGraph:
+    """Function inventory + conservative call resolution over a set of
+    parsed SourceFiles."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.funcs: dict[str, FuncInfo] = {}
+        self.methods: dict[str, list[str]] = {}    # method name -> func ids
+        self.module_funcs: dict[tuple, str] = {}   # (module, name) -> id
+        self.class_methods: dict[tuple, str] = {}  # (class, name) -> id
+        self.file_of: dict[str, SourceFile] = {f.rel: f for f in files}
+        for f in files:
+            self._collect(f)
+
+    # -- collection --------------------------------------------------------
+
+    def _collect(self, f: SourceFile) -> None:
+        mod = module_name(f.rel)
+
+        def visit(body, path: str, cls: Optional[str]):
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    visit(stmt.body,
+                          f"{path}.{stmt.name}" if path else stmt.name,
+                          stmt.name)
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fid = (f"{mod}.{path}.{stmt.name}" if path
+                           else f"{mod}.{stmt.name}")
+                    fn = FuncInfo(func_id=fid, rel=f.rel, node=stmt, cls=cls)
+                    self.funcs[fid] = fn
+                    self.methods.setdefault(stmt.name, []).append(fid)
+                    if cls is not None:
+                        self.class_methods.setdefault((cls, stmt.name), fid)
+                    else:
+                        self.module_funcs[(mod, stmt.name)] = fid
+                    visit(
+                        [s for s in stmt.body
+                         if isinstance(s, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef))],
+                        f"{path}.{stmt.name}" if path else stmt.name,
+                        cls,
+                    )
+
+        visit(f.tree.body, "", None)
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_call(self, call: ast.Call, fn: FuncInfo) -> Optional[str]:
+        """Callee func id (possibly an `a|b` union key), or None."""
+        return self.resolve_ref(call.func, fn)
+
+    def resolve_ref(self, func: ast.AST, fn: FuncInfo) -> Optional[str]:
+        """Resolve a function REFERENCE (a call target, a Thread
+        target=, a pool.submit first argument) to a func id."""
+        mod = module_name(fn.rel)
+        if isinstance(func, ast.Name):
+            fid = self.module_funcs.get((mod, func.id))
+            if fid:
+                return fid
+            # nested def in an enclosing function of this module
+            parts = fn.func_id.split(".")
+            for depth in range(len(parts), 0, -1):
+                cand = ".".join(parts[:depth]) + f".{func.id}"
+                if cand in self.funcs:
+                    return cand
+            # unique project-wide module function of that name
+            cands = [
+                v for (m, n), v in self.module_funcs.items() if n == func.id
+            ]
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            # self.m() resolves by the enclosing class BEFORE the
+            # generic-name filter: Executor.execute is a real project
+            # method even though bare `.execute(` usually means a DB
+            # cursor.
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and fn.cls is not None
+            ):
+                fid = self.class_methods.get((fn.cls, name))
+                if fid:
+                    return fid
+            if name in GENERIC_NAMES or name.startswith("__"):
+                return None
+            cands = self.methods.get(name, [])
+            if len(cands) == 1:
+                return cands[0]
+            if 1 < len(cands) <= 4:
+                # Small SAME-MODULE union (e.g. StatsClient +
+                # NopStatsClient both define gauge): a synthetic union
+                # key resolved at fixpoint time. Cross-module unions are
+                # refused — merging roaring's Bitmap._put with the TPU
+                # cache's _put would smear device dispatch over the
+                # whole host bitmap layer and invent violations.
+                mods = {self.funcs[c].rel for c in cands if c in self.funcs}
+                if len(mods) == 1:
+                    return "|".join(sorted(cands))
+            return None
+        return None
+
+    @staticmethod
+    def callee_ids(key: str) -> list[str]:
+        return key.split("|") if "|" in key else [key]
+
+    def collect_calls(self) -> None:
+        """Populate FuncInfo.calls for every function (context-free
+        edges: no lock/deadline state — checkers that need that rescan
+        with their own state machine)."""
+        for fn in self.funcs.values():
+            for n in walk_own(fn.node):
+                if isinstance(n, ast.Call):
+                    key = self.resolve_call(n, fn)
+                    if key is not None:
+                        fn.calls.append((key, n.lineno))
+
+    def reachable(self, roots: Iterable[str]) -> set[str]:
+        """Transitive closure over FuncInfo.calls (collect_calls first)."""
+        seen: set[str] = set()
+        stack = [r for r in roots if r in self.funcs]
+        while stack:
+            fid = stack.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            fn = self.funcs.get(fid)
+            if fn is None:
+                continue
+            for key, _ln in fn.calls:
+                for callee in self.callee_ids(key):
+                    if callee in self.funcs and callee not in seen:
+                        stack.append(callee)
+        return seen
+
+
+#: Receiver-name hints for `<pool>.submit(f)` / `<pool>.map(f)` thread
+#: dispatch (plain `.map` on anything else is not a thread root).
+POOL_HINTS = ("pool", "executor", "workers")
+
+
+def thread_targets(graph: CallGraph, call: ast.Call,
+                   fn: FuncInfo) -> list[str]:
+    """Resolved func ids a Call hands to another thread, or []:
+    `threading.Thread(target=...)` under any alias, and
+    `<pool>.submit(f, ...)` / `<pool>.map(f, it)` executor dispatch."""
+    out: list[str] = []
+    func = call.func
+    dn = dotted_name(func) or ""
+    if dn.endswith("Thread") and not dn.endswith("ThreadPoolExecutor"):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                ref = graph.resolve_ref(kw.value, fn)
+                if ref:
+                    out.extend(CallGraph.callee_ids(ref))
+    elif isinstance(func, ast.Attribute) and func.attr in ("submit", "map"):
+        recv = func.value
+        rname = (recv.attr if isinstance(recv, ast.Attribute)
+                 else recv.id if isinstance(recv, ast.Name) else "")
+        if any(h in rname.lower() for h in POOL_HINTS) and call.args:
+            ref = graph.resolve_ref(call.args[0], fn)
+            if ref:
+                out.extend(CallGraph.callee_ids(ref))
+    return out
+
+
+def collect_thread_roots(graph: CallGraph) -> dict[str, set[str]]:
+    """root name -> entry func ids, across the whole graph. Thread
+    targets are one root each; the HTTP handler class's methods are one
+    synthetic 'http-request' root (the stdlib server spawns one thread
+    per request into do_*, so every routed handler method runs on such
+    a thread)."""
+    roots: dict[str, set[str]] = {}
+    for fn in graph.funcs.values():
+        for n in walk_own(fn.node):
+            if isinstance(n, ast.Call):
+                for target in thread_targets(graph, n, fn):
+                    roots.setdefault(target, set()).add(target)
+    handler_classes = {
+        cls for (cls, name) in graph.class_methods if name == "do_GET"
+    }
+    request_entries = {
+        fid for (cls, name), fid in graph.class_methods.items()
+        if cls in handler_classes
+    }
+    if request_entries:
+        roots["http-request"] = request_entries
+    return roots
+
+
+@dataclass
+class LockDef:
+    lock_id: str      # module.Class.attr | module.NAME | module.func.NAME
+    kind: str         # Lock | RLock | Condition
+    attr: str         # attribute / variable name
+    rel: str
+    line: int
+
+
+class LockIndex:
+    """Every `threading.Lock()/RLock()/Condition()` assignment in the
+    tree, resolvable from a `with <expr>:` context expression."""
+
+    def __init__(self, files: list[SourceFile], graph: CallGraph):
+        self.locks: dict[str, LockDef] = {}
+        self.attr_locks: dict[str, list[str]] = {}  # attr name -> lock ids
+        for f in files:
+            self._collect_module(f)
+        for fn in graph.funcs.values():
+            self._collect_fn(fn)
+
+    def _add(self, lock_id: str, kind: str, attr: str, rel: str,
+             line: int) -> None:
+        self.locks[lock_id] = LockDef(lock_id, kind, attr, rel, line)
+        self.attr_locks.setdefault(attr, []).append(lock_id)
+
+    @staticmethod
+    def lock_ctor(value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            return LOCK_CTORS.get(dotted_name(value.func) or "")
+        return None
+
+    def _collect_module(self, f: SourceFile) -> None:
+        """Module-level and class-level Name-target lock assignments."""
+        mod = module_name(f.rel)
+
+        def visit(body):
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    visit(stmt.body)
+                elif isinstance(stmt, ast.Assign):
+                    kind = self.lock_ctor(stmt.value)
+                    if kind:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                self._add(f"{mod}.{t.id}", kind, t.id,
+                                          f.rel, stmt.lineno)
+
+        visit(f.tree.body)
+
+    def _collect_fn(self, fn: FuncInfo) -> None:
+        """self.X = Lock() and function-local lock assignments inside
+        one function body (nested defs get their own FuncInfo pass)."""
+        mod = module_name(fn.rel)
+        for n in walk_own(fn.node):
+            if not isinstance(n, ast.Assign):
+                continue
+            kind = self.lock_ctor(n.value)
+            if not kind:
+                continue
+            for t in n.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and fn.cls is not None
+                ):
+                    self._add(f"{mod}.{fn.cls}.{t.attr}", kind, t.attr,
+                              fn.rel, n.lineno)
+                elif isinstance(t, ast.Name):
+                    # function-local lock (closure rendezvous)
+                    self._add(f"{fn.func_id}.{t.id}", kind, t.id,
+                              fn.rel, n.lineno)
+
+    def resolve(self, expr: ast.AST, fn: FuncInfo) -> Optional[str]:
+        """lock id for a `with <expr>:` context, or None (not a lock)."""
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            candidates = self.attr_locks.get(attr, [])
+            if not candidates:
+                return None
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                or fn.cls is not None
+            ):
+                # self.X — or a same-class alias like `r._lock` where r
+                # is the root instance: prefer the enclosing class's X.
+                for c in candidates:
+                    if f".{fn.cls}.{attr}" in c:
+                        return c
+            if len(candidates) == 1:
+                return candidates[0]
+            return None  # ambiguous attribute: don't invent edges
+        if isinstance(expr, ast.Name):
+            # innermost function-local, then enclosing funcs, then module
+            parts = fn.func_id.split(".")
+            for depth in range(len(parts), 0, -1):
+                cand = ".".join(parts[:depth]) + f".{expr.id}"
+                if cand in self.locks:
+                    return cand
+            mod = module_name(fn.rel)
+            cand = f"{mod}.{expr.id}"
+            return cand if cand in self.locks else None
+        return None

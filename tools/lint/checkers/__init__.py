@@ -12,6 +12,8 @@ docs/development.md "Lint plane").
 
 from __future__ import annotations
 
+from tools.lint.checkers.config_drift import ConfigDriftChecker
+from tools.lint.checkers.deadline_scope import DeadlineScopeChecker
 from tools.lint.checkers.durable_write import DurableWriteChecker
 from tools.lint.checkers.error_codes import ErrorCodeChecker
 from tools.lint.checkers.exceptions import ExceptDisciplineChecker
@@ -19,6 +21,7 @@ from tools.lint.checkers.jax_dispatch import JaxDispatchChecker
 from tools.lint.checkers.lock_discipline import LockDisciplineChecker
 from tools.lint.checkers.metrics import MetricDocsChecker, TagCardinalityChecker
 from tools.lint.checkers.monotonic_time import MonotonicTimeChecker
+from tools.lint.checkers.shared_state import SharedStateChecker
 
 
 def make_checkers():
@@ -28,8 +31,11 @@ def make_checkers():
         ErrorCodeChecker(),
         JaxDispatchChecker(),
         LockDisciplineChecker(),
+        SharedStateChecker(),
+        DeadlineScopeChecker(),
         ExceptDisciplineChecker(),
         MetricDocsChecker(),
         TagCardinalityChecker(),
         DurableWriteChecker(),
+        ConfigDriftChecker(),
     ]

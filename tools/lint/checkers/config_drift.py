@@ -1,0 +1,226 @@
+"""config-drift: every knob wired end to end, or it ships broken.
+
+PRs 4-10 added ~15 config knobs by hand, and the seed shipped knobs
+that PARSED but were never consumed (`max-writes-per-request`,
+`log-path`, `[metric] service`) or were consumed but invisible
+(`client-timeout` absent from `pilosa-tpu config`'s to_dict dump). A
+knob that misses one surface fails silently: an env var that doesn't
+exist reads as "the flag is broken", a missing doc row reads as "the
+flag doesn't exist".
+
+The rule: every top-level scalar field of server/config.py `Config`
+must round-trip through all six surfaces —
+
+1. TOML parse (`_apply_toml`),
+2. env var (`_apply_env`, spelled `PILOSA_TPU_<FIELD>`),
+3. `to_dict` (the `pilosa-tpu config` validation dump),
+4. `toml_text` (the `generate-config` output),
+5. cli.py wiring (something actually reads `cfg.<field>`),
+6. a docs/configuration.md row (knob key + env var).
+
+Compound fields (cluster/tls dataclasses, the slo list) are owned by
+their own tests and skipped here. A deliberate exception carries a
+waiver on the field's definition line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.lint.core import REPO_ROOT, Checker, SourceFile, Violation
+
+CONFIG_PATH = REPO_ROOT / "pilosa_tpu" / "server" / "config.py"
+CLI_PATH = REPO_ROOT / "pilosa_tpu" / "cli.py"
+DOC_PATH = REPO_ROOT / "docs" / "configuration.md"
+
+#: Scalar annotations the rule audits; everything else is compound.
+_SCALAR_TYPES = {"str", "int", "float", "bool"}
+
+#: Doc spellings for knobs that live under a TOML section instead of a
+#: top-level `knob-name` key.
+SPECIAL_DOC_KEYS = {
+    "profile_port": "profile.port",
+    "anti_entropy_interval": "[anti-entropy] interval",
+    "metric_service": "[metric] service",
+}
+
+#: cli.py consumption aliases: `bind` is consumed through the derived
+#: host/port properties.
+_CLI_ALIASES = {"bind": ("host", "port")}
+
+ENV_PREFIX = "PILOSA_TPU_"
+
+
+def _self_attr_stores(fn: ast.AST) -> set[str]:
+    out = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.add(t.attr)
+    return out
+
+
+def _attr_loads(fn: ast.AST, receiver: Optional[str] = None) -> set[str]:
+    out = set()
+    for n in ast.walk(fn):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name)
+            and (receiver is None or n.value.id == receiver)
+        ):
+            out.add(n.attr)
+    return out
+
+
+def _dict_string_values(fn: ast.AST, var_name: str) -> set[str]:
+    """String values of a dict literal assigned to `var_name` in fn:
+    the `simple` spelling->attr map in _apply_toml."""
+    out = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Dict):
+            if any(isinstance(t, ast.Name) and t.id == var_name
+                   for t in n.targets):
+                for v in n.value.values:
+                    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                        out.add(v.value)
+    return out
+
+
+def _env_mapping_attrs(fn: ast.AST) -> set[str]:
+    """First tuple elements of the `mapping` dict in _apply_env
+    (attribute names; dotted sub-config entries are skipped)."""
+    out = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Dict):
+            if not any(isinstance(t, ast.Name) and t.id == "mapping"
+                       for t in n.targets):
+                continue
+            for v in n.value.values:
+                if (
+                    isinstance(v, ast.Tuple)
+                    and v.elts
+                    and isinstance(v.elts[0], ast.Constant)
+                    and isinstance(v.elts[0].value, str)
+                    and "." not in v.elts[0].value
+                ):
+                    out.add(v.elts[0].value)
+    return out
+
+
+def config_drift_findings(
+    config_text: str,
+    cli_text: Optional[str] = None,
+    doc_text: Optional[str] = None,
+) -> list[tuple[str, int, str]]:
+    """(field attr, config.py line, missing-surface description) per
+    drifted knob. Injectable inputs so the rule is testable against a
+    seeded fixture without mutating the repo (the metrics-docs
+    pattern). cli/doc checks are skipped when their text is None only
+    if the caller explicitly passes empty strings semantics: pass ""
+    to assert against 'nothing is wired'."""
+    tree = ast.parse(config_text)
+    cfg_cls = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, ast.ClassDef) and n.name == "Config"),
+        None,
+    )
+    if cfg_cls is None:
+        return []
+    fields: dict[str, int] = {}
+    fns: dict[str, ast.AST] = {}
+    for stmt in cfg_cls.body:
+        if (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and isinstance(stmt.annotation, ast.Name)
+            and stmt.annotation.id in _SCALAR_TYPES
+        ):
+            fields[stmt.target.id] = stmt.lineno
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns[stmt.name] = stmt
+
+    toml_attrs: set[str] = set()
+    if "_apply_toml" in fns:
+        toml_attrs |= _dict_string_values(fns["_apply_toml"], "simple")
+        toml_attrs |= _self_attr_stores(fns["_apply_toml"])
+    env_attrs = _env_mapping_attrs(fns["_apply_env"]) if "_apply_env" in fns else set()
+    todict_attrs = _attr_loads(fns["to_dict"], "self") if "to_dict" in fns else set()
+    # toml_text reads through a local alias (`c = self`): collect loads
+    # on ANY simple name — only scalar field names are compared anyway.
+    text_attrs = _attr_loads(fns["toml_text"]) if "toml_text" in fns else set()
+
+    cli_attrs: set[str] = set()
+    if cli_text:
+        cli_attrs = _attr_loads(ast.parse(cli_text), "cfg")
+
+    findings: list[tuple[str, int, str]] = []
+    for attr, line in sorted(fields.items(), key=lambda kv: kv[1]):
+        knob = attr.replace("_", "-")
+        if attr not in toml_attrs:
+            findings.append((attr, line, "not parseable from TOML "
+                                         "(_apply_toml)"))
+        if attr not in env_attrs:
+            findings.append((attr, line, f"no env var ({ENV_PREFIX}"
+                                         f"{attr.upper()} in _apply_env)"))
+        if attr not in todict_attrs:
+            findings.append((attr, line, "absent from to_dict (the "
+                                         "`pilosa-tpu config` dump)"))
+        if attr not in text_attrs:
+            findings.append((attr, line, "absent from toml_text "
+                                         "(generate-config output)"))
+        if cli_text is not None:
+            aliases = (attr,) + _CLI_ALIASES.get(attr, ())
+            if not any(a in cli_attrs for a in aliases):
+                findings.append((attr, line, "never consumed in cli.py "
+                                             "(a parsed-but-dead knob)"))
+        if doc_text is not None:
+            doc_key = SPECIAL_DOC_KEYS.get(attr, knob)
+            if doc_key not in doc_text:
+                findings.append((attr, line, "no docs/configuration.md "
+                                             f"row for `{doc_key}`"))
+            elif (attr in env_attrs
+                  and f"{ENV_PREFIX}{attr.upper()}" not in doc_text):
+                findings.append((attr, line, "docs row omits the env "
+                                             f"var {ENV_PREFIX}"
+                                             f"{attr.upper()}"))
+    return findings
+
+
+class ConfigDriftChecker(Checker):
+    rule = "config-drift"
+    doc = ("every Config knob round-trips TOML <-> env <-> to_dict <-> "
+           "toml_text <-> cli wiring <-> a docs/configuration.md row")
+    scope = ("pilosa_tpu",)
+    project_level = True
+
+    def finalize(self, files: list[SourceFile]) -> Iterable[Violation]:
+        try:
+            config_text = CONFIG_PATH.read_text()
+            cli_text = CLI_PATH.read_text()
+            doc_text = DOC_PATH.read_text()
+        except OSError as e:
+            yield Violation(
+                rule=self.rule, path="pilosa_tpu/server/config.py", line=1,
+                message=f"cannot read a config-drift input: {e}",
+            )
+            return
+        rel = str(CONFIG_PATH.relative_to(REPO_ROOT))
+        cfg_file = next((f for f in files if f.rel == rel), None)
+        for attr, line, missing in config_drift_findings(
+            config_text, cli_text, doc_text
+        ):
+            if cfg_file is not None and cfg_file.waive(self.rule, line):
+                continue
+            yield Violation(
+                rule=self.rule, path=rel, line=line,
+                message=f"config knob {attr.replace('_', '-')!r}: {missing}",
+                hint="wire all six surfaces (TOML/env/to_dict/toml_text/"
+                     "cli/docs) or waive on the field's definition line "
+                     "with the reason",
+            )

@@ -1,0 +1,154 @@
+"""deadline-scope: every peer RPC rides a query/operation budget.
+
+PR 4 built the end-to-end deadline plane (utils/deadline.py): a
+monotonic budget opened at HTTP ingress, threaded thread-locally to
+every layer, bounding each peer RPC's socket timeout and riding
+X-Pilosa-Deadline so remote nodes abandon abandoned work. But nothing
+ENFORCED it — a new daemon calling `client.status(peer)` outside any
+scope silently reverts to the flat client timeout, and a hung peer pins
+that thread for the full 30 s with no budget accounting.
+
+This rule pins the invariant statically: every call path from a
+concurrency root (thread targets + the thread-per-request HTTP plane,
+the same inventory the shared-state rule walks) into an
+`InternalClient` method must pass through a `with deadline_scope(...)`
+somewhere along the way. A path that reaches the client with no scope
+is flagged at the call site entering the client.
+
+Control-plane paths with a considered reason to run un-budgeted (their
+socket timeout IS the budget, or the path owns retry/backoff policy
+end to end) carry a waiver at that call site naming the path:
+`# lint: allow-deadline-scope(control-plane <path>: <why>)`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.lint.callgraph import (
+    CallGraph,
+    FuncInfo,
+    collect_thread_roots,
+    walk_own,
+)
+from tools.lint.core import Checker, SourceFile, Violation, dotted_name
+
+#: The peer-RPC chokepoint class: every `_do` caller lives here.
+CLIENT_CLASS = "InternalClient"
+
+
+def _opens_scope(expr: ast.AST) -> bool:
+    """True for a `with deadline_scope(...)` context expression."""
+    if isinstance(expr, ast.Call):
+        dn = dotted_name(expr.func) or ""
+        return dn.split(".")[-1] == "deadline_scope"
+    return False
+
+
+class DeadlineScopeChecker(Checker):
+    rule = "deadline-scope"
+    doc = ("every call path from a thread root into InternalClient must "
+           "pass a `with deadline_scope(...)` (the PR 4 budget plane)")
+    # Unscoped: the default tree is pilosa_tpu/ already; explicit paths
+    # (fixtures, --changed) must still be checkable.
+    scope = ("",)
+    cross_file = True
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        return ()  # whole-program analysis; see finalize
+
+    def _scan_sites(self, fn: FuncInfo) -> list:
+        """(callee key, line, covered) per resolved call site, where
+        covered means lexically inside a deadline_scope with-block."""
+        sites: list = []
+
+        def visit(node: ast.AST, covered: bool):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.With):
+                inner = covered or any(
+                    _opens_scope(item.context_expr) for item in node.items
+                )
+                for item in node.items:
+                    visit(item.context_expr, covered)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                key = self.graph.resolve_call(node, fn)
+                if key is not None:
+                    sites.append((key, node.lineno, covered))
+            for child in ast.iter_child_nodes(node):
+                visit(child, covered)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt, False)
+        return sites
+
+    def finalize(self, files: list[SourceFile]) -> Iterable[Violation]:
+        if not files:
+            return
+        self.graph = CallGraph(files)
+        self.graph.collect_calls()
+        roots = collect_thread_roots(self.graph)
+        if not roots:
+            return
+
+        sites: dict[str, list] = {
+            fid: self._scan_sites(fn) for fid, fn in self.graph.funcs.items()
+        }
+        is_client = {
+            fid: fn.cls == CLIENT_CLASS
+            for fid, fn in self.graph.funcs.items()
+        }
+
+        # BFS per root over (func, covered) states; an edge into an
+        # InternalClient method with covered=False is a finding at that
+        # call site. Client-internal edges are never findings (the
+        # chokepoint is the boundary, not the plumbing behind it).
+        findings: dict[tuple, set] = {}  # (rel, line, callee) -> roots
+        for root, entries in roots.items():
+            seen: set[tuple] = set()
+            stack = [(e, False) for e in entries if e in self.graph.funcs]
+            while stack:
+                fid, covered = stack.pop()
+                if (fid, covered) in seen:
+                    continue
+                seen.add((fid, covered))
+                fn = self.graph.funcs[fid]
+                for key, line, site_cov in sites.get(fid, ()):
+                    eff = covered or site_cov
+                    for callee in CallGraph.callee_ids(key):
+                        if callee not in self.graph.funcs:
+                            continue
+                        if is_client.get(callee) and not is_client.get(fid):
+                            if not eff:
+                                short = callee.rsplit(".", 1)[-1]
+                                findings.setdefault(
+                                    (fn.rel, line, short), set()
+                                ).add(root)
+                            continue
+                        if (callee, eff) not in seen:
+                            stack.append((callee, eff))
+
+        file_of = self.graph.file_of
+        for (rel, line, callee), from_roots in sorted(findings.items()):
+            f = file_of.get(rel)
+            if f is not None and f.waive(self.rule, line):
+                continue
+            root_names = ", ".join(
+                sorted({r.rsplit(".", 1)[-1] if "." in r else r
+                        for r in from_roots})
+            )
+            yield Violation(
+                rule=self.rule, path=rel, line=line,
+                message=f"peer RPC {callee}() reachable from thread "
+                        f"root(s) {root_names} with no deadline scope on "
+                        "the path",
+                hint="open `with deadline_scope(Deadline(budget)):` at "
+                     "the operation boundary, or waive naming the "
+                     "control-plane path: # lint: allow-deadline-scope("
+                     "control-plane <path>: <why>)",
+            )

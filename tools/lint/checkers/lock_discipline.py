@@ -21,13 +21,11 @@ statically over the WHOLE package:
    breaker registry, and histogram observe paths stay lock-cheap by
    CONSTRUCTION, and this rule keeps them that way.
 
-Static analysis of dynamic Python is an under-approximation by nature:
-attribute calls resolve to the enclosing class first, then by unique
-name project-wide, then by a small-union fallback; names too generic to
-resolve (dict.get, list.append, ...) are skipped. That misses exotic
-dispatch — it does NOT miss the `with self._lock: self.other_method()`
-patterns real deadlocks are made of. False positives get a reasoned
-waiver at the `with` site.
+The call graph + lock inventory live in tools/lint/callgraph.py, shared
+with the shared-state and deadline-scope rules (ISSUE r13); see that
+module's docstring for the resolution contract and its deliberate
+under-approximation. False positives get a reasoned waiver at the
+`with` site.
 """
 
 from __future__ import annotations
@@ -36,30 +34,8 @@ import ast
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
+from tools.lint.callgraph import CallGraph, FuncInfo, LockIndex
 from tools.lint.core import Checker, SourceFile, Violation, dotted_name
-
-#: Attribute/method names far too generic to resolve by name union —
-#: resolving `d.get(...)` to some class's `get` method would invent
-#: call-graph edges (and from them, phantom deadlocks).
-_GENERIC_NAMES = {
-    "get", "set", "pop", "popitem", "popleft", "appendleft", "items",
-    "keys", "values", "append", "extend", "insert", "remove", "sort",
-    "reverse", "copy", "clear", "update", "setdefault", "add",
-    "discard", "count", "index", "join", "split", "rsplit", "strip",
-    "lstrip", "rstrip", "startswith", "endswith", "encode", "decode",
-    "format", "replace", "read", "write", "readline", "readlines",
-    "close", "flush", "open", "search", "match", "fullmatch",
-    "findall", "finditer", "sub", "group", "groups", "start", "end",
-    "partition", "rpartition", "lower", "upper", "title", "tolist",
-    "astype", "reshape", "sum", "max", "min", "any", "all", "mean",
-    "nonzero", "item", "wait", "acquire", "release", "locked", "name",
-    "cancel", "put", "empty", "full", "qsize", "result", "submit",
-    "sleep", "is_set",
-    # DB-API cursor/connection methods (sqlite in store/): never the
-    # project's Executor.execute, which self-resolves above.
-    "execute", "executemany", "fetchone", "fetchall", "commit",
-    "rollback", "cursor",
-}
 
 #: Direct blocking operations (attribute name or dotted call).
 _BLOCKING_ATTRS = {
@@ -80,28 +56,11 @@ _BLOCKING_DOTTED = {
 #: .join() blocks only on thread-like receivers; "".join must not match.
 _JOIN_RECEIVER_HINTS = ("thread", "proc", "pool", "prewarm", "worker")
 
-_LOCK_CTORS = {
-    "threading.Lock": "Lock",
-    "threading.RLock": "RLock",
-    "threading.Condition": "Condition",
-}
-
 
 @dataclass
-class _Lock:
-    lock_id: str      # module.Class.attr | module.NAME | module.func.NAME
-    kind: str         # Lock | RLock | Condition
-    attr: str         # attribute / variable name
-    rel: str
-    line: int
+class _FnState:
+    """Per-function lock context collected by the scan."""
 
-
-@dataclass
-class _Func:
-    func_id: str                  # module.(Class.)name(.nested)
-    rel: str
-    node: ast.AST
-    cls: Optional[str]            # enclosing class name
     #: lock ids acquired directly anywhere in the body
     acquires: set = field(default_factory=set)
     #: (callee key, lineno, held lock ids at the call site)
@@ -110,14 +69,6 @@ class _Func:
     blocking: list = field(default_factory=list)
     #: (lock_id, lineno, held-before tuple) per with-site
     with_sites: list = field(default_factory=list)
-
-
-def _module_name(rel: str) -> str:
-    name = rel
-    for prefix in ("pilosa_tpu/",):
-        if name.startswith(prefix):
-            name = name[len(prefix):]
-    return name[:-3].replace("/", ".") if name.endswith(".py") else name
 
 
 class LockDisciplineChecker(Checker):
@@ -137,18 +88,12 @@ class LockDisciplineChecker(Checker):
     def finalize(self, files: list[SourceFile]) -> Iterable[Violation]:
         if not files:
             return
-        self.locks: dict[str, _Lock] = {}          # lock_id -> _Lock
-        self.attr_locks: dict[str, list[str]] = {} # attr name -> lock ids
-        self.funcs: dict[str, _Func] = {}
-        self.methods: dict[str, list[str]] = {}    # method name -> func ids
-        self.module_funcs: dict[tuple, str] = {}   # (module, name) -> id
-        self.class_methods: dict[tuple, str] = {}  # (class, name) -> id
-        self.file_of: dict[str, SourceFile] = {f.rel: f for f in files}
-
-        for f in files:
-            self._collect(f)
-        for fn in self.funcs.values():
-            self._scan_function(fn)
+        self.graph = CallGraph(files)
+        self.lock_index = LockIndex(files, self.graph)
+        self.file_of = self.graph.file_of
+        self.state: dict[str, _FnState] = {}
+        for fid, fn in self.graph.funcs.items():
+            self.state[fid] = self._scan_function(fn)
         # A waivered blocking site is accepted AT ITS SOURCE: drop it
         # before the fixpoint so callers of the waivered function aren't
         # re-flagged for a risk the waiver already owns (e.g. the native
@@ -156,175 +101,21 @@ class LockDisciplineChecker(Checker):
         # consume a waiver — a blocking call under NO lock was never a
         # violation, so a waiver there must surface as unused-waiver
         # instead of being silently eaten (code review r12).
-        for fn in self.funcs.values():
-            fn.blocking = [
-                (line, desc, held) for line, desc, held in fn.blocking
-                if not (held and self._waived(fn.rel, line))
+        for fid, st in self.state.items():
+            rel = self.graph.funcs[fid].rel
+            st.blocking = [
+                (line, desc, held) for line, desc, held in st.blocking
+                if not (held and self._waived(rel, line))
             ]
         trans_acq = self._transitive_acquires()
         trans_blk = self._transitive_blocking()
-        yield from self._emit(files, trans_acq, trans_blk)
+        yield from self._emit(trans_acq, trans_blk)
 
     def _waived(self, rel: str, line: int) -> bool:
         f = self.file_of.get(rel)
         return f is not None and f.waive(self.rule, line)
 
-    def _collect(self, f: SourceFile) -> None:
-        mod = _module_name(f.rel)
-
-        def add_lock(lock_id, kind, attr, line):
-            self.locks[lock_id] = _Lock(lock_id, kind, attr, f.rel, line)
-            self.attr_locks.setdefault(attr, []).append(lock_id)
-
-        def visit(body, path: str, cls: Optional[str]):
-            for stmt in body:
-                if isinstance(stmt, ast.ClassDef):
-                    visit(stmt.body, f"{path}.{stmt.name}" if path else stmt.name,
-                          stmt.name)
-                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    fid = f"{mod}.{path}.{stmt.name}" if path else f"{mod}.{stmt.name}"
-                    fn = _Func(func_id=fid, rel=f.rel, node=stmt, cls=cls)
-                    self.funcs[fid] = fn
-                    self.methods.setdefault(stmt.name, []).append(fid)
-                    if cls is not None:
-                        self.class_methods.setdefault(
-                            (cls, stmt.name), fid
-                        )
-                    else:
-                        self.module_funcs[(mod, stmt.name)] = fid
-                    # Lock assignments + nested defs inside the function.
-                    self._collect_fn_locks(stmt, fid, cls, mod, add_lock)
-                    visit(
-                        [s for s in stmt.body
-                         if isinstance(s, (ast.FunctionDef,
-                                           ast.AsyncFunctionDef,
-                                           ast.ClassDef))],
-                        f"{path}.{stmt.name}" if path else stmt.name,
-                        cls,
-                    )
-                elif isinstance(stmt, ast.Assign):
-                    kind = self._lock_ctor(stmt.value)
-                    if kind:
-                        for t in stmt.targets:
-                            if isinstance(t, ast.Name):
-                                add_lock(f"{mod}.{t.id}", kind, t.id,
-                                         stmt.lineno)
-
-        visit(f.tree.body, "", None)
-
-    def _collect_fn_locks(self, fn_node, fid, cls, mod, add_lock) -> None:
-        """Lock assignments in THIS function body only (nested defs get
-        their own pass with their own fid, so the id reflects the scope
-        the name actually lives in)."""
-        def walk_own(node):
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                      ast.ClassDef, ast.Lambda)):
-                    continue
-                yield child
-                yield from walk_own(child)
-
-        for n in walk_own(fn_node):
-            if not isinstance(n, ast.Assign):
-                continue
-            kind = self._lock_ctor(n.value)
-            if not kind:
-                continue
-            for t in n.targets:
-                if (
-                    isinstance(t, ast.Attribute)
-                    and isinstance(t.value, ast.Name)
-                    and t.value.id == "self"
-                    and cls is not None
-                ):
-                    add_lock(f"{mod}.{cls}.{t.attr}", kind, t.attr, n.lineno)
-                elif isinstance(t, ast.Name):
-                    # function-local lock (closure rendezvous)
-                    add_lock(f"{fid}.{t.id}", kind, t.id, n.lineno)
-
-    @staticmethod
-    def _lock_ctor(value: ast.AST) -> Optional[str]:
-        if isinstance(value, ast.Call):
-            return _LOCK_CTORS.get(dotted_name(value.func) or "")
-        return None
-
     # -- per-function scan --------------------------------------------------
-
-    def _resolve_lock(self, expr: ast.AST, fn: _Func) -> Optional[str]:
-        """lock id for a `with <expr>:` context, or None (not a lock)."""
-        if isinstance(expr, ast.Attribute):
-            attr = expr.attr
-            candidates = self.attr_locks.get(attr, [])
-            if not candidates:
-                return None
-            if (
-                isinstance(expr.value, ast.Name)
-                and expr.value.id == "self"
-                or fn.cls is not None
-            ):
-                # self.X — or a same-class alias like `r._lock` where r
-                # is the root instance: prefer the enclosing class's X.
-                for c in candidates:
-                    if f".{fn.cls}.{attr}" in c:
-                        return c
-            if len(candidates) == 1:
-                return candidates[0]
-            return None  # ambiguous attribute: don't invent edges
-        if isinstance(expr, ast.Name):
-            # innermost function-local, then enclosing funcs, then module
-            parts = fn.func_id.split(".")
-            for depth in range(len(parts), 0, -1):
-                cand = ".".join(parts[:depth]) + f".{expr.id}"
-                if cand in self.locks:
-                    return cand
-            mod = _module_name(fn.rel)
-            return f"{mod}.{expr.id}" if f"{mod}.{expr.id}" in self.locks else None
-        return None
-
-    def _resolve_call(self, call: ast.Call, fn: _Func) -> Optional[str]:
-        """callee func id, or None when unresolvable."""
-        mod = _module_name(fn.rel)
-        func = call.func
-        if isinstance(func, ast.Name):
-            fid = self.module_funcs.get((mod, func.id))
-            if fid:
-                return fid
-            # unique project-wide module function of that name
-            cands = [
-                v for (m, n), v in self.module_funcs.items() if n == func.id
-            ]
-            return cands[0] if len(cands) == 1 else None
-        if isinstance(func, ast.Attribute):
-            name = func.attr
-            # self.m() resolves by the enclosing class BEFORE the
-            # generic-name filter: Executor.execute is a real project
-            # method even though bare `.execute(` usually means a DB
-            # cursor.
-            if (
-                isinstance(func.value, ast.Name)
-                and func.value.id == "self"
-                and fn.cls is not None
-            ):
-                fid = self.class_methods.get((fn.cls, name))
-                if fid:
-                    return fid
-            if name in _GENERIC_NAMES or name.startswith("__"):
-                return None
-            cands = self.methods.get(name, [])
-            if len(cands) == 1:
-                return cands[0]
-            if 1 < len(cands) <= 4:
-                # Small SAME-MODULE union (e.g. StatsClient +
-                # NopStatsClient both define gauge): a synthetic union
-                # key resolved at fixpoint time. Cross-module unions are
-                # refused — merging roaring's Bitmap._put with the TPU
-                # cache's _put would smear device dispatch over the
-                # whole host bitmap layer and invent violations.
-                mods = {self.funcs[c].rel for c in cands if c in self.funcs}
-                if len(mods) == 1:
-                    return "|".join(sorted(cands))
-            return None
-        return None
 
     def _blocking_desc(self, call: ast.Call) -> Optional[str]:
         dn = dotted_name(call.func)
@@ -344,7 +135,9 @@ class LockDisciplineChecker(Checker):
             return "urlopen"
         return None
 
-    def _scan_function(self, fn: _Func) -> None:
+    def _scan_function(self, fn: FuncInfo) -> _FnState:
+        st = _FnState()
+
         def visit(node: ast.AST, held: tuple):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)):
@@ -353,10 +146,10 @@ class LockDisciplineChecker(Checker):
                 new = []
                 for item in node.items:
                     visit(item.context_expr, held)
-                    lock_id = self._resolve_lock(item.context_expr, fn)
+                    lock_id = self.lock_index.resolve(item.context_expr, fn)
                     if lock_id is not None:
-                        fn.acquires.add(lock_id)
-                        fn.with_sites.append(
+                        st.acquires.add(lock_id)
+                        st.with_sites.append(
                             (lock_id, item.context_expr.lineno, held)
                         )
                         new.append(lock_id)
@@ -367,31 +160,28 @@ class LockDisciplineChecker(Checker):
             if isinstance(node, ast.Call):
                 desc = self._blocking_desc(node)
                 if desc is not None:
-                    fn.blocking.append((node.lineno, desc, held))
+                    st.blocking.append((node.lineno, desc, held))
                 else:
-                    callee = self._resolve_call(node, fn)
+                    callee = self.graph.resolve_call(node, fn)
                     if callee is not None:
-                        fn.calls.append((callee, node.lineno, held))
+                        st.calls.append((callee, node.lineno, held))
             for child in ast.iter_child_nodes(node):
                 visit(child, held)
 
-        body = getattr(fn.node, "body", [])
-        for stmt in body:
+        for stmt in getattr(fn.node, "body", []):
             visit(stmt, ())
+        return st
 
     # -- fixpoints ----------------------------------------------------------
 
-    def _callee_ids(self, key: str) -> list[str]:
-        return key.split("|") if "|" in key else [key]
-
     def _transitive_acquires(self) -> dict[str, set]:
-        trans = {fid: set(fn.acquires) for fid, fn in self.funcs.items()}
+        trans = {fid: set(st.acquires) for fid, st in self.state.items()}
         changed = True
         while changed:
             changed = False
-            for fid, fn in self.funcs.items():
-                for key, _ln, _held in fn.calls:
-                    for callee in self._callee_ids(key):
+            for fid, st in self.state.items():
+                for key, _ln, _held in st.calls:
+                    for callee in CallGraph.callee_ids(key):
                         got = trans.get(callee)
                         if got and not got <= trans[fid]:
                             trans[fid] |= got
@@ -401,16 +191,16 @@ class LockDisciplineChecker(Checker):
     def _transitive_blocking(self) -> dict[str, Optional[str]]:
         """func id -> description of a blocking op reachable from it."""
         trans: dict[str, Optional[str]] = {}
-        for fid, fn in self.funcs.items():
-            trans[fid] = fn.blocking[0][1] if fn.blocking else None
+        for fid, st in self.state.items():
+            trans[fid] = st.blocking[0][1] if st.blocking else None
         changed = True
         while changed:
             changed = False
-            for fid, fn in self.funcs.items():
+            for fid, st in self.state.items():
                 if trans[fid]:
                     continue
-                for key, _ln, _held in fn.calls:
-                    for callee in self._callee_ids(key):
+                for key, _ln, _held in st.calls:
+                    for callee in CallGraph.callee_ids(key):
                         d = trans.get(callee)
                         if d:
                             short = callee.rsplit(".", 1)[-1]
@@ -423,10 +213,11 @@ class LockDisciplineChecker(Checker):
 
     # -- violations ---------------------------------------------------------
 
-    def _emit(self, files, trans_acq, trans_blk) -> Iterable[Violation]:
+    def _emit(self, trans_acq, trans_blk) -> Iterable[Violation]:
         edges: dict[tuple, list] = {}  # (A, B) -> [(rel, line)]
         emitted: set[tuple] = set()    # (rel, line, message) dedupe
         waived = self._waived
+        locks = self.lock_index.locks
 
         def once(v: Violation):
             key = (v.path, v.line, v.message)
@@ -434,15 +225,16 @@ class LockDisciplineChecker(Checker):
                 emitted.add(key)
                 yield v
 
-        for fid, fn in self.funcs.items():
+        for fid, st in self.state.items():
+            rel = self.graph.funcs[fid].rel
             # direct nesting edges + non-reentrant re-acquisition
-            for lock_id, line, held in fn.with_sites:
+            for lock_id, line, held in st.with_sites:
                 for h in held:
                     if h == lock_id:
-                        if self.locks[lock_id].kind == "Lock":
-                            if not waived(fn.rel, line):
+                        if locks[lock_id].kind == "Lock":
+                            if not waived(rel, line):
                                 yield from once(Violation(
-                                    rule=self.rule, path=fn.rel, line=line,
+                                    rule=self.rule, path=rel, line=line,
                                     message="re-acquires non-reentrant "
                                             f"lock {lock_id} already held",
                                     hint="guaranteed deadlock: use RLock "
@@ -450,21 +242,21 @@ class LockDisciplineChecker(Checker):
                                 ))
                     else:
                         edges.setdefault((h, lock_id), []).append(
-                            (fn.rel, line)
+                            (rel, line)
                         )
             # call-graph edges + blocking + re-entry through calls
-            for key, line, held in fn.calls:
+            for key, line, held in st.calls:
                 if not held:
                     continue
                 callee_acq = set()
-                for callee in self._callee_ids(key):
+                for callee in CallGraph.callee_ids(key):
                     callee_acq |= trans_acq.get(callee, set())
                 for h in held:
                     for b in callee_acq:
                         if b == h:
-                            if self.locks[b].kind == "Lock" and not waived(fn.rel, line):
+                            if locks[b].kind == "Lock" and not waived(rel, line):
                                 yield from once(Violation(
-                                    rule=self.rule, path=fn.rel, line=line,
+                                    rule=self.rule, path=rel, line=line,
                                     message=f"call re-enters non-reentrant "
                                             f"lock {b} through "
                                             f"{key.rsplit('.', 1)[-1]}()",
@@ -473,23 +265,23 @@ class LockDisciplineChecker(Checker):
                                 ))
                         else:
                             edges.setdefault((h, b), []).append(
-                                (fn.rel, line)
+                                (rel, line)
                             )
                 blk = None
-                for callee in self._callee_ids(key):
+                for callee in CallGraph.callee_ids(key):
                     blk = blk or trans_blk.get(callee)
-                if blk and not waived(fn.rel, line):
+                if blk and not waived(rel, line):
                     yield from once(Violation(
-                        rule=self.rule, path=fn.rel, line=line,
+                        rule=self.rule, path=rel, line=line,
                         message=f"blocking call under lock "
                                 f"{held[-1]}: {blk}",
                         hint="move the blocking work outside the locked "
                              "region (collect under lock, act after)",
                     ))
-            for line, desc, held in fn.blocking:
-                if held and not waived(fn.rel, line):
+            for line, desc, held in st.blocking:
+                if held and not waived(rel, line):
                     yield from once(Violation(
-                        rule=self.rule, path=fn.rel, line=line,
+                        rule=self.rule, path=rel, line=line,
                         message=f"blocking call under lock {held[-1]}: "
                                 f"{desc}",
                         hint="move the blocking work outside the locked "

@@ -1,0 +1,442 @@
+"""shared-state: whole-program race analysis over thread roots.
+
+The Go reference runs under `-race`; this is the static stand-in. The
+package spawns threads at ~25 sites (batcher leader/follower drain,
+snapshot rewriter, resize heartbeat/migration workers, broadcast
+fan-out, sync daemons, monitor/profiler loops, the thread-per-request
+HTTP plane). Every one is a ROOT; every function conservatively
+reachable from a root runs concurrently with every function reachable
+from a DIFFERENT root. Any piece of shared state — a `self.<attr>` or
+a module global — written from one root while another root writes or
+reads it, with no lock common to every access path, is a data race
+waiting for the right interleaving.
+
+What counts as a write:
+
+- augmented assignment (`self.n += 1`) — a read-modify-write, never
+  atomic;
+- assignment whose right-hand side reads the same attribute
+  (`self.n = self.n + 1`) — the same RMW spelled long-hand;
+- mutation of the referenced container: subscript stores
+  (`self.d[k] = v`), `del self.d[k]`, and mutating method calls
+  (`self.q.append(x)`, `self.s.add(y)`, ...).
+
+What is BLESSED (not a write):
+
+- plain assignment of an immutable value (None/bool/number/string,
+  tuple/frozenset literal or constructor): a single GIL-atomic
+  STORE_ATTR publishing an immutable object — the documented
+  immutable-swap idiom. Readers see the old value or the new one,
+  never a torn one.
+- any store inside `__init__`/`__post_init__`: construction
+  happens-before the object is handed to another thread (assign-once-
+  before-start).
+- accesses in functions no root reaches: setup code on the main thread
+  (cli wiring, daemon .start() methods) is sequenced before the threads
+  exist.
+
+Plain assignment of a MUTABLE value (`self.cache = {}`) from a root IS
+recorded as a write: the store itself is atomic, but a concurrent
+reader may mutate or iterate the old object while the writer swaps —
+whether that is safe is exactly the judgement a reasoned
+`# lint: allow-shared-state(...)` waiver should record.
+
+A common lock means: some one lock id is held (lexically, or at every
+call site leading to the function — the `always_held` intersection
+fixpoint) at EVERY access to the state key, across all roots.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from tools.lint.callgraph import (
+    CallGraph,
+    FuncInfo,
+    LockIndex,
+    collect_thread_roots,
+    module_name,
+    walk_own,
+)
+from tools.lint.core import Checker, SourceFile, Violation, dotted_name
+
+#: Method names that mutate their receiver in place. Calling one on a
+#: shared attribute is a write to that attribute's object.
+MUTATING_METHODS = {
+    "append", "appendleft", "add", "update", "pop", "popleft",
+    "popitem", "remove", "discard", "extend", "insert", "clear",
+    "setdefault", "sort", "reverse",
+}
+
+#: Constructors whose result is immutable: assigning one is an atomic
+#: publish (the blessed swap idiom). `next` covers the itertools.count
+#: atomic-generation idiom (`self.version = next(_counter)`).
+_IMMUTABLE_CTORS = {"tuple", "frozenset", "frozendict", "bool", "int",
+                    "float", "str", "bytes", "next", "len", "id"}
+
+#: Functions whose body is construction: stores there happen before the
+#: object escapes to another thread. `open` is this project's storage
+#: lifecycle hook — an object is published to the holder tree only
+#: AFTER open() returns (create_*_if_not_exists inserts under its lock),
+#: so open-time stores are sequenced before any concurrent access.
+#: These functions also act as a PUBLICATION BARRIER for reachability:
+#: code they call runs during construction, so roots do not "reach"
+#: shared state through them.
+_CTOR_FUNCS = {"__init__", "__post_init__", "__new__", "open"}
+
+@dataclass
+class _Access:
+    key: str          # module.Class.attr | module.GLOBAL
+    kind: str         # store | load
+    func_id: str
+    rel: str
+    line: int
+    held: tuple       # lock ids held lexically at the site
+
+
+class SharedStateChecker(Checker):
+    rule = "shared-state"
+    doc = ("state written from one thread root and touched from another "
+           "must share a lock on every access path (static -race)")
+    # Unscoped: the default tree is pilosa_tpu/ already; explicit paths
+    # (fixtures, --changed) must still be checkable.
+    scope = ("",)
+    cross_file = True
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        return ()  # whole-program analysis; see finalize
+
+    # -- access collection -------------------------------------------------
+
+    def _immutable_rhs(self, value: ast.AST) -> bool:
+        if isinstance(value, ast.Constant):
+            return True
+        if isinstance(value, ast.Tuple):
+            return all(self._immutable_rhs(e) for e in value.elts)
+        if isinstance(value, (ast.Compare, ast.BoolOp, ast.UnaryOp)):
+            return True
+        if isinstance(value, ast.IfExp):
+            return self._immutable_rhs(value.body) and self._immutable_rhs(
+                value.orelse
+            )
+        if isinstance(value, ast.Call):
+            root = value.func
+            name = (root.id if isinstance(root, ast.Name)
+                    else root.attr if isinstance(root, ast.Attribute)
+                    else "")
+            return name in _IMMUTABLE_CTORS
+        return False
+
+    def _self_attr(self, node: ast.AST, fn: FuncInfo) -> Optional[str]:
+        """state key for `self.<attr>`, skipping lock attributes (the
+        lock-discipline rule owns those) and threading.local attributes
+        (thread-confined by construction)."""
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and fn.cls is not None
+        ):
+            if node.attr in self.lock_attrs or node.attr in self.local_attrs:
+                return None
+            return f"{module_name(fn.rel)}.{fn.cls}.{node.attr}"
+        return None
+
+    def _scan_accesses(self, fn: FuncInfo) -> list[_Access]:
+        out: list[_Access] = []
+        blessed_ctor = fn.node.name in _CTOR_FUNCS
+        if blessed_ctor:
+            # Construction is sequenced before publication: neither its
+            # stores nor its loads race anything.
+            return out
+        globals_declared: set[str] = set()
+        for n in walk_own(fn.node):
+            if isinstance(n, ast.Global):
+                globals_declared.update(n.names)
+
+        def rec(key, kind, line, held):
+            out.append(_Access(key=key, kind=kind, func_id=fn.func_id,
+                               rel=fn.rel, line=line, held=held))
+
+        def mentions_attr(value: ast.AST, key: str) -> bool:
+            for sub in ast.walk(value):
+                if self._self_attr(sub, fn) == key:
+                    return True
+            return False
+
+        def store_target(t: ast.AST, line, held, value=None):
+            """One assignment target: attr store, subscript-on-attr
+            store, or declared-global store. (Ctor functions never get
+            here — the early return above skips their whole scan.)"""
+            if isinstance(t, ast.Tuple):
+                for e in t.elts:
+                    store_target(e, line, held, None)
+                return
+            key = self._self_attr(t, fn)
+            if key is not None:
+                if value is not None and self._immutable_rhs(value) \
+                        and not mentions_attr(value, key):
+                    return  # atomic publish of an immutable value
+                rec(key, "store", line, held)
+                return
+            if isinstance(t, (ast.Subscript, ast.Attribute)) and not isinstance(
+                t, ast.Name
+            ):
+                # self.d[k] = v / self.obj.field = v: mutation of the
+                # object a shared attribute references.
+                base = t.value
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    key = self._self_attr(base, fn)
+                    if key is not None:
+                        rec(key, "store", line, held)
+                        return
+                    base = base.value
+                return
+            if isinstance(t, ast.Name) and t.id in globals_declared:
+                mod = module_name(fn.rel)
+                if value is not None and self._immutable_rhs(value):
+                    return
+                rec(f"{mod}.{t.id}", "store", line, held)
+
+        def visit(node: ast.AST, held: tuple):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.With):
+                new = []
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    lock_id = self.lock_index.resolve(item.context_expr, fn)
+                    if lock_id is not None:
+                        new.append(lock_id)
+                inner = held + tuple(new)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    store_target(t, node.lineno, held, node.value)
+                visit(node.value, held)
+                return
+            if isinstance(node, ast.AugAssign):
+                store_target(node.target, node.lineno, held, None)
+                visit(node.value, held)
+                return
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    store_target(t, node.lineno, held, None)
+                return
+            if isinstance(node, ast.Call):
+                # self.q.append(x): mutation of the shared container.
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in MUTATING_METHODS:
+                    key = self._self_attr(node.func.value, fn)
+                    if key is not None:
+                        rec(key, "store", node.lineno, held)
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                key = self._self_attr(node, fn)
+                if key is not None:
+                    rec(key, "load", node.lineno, held)
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                    and node.id in self.mutable_globals.get(
+                        module_name(fn.rel), set()):
+                rec(f"{module_name(fn.rel)}.{node.id}", "load",
+                    node.lineno, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt, ())
+        return out
+
+    # -- always-held fixpoint ----------------------------------------------
+
+    def _always_held(self, roots: dict[str, set[str]],
+                     calls: dict[str, list]) -> dict[str, frozenset]:
+        """lock ids held at EVERY call path into each function
+        (intersection over call edges; root entries start empty-handed:
+        a fresh thread inherits no locks)."""
+        held: dict[str, Optional[frozenset]] = {}
+        entry_ids = set().union(*roots.values()) if roots else set()
+        for fid in entry_ids:
+            held[fid] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for fid, sites in calls.items():
+                base = held.get(fid)
+                if base is None:
+                    continue
+                for key, _line, site_held in sites:
+                    for callee in CallGraph.callee_ids(key):
+                        if callee not in self.graph.funcs:
+                            continue
+                        incoming = base | frozenset(site_held)
+                        prev = held.get(callee)
+                        nxt = incoming if prev is None else prev & incoming
+                        if nxt != prev:
+                            held[callee] = nxt
+                            changed = True
+        return {fid: h for fid, h in held.items() if h is not None}
+
+    # -- finalize ----------------------------------------------------------
+
+    def finalize(self, files: list[SourceFile]) -> Iterable[Violation]:
+        if not files:
+            return
+        self.graph = CallGraph(files)
+        self.graph.collect_calls()
+        self.lock_index = LockIndex(files, self.graph)
+        self.lock_attrs = set(self.lock_index.attr_locks)
+        self.file_of = self.graph.file_of
+
+        # threading.local() attributes are thread-confined by design.
+        self.local_attrs: set[str] = set()
+        for fn in self.graph.funcs.values():
+            for n in walk_own(fn.node):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    dn = dotted_name(n.value.func) or ""
+                    if dn in ("threading.local", "local"):
+                        for t in n.targets:
+                            if isinstance(t, ast.Attribute):
+                                self.local_attrs.add(t.attr)
+
+        # Mutable module globals: names some function re-binds via
+        # `global` (everything else at module scope is config/constants).
+        self.mutable_globals: dict[str, set[str]] = {}
+        for fn in self.graph.funcs.values():
+            for n in walk_own(fn.node):
+                if isinstance(n, ast.Global):
+                    self.mutable_globals.setdefault(
+                        module_name(fn.rel), set()
+                    ).update(n.names)
+
+        roots = collect_thread_roots(self.graph)
+        if not roots:
+            return
+        reach = {name: self._reachable(entries)
+                 for name, entries in roots.items()}
+
+        # Per-function lock-context call sites (for always_held) and
+        # accesses.
+        calls: dict[str, list] = {}
+        accesses: dict[str, list[_Access]] = {}
+        touched = set().union(*reach.values())
+        for fid in touched:
+            fn = self.graph.funcs[fid]
+            calls[fid] = self._scan_calls_with_locks(fn)
+            accesses[fid] = self._scan_accesses(fn)
+        always = self._always_held(roots, calls)
+
+        # Group accesses by state key, tagged with every root that
+        # reaches the access's function.
+        by_key: dict[str, list[tuple[str, _Access, frozenset]]] = {}
+        for root, fids in reach.items():
+            for fid in fids:
+                for acc in accesses.get(fid, ()):
+                    eff = frozenset(acc.held) | always.get(fid, frozenset())
+                    by_key.setdefault(acc.key, []).append((root, acc, eff))
+
+        for key in sorted(by_key):
+            recs = by_key[key]
+            store_roots = {r for r, a, _e in recs if a.kind == "store"}
+            all_roots = {r for r, _a, _e in recs}
+            if not store_roots:
+                continue
+            # racing = a store in one root plus any access in another.
+            if len(store_roots) < 2 and not (
+                store_roots and len(all_roots) > 1
+            ):
+                continue
+            common = None
+            for _r, _a, eff in recs:
+                common = eff if common is None else common & eff
+            if common:
+                continue  # one lock guards every access path
+            # Deterministic primary site: first store by (rel, line).
+            stores = sorted(
+                (a for _r, a, _e in recs if a.kind == "store"),
+                key=lambda a: (a.rel, a.line),
+            )
+            primary = stores[0]
+            f = self.file_of.get(primary.rel)
+            if f is not None and f.waive(self.rule, primary.line):
+                continue
+            others = sorted(
+                {(r, a.rel, a.line) for r, a, _e in recs
+                 if (a.rel, a.line) != (primary.rel, primary.line)},
+            )[:3]
+            root_names = ", ".join(
+                sorted({r.rsplit(".", 1)[-1] if "." in r else r
+                        for r in all_roots})
+            )
+            detail = "; ".join(
+                f"{r.rsplit('.', 1)[-1] if '.' in r else r} at {rel}:{line}"
+                for r, rel, line in others
+            )
+            yield Violation(
+                rule=self.rule, path=primary.rel, line=primary.line,
+                message=f"shared state {key} written here and touched "
+                        f"from other thread roots ({root_names}) with no "
+                        f"common lock ({detail})",
+                hint="guard every access with one lock, or publish via "
+                     "immutable swap; if the interleaving is provably "
+                     "safe, waive with the reason: "
+                     "# lint: allow-shared-state(<why>)",
+            )
+
+    def _reachable(self, entries: set[str]) -> set[str]:
+        """graph.reachable with the publication barrier: construction
+        functions (_CTOR_FUNCS) do not propagate concurrency — the code
+        they call runs before the object is handed to another thread.
+        (A thread whose TARGET is a ctor func still propagates.)"""
+        seen: set[str] = set()
+        stack = [e for e in entries if e in self.graph.funcs]
+        while stack:
+            fid = stack.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            fn = self.graph.funcs[fid]
+            if fn.node.name in _CTOR_FUNCS and fid not in entries:
+                continue
+            for key, _ln in fn.calls:
+                for callee in CallGraph.callee_ids(key):
+                    if callee in self.graph.funcs and callee not in seen:
+                        stack.append(callee)
+        return seen
+
+    def _scan_calls_with_locks(self, fn: FuncInfo) -> list:
+        """(callee key, line, held lock ids) per call site — the lock-
+        aware variant of FuncInfo.calls, for the always_held fixpoint."""
+        sites: list = []
+
+        def visit(node: ast.AST, held: tuple):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.With):
+                new = []
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    lock_id = self.lock_index.resolve(item.context_expr, fn)
+                    if lock_id is not None:
+                        new.append(lock_id)
+                inner = held + tuple(new)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                key = self.graph.resolve_call(node, fn)
+                if key is not None:
+                    sites.append((key, node.lineno, held))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt, ())
+        return sites

@@ -36,6 +36,14 @@ REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 #: when named explicitly (fixtures are known-bad on purpose).
 DEFAULT_TREE = "pilosa_tpu"
 
+#: The waiver-ratchet ledger: the committed per-rule census of
+#: `# lint: allow-<rule>(...)` waivers in the default tree. Full-tree
+#: runs fail when the live census differs — adding a waiver is a
+#: deliberate reviewed act (bump the ledger in the same commit), and
+#: removing one ratchets the ledger down so it can never drift into a
+#: standing pile of unexamined permissions.
+WAIVER_LEDGER = Path(__file__).resolve().parent / "waivers.lock"
+
 _WAIVER_RE = re.compile(
     r"allow-(?P<rule>[a-z][a-z0-9-]*)"
     r"(?:\((?P<reason>[^()]*)\))?"
@@ -209,8 +217,13 @@ def _git_changed_files() -> list[Path]:
         name = line[3:].split(" -> ")[-1].strip().strip('"')
         if not name.endswith(".py"):
             continue
-        if name.startswith("tests/lint_fixtures/"):
-            continue  # deliberately-bad fixtures are never lint targets
+        if not name.startswith(DEFAULT_TREE + "/"):
+            # Fast mode is a SUBSET of the default gate: changed test/
+            # tool files were never lint targets, and feeding them to
+            # the whole-program rules (shared-state's root inventory,
+            # the lock graph) manufactures roots/edges the real tree
+            # doesn't have.
+            continue
         p = REPO_ROOT / name
         if p.exists():
             paths.append(p)
@@ -234,6 +247,70 @@ def collect_files(
                 out.append(p)
         return out
     return sorted((REPO_ROOT / DEFAULT_TREE).rglob("*.py"))
+
+
+def read_waiver_ledger(path: Optional[Path] = None) -> Optional[dict[str, int]]:
+    """rule -> allowed waiver count, or None when the ledger is absent."""
+    p = path or WAIVER_LEDGER
+    if not p.exists():
+        return None
+    out: dict[str, int] = {}
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        rule, _, count = line.partition(" ")
+        try:
+            out[rule] = int(count)
+        except ValueError:
+            continue  # malformed line: surfaces as a census mismatch
+    return out
+
+
+def waiver_census(files: Iterable[SourceFile]) -> dict[str, int]:
+    """Live per-rule waiver counts over the given files."""
+    census: dict[str, int] = {}
+    for f in files:
+        for w in f.waivers:
+            census[w.rule] = census.get(w.rule, 0) + 1
+    return census
+
+
+def _ratchet_violations(files: list[SourceFile]) -> list[Violation]:
+    """Census-vs-ledger drift. Judged only on full default-tree runs —
+    a subset run sees a partial census by construction."""
+    ledger = read_waiver_ledger()
+    rel = (str(WAIVER_LEDGER.relative_to(REPO_ROOT))
+           if WAIVER_LEDGER.is_relative_to(REPO_ROOT)
+           else str(WAIVER_LEDGER))
+    if ledger is None:
+        return [Violation(
+            rule="waiver-ratchet", path=rel, line=1,
+            message="waiver ledger missing",
+            hint="create it from the live census: "
+                 "`python -m tools.lint --list-waivers`",
+        )]
+    census = waiver_census(files)
+    out = []
+    for rule in sorted(set(census) | set(ledger)):
+        have, allowed = census.get(rule, 0), ledger.get(rule, 0)
+        if have > allowed:
+            out.append(Violation(
+                rule="waiver-ratchet", path=rel, line=1,
+                message=f"{have} waiver(s) for {rule!r} in the tree but "
+                        f"the ledger records {allowed}",
+                hint="a new waiver is a reviewed decision: bump "
+                     f"{rel} in the same commit (or fix instead of "
+                     "waiving)",
+            ))
+        elif have < allowed:
+            out.append(Violation(
+                rule="waiver-ratchet", path=rel, line=1,
+                message=f"ledger records {allowed} waiver(s) for "
+                        f"{rule!r} but the tree has {have}",
+                hint=f"ratchet down: lower the {rule} count in {rel}",
+            ))
+    return out
 
 
 def run_lint(
@@ -272,14 +349,26 @@ def run_lint(
             continue
         violations.extend(f.waiver_errors)
     parsed = [f for f in files if f.tree is not None]
+    explicit_subset = bool(paths) or changed
     for checker in checkers:
+        if checker.cross_file and explicit_subset:
+            # Whole-program analyses are only sound on the whole
+            # program: a subset's narrower name-candidate sets resolve
+            # calls the full tree refuses, manufacturing roots/edges —
+            # and a waiver added for a subset-only phantom would read
+            # as unused on the real gate. Fixture tests drive these
+            # checkers through finalize() directly.
+            continue
         in_scope = [f for f in parsed if checker.in_scope(f)]
         for f in in_scope:
             violations.extend(checker.check_file(f))
         violations.extend(checker.finalize(in_scope))
     # Unused waivers: a permission nothing needed anymore is drift.
     # Judged only for rules whose checkers actually ran this invocation.
-    explicit_subset = bool(paths) or changed
+    if not explicit_subset and rules is None:
+        # Waiver ratchet (full unfiltered runs only): the census of
+        # suppressions must match the committed ledger exactly.
+        violations.extend(_ratchet_violations(parsed))
     for f in parsed:
         for w in f.waivers:
             if w.used or w.rule not in active_rules:
