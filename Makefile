@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-changed check fast-tests test
+.PHONY: lint lint-changed check fast-tests test bench-smoke
 
 lint:                    ## whole-tree pilint (the CI gate)
 	$(PY) -m tools.lint
@@ -25,3 +25,13 @@ fast-tests:              ## the fast subset alone (CI runs lint as its own step)
 
 test:                    ## full tier-1
 	$(PY) -m pytest -q
+
+# Tiny-shape bench end to end (ISSUE r13 satellite): every leg of the
+# artifact — including the mesh_scaling curve, whose children force
+# virtual CPU device counts themselves — runs under the same forced
+# 8-device CPU platform the test suite uses, so an artifact-zeroing
+# regression (crashed leg, renamed key) fails in CI instead of burning
+# a capture round.
+bench-smoke:             ## tiny-shape bench smoke incl. mesh_scaling keys
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) -m pytest -q tests/test_bench_smoke.py

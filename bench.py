@@ -158,6 +158,19 @@ ZIPF_SECONDS = float(os.environ.get("BENCH_ZIPF_SECONDS") or SECONDS)
 ZIPF_CACHE_BYTES = int(
     os.environ.get("BENCH_ZIPF_CACHE_BYTES", str(256 << 20))
 )
+# Mesh-scaling leg (ISSUE r13): device counts for the per-chip curve,
+# the leg's own (small, self-contained) shard count and row height, the
+# per-point measurement window, and the per-child subprocess timeout.
+# Each point runs in a SUBPROCESS so the device inventory can differ
+# per point (XLA fixes the platform device count at first import); on a
+# non-TPU parent the children force the virtual CPU platform.
+MESH_DEVICES = sorted(
+    int(c) for c in os.environ.get("BENCH_MESH_DEVICES", "1,2,4,8").split(",")
+)  # ascending: the monotonic-scaling verdict reads the curve in order
+MESH_SHARDS = int(os.environ.get("BENCH_MESH_SHARDS", "32"))
+MESH_ROWS = int(os.environ.get("BENCH_MESH_ROWS", "8"))
+MESH_SECONDS = float(os.environ.get("BENCH_MESH_SECONDS", "2"))
+MESH_CHILD_TIMEOUT = float(os.environ.get("BENCH_MESH_CHILD_TIMEOUT", "600"))
 # Rolling-restart drill (ISSUE r9): reader client count, settle window
 # between restarts, and the per-node reconvergence timeout.
 ROLLING_READERS = int(os.environ.get("BENCH_ROLLING_READERS", "4"))
@@ -426,6 +439,12 @@ LEG_COUNTER_FAMILIES = (
     "stack_pending_drains_total",
     "stack_incremental_",
     "stack_update_bytes_total",
+    # Mesh data plane (ISSUE r13): the under-churn point's proof is
+    # splice counters moving while full rebuilds stay flat, and any
+    # residual mesh-disabled tier names itself as a reason=mesh_*
+    # fallback.
+    "stack_full_rebuilds_total",
+    "device_fallback_total",
     "hbm_page_",
     "http_connection_aborts_total",
     "trace_spans_dropped_total",
@@ -1932,6 +1951,420 @@ def bench_rolling_restart() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# mesh_scaling leg (ISSUE r13): the per-chip scaling curve + the folded
+# MULTICHIP differential. Each device-count point runs in its own
+# subprocess (`bench.py --mesh-child N`) because XLA fixes the platform
+# device inventory at first import; on a non-TPU parent the children
+# force the virtual CPU platform with
+# XLA_FLAGS=--xla_force_host_platform_device_count=N (the same trick
+# tests/conftest.py uses), so the leg captures a curve on any container
+# while the shapes stay honest about what they are (env_note).
+# ---------------------------------------------------------------------------
+
+#: The folded MULTICHIP differential query set (every device-lowered
+#: family: Count over the bitwise verbs, Row materialization, exact
+#: TopN plain+filtered, BSI Sum/Min/Max, BSI range/between, GroupBy at
+#: 1/2/3 fields incl. filtered — the full framework path the standalone
+#: runner used to smoke-check).
+MESH_DIFFERENTIAL_QUERIES = [
+    "Count(Intersect(Row(f=1), Row(g=7)))",
+    "Count(Union(Row(f=1), Row(f=2), Row(f=3)))",
+    "Count(Not(Row(f=1)))",
+    "Row(f=2)",
+    "TopN(f, n=2)",
+    "TopN(f, Row(g=7), n=3)",
+    "Sum(field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
+    "Count(Row(v > 100))",
+    "Count(Row(v >< [-100, 100]))",
+    "GroupBy(Rows(f))",
+    "GroupBy(Rows(f), Rows(g))",
+    "GroupBy(Rows(f), Rows(g), filter=Row(f=2))",
+    "GroupBy(Rows(f), Rows(g), Rows(h))",
+]
+
+#: Per-epoch churn re-check set: every serving surface whose host
+#: stats tier absorbs write epochs must stay oracle-exact after each
+#: one (splice + delta tiers, mesh or not).
+MESH_CHURN_QUERIES = [
+    "TopN(f, n=0)",
+    "Rows(f)",
+    "Row(f=1)",
+    "Sum(field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
+    "GroupBy(Rows(f), Rows(g), Rows(h))",
+]
+
+
+def _mesh_build_holder(n_shards: int, rng) -> Holder:
+    """The mesh leg's self-contained in-memory holder — the same field
+    shapes as the standalone MULTICHIP runner it replaces (f/g row
+    fields, v BSI field, h small field), with column counts scaled to
+    the shard span so every shard carries real bits."""
+    from pilosa_tpu.core.field import options_for_int
+
+    h = Holder(None).open()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.create_field("v", options_for_int(-500, 500))
+    idx.create_field("h")
+    span = n_shards * SHARD_WIDTH
+    per_row = max(2000, 500 * n_shards)
+    for row in (1, 2, 3):
+        cols = np.unique(rng.integers(0, span, per_row, dtype=np.uint64))
+        idx.field("f").import_bits(np.full(cols.size, row, dtype=np.uint64), cols)
+        idx.existence_field().import_bits(
+            np.zeros(cols.size, dtype=np.uint64), cols
+        )
+    cols = np.unique(rng.integers(0, span, per_row, dtype=np.uint64))
+    idx.field("g").import_bits(np.full(cols.size, 7, dtype=np.uint64), cols)
+    cols = np.unique(rng.integers(0, span, per_row // 2, dtype=np.uint64))
+    idx.field("h").import_bits(
+        rng.integers(0, 2, cols.size, dtype=np.uint64), cols
+    )
+    cols = np.unique(rng.integers(0, span, per_row // 3, dtype=np.uint64))
+    idx.field("v").import_value(cols, rng.integers(-500, 501, cols.size))
+    return h
+
+
+def mesh_differential(holder, ex_cpu, ex_mesh, n_shards: int,
+                      churn_epochs: int = 2) -> int:
+    """Byte-identical mesh-vs-oracle differential across churn epochs
+    (the folded body of the standalone MULTICHIP runner,
+    __graft_entry__.dryrun_multichip): every query family, the batched
+    count path (backend + ShardLegBatcher), then churn_epochs rounds of
+    bit + value writes with every host-tier surface re-checked. Raises
+    AssertionError on the first mismatch; returns the number of
+    query comparisons made."""
+    from pilosa_tpu.exec.result import result_to_json
+
+    checked = 0
+    for q in MESH_DIFFERENTIAL_QUERIES:
+        want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+        got = [result_to_json(r) for r in ex_mesh.execute("i", q)]
+        assert got == want, (q, got, want)
+        checked += 1
+    be = ex_mesh.backend
+    calls = [
+        parse_string(f"Intersect(Row(f={r}), Row(g=7))").calls[0]
+        for r in (1, 2, 3)
+    ]
+    shards = list(range(n_shards))
+    singles = [
+        ex_cpu.execute("i", f"Count(Intersect(Row(f={r}), Row(g=7)))")[0]
+        for r in (1, 2, 3)
+    ]
+    assert be.count_batch("i", calls, shards) == singles
+    batcher = ShardLegBatcher(be, window=0.0)
+    assert batcher.count("i", calls, shards) == singles
+    # Second pass resolves from the host pair-stats cache and must agree.
+    assert batcher.count("i", calls, shards) == singles
+    checked += 3
+    idx = holder.index("i")
+    for k in range(churn_epochs):
+        idx.field("f").set_bit(1, 5 + k * 131)
+        idx.field("v").set_value(17 + k * 97, (-1) ** k * (450 - k))
+        got = batcher.count("i", calls, shards)
+        want = [
+            ex_cpu.execute("i", f"Count(Intersect(Row(f={r}), Row(g=7)))")[0]
+            for r in (1, 2, 3)
+        ]
+        assert got == want, (k, got, want)
+        for q in MESH_CHURN_QUERIES:
+            w = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+            g = [result_to_json(r) for r in ex_mesh.execute("i", q)]
+            assert g == w, (k, q, g, w)
+            checked += 1
+    return checked
+
+
+def run_mesh_differential(n_devices: int) -> dict:
+    """Standalone MULTICHIP-shaped check: build a holder, mesh it over
+    n devices, run the full differential. Returns the MULTICHIP_* key
+    shape ({n_devices, rc, ok, skipped, tail}) the round driver has
+    consumed since r1 — __graft_entry__.dryrun_multichip delegates
+    here, and the mesh_scaling leg embeds the same dict."""
+    import jax
+
+    from pilosa_tpu.exec.tpu import TPUBackend
+    from pilosa_tpu.parallel import ShardMesh
+
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        return {
+            "n_devices": n_devices, "rc": 0, "ok": None,
+            "skipped": f"need {n_devices} devices, have {len(devices)}",
+            "tail": "",
+        }
+    rng = np.random.default_rng(0)
+    n_shards = n_devices + 3  # non-multiple of n: exercises shard padding
+    holder = _mesh_build_holder(n_shards, rng)
+    try:
+        ex_cpu = Executor(holder)
+        ex_mesh = Executor(
+            holder,
+            backend=TPUBackend(holder, mesh=ShardMesh(devices[:n_devices])),
+        )
+        checked = mesh_differential(holder, ex_cpu, ex_mesh, n_shards,
+                                    churn_epochs=3)
+    except AssertionError as e:
+        return {
+            "n_devices": n_devices, "rc": 1, "ok": False, "skipped": False,
+            "tail": repr(e)[-800:],
+        }
+    finally:
+        holder.close()
+    return {
+        "n_devices": n_devices, "rc": 0, "ok": True, "skipped": False,
+        "tail": "", "queries_checked": checked,
+    }
+
+
+def _mesh_child(n_devices: int) -> dict:
+    """One scaling-curve point, run in its own process: qps and
+    device-only sweep time on an n-device mesh, the under-churn splice
+    proof, and the full differential — one JSON line on stdout."""
+    import jax
+
+    # The image's sitecustomize may pin the TPU platform; when the
+    # parent asked for virtual CPU devices, re-point config at cpu
+    # (same dance as tests/conftest.py / the old standalone runner).
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        return {
+            "n_devices": n_devices, "ok": None,
+            "skipped": f"need {n_devices} devices, have {len(devices)}",
+        }
+    from pilosa_tpu.exec.tpu import TPUBackend
+    from pilosa_tpu.parallel import ShardMesh
+
+    rng = np.random.default_rng(0)
+    holder = _mesh_build_holder(MESH_SHARDS, rng)
+    mesh = ShardMesh(devices[:n_devices]) if n_devices > 1 else None
+    be = TPUBackend(holder, mesh=mesh)
+    ex_mesh = Executor(holder, backend=be)
+    ex_cpu = Executor(holder)
+    shards = list(range(MESH_SHARDS))
+    shards_t = tuple(shards)
+    calls = [
+        parse_string(f"Intersect(Row(f={r}), Row(g=7))").calls[0]
+        for r in (1, 2, 3)
+    ]
+    base = leg_counter_snapshot()
+    out: dict = {
+        "n_devices": n_devices,
+        "devices_visible": len(devices),
+        "platform": jax.default_backend(),
+        "shards": MESH_SHARDS,
+        "skipped": None,
+    }
+    # Warm: stacks resident + programs compiled before anything is timed.
+    be.count_batch("i", calls, shards)
+    ex_mesh.execute("i", "Row(f=1)")
+
+    # Device-only sweep time: pipelined-chain slope over the pair-stats
+    # program on the resident f/g stacks (same technique and honesty
+    # contract as bench_sweep_device_only — the constant dispatch +
+    # readback cost cancels, leaving pure device execution; THE number
+    # that must fall as devices split the shard axis).
+    fblock, _ = be._get_block("i", be._field("i", "f"), shards_t)
+    gblock, _ = be._get_block("i", be._field("i", "g"), shards_t)
+    _, pershard_ok = be._pair_gates(
+        fblock.shape[0], fblock.shape[1], gblock.shape[1]
+    )
+    prog = be._pair_program(pershard=pershard_ok)
+    np.asarray(prog(fblock, gblock))  # compile + warm
+
+    def t_chain(k: int) -> float:
+        t0 = time.perf_counter()
+        outs = [prog(fblock, gblock) for _ in range(k)]
+        np.asarray(outs[-1])
+        return time.perf_counter() - t0
+
+    k1, k2 = 4, 16
+    slopes = sorted((t_chain(k2) - t_chain(k1)) / (k2 - k1) for _ in range(3))
+    out["sweep_ms_device_only"] = round(max(0.0, slopes[1]) * 1e3, 3)
+
+    # Device-bound qps: every batch pays a real pair-stats sweep (the
+    # host cache is cleared per batch), so the figure tracks the device
+    # path instead of the ~1.5M/s host-cache-hit ceiling.
+    n_done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < MESH_SECONDS or n_done == 0:
+        be._pair_cache.clear()
+        be.count_batch("i", calls, shards)
+        n_done += len(calls)
+    out["qps"] = round(n_done / (time.perf_counter() - t0), 1)
+
+    # Under-churn splice point: one dirty shard must splice O(slab)
+    # bytes into the resident (sharded) stack — never a full rebuild.
+    stack_bytes = int(np.prod(fblock.shape)) * 4
+    snap0 = leg_counter_snapshot()
+    holder.index("i").field("f").set_bit(1, 5)
+    ex_mesh.execute("i", "Row(f=1)")  # stack consumer: forces the refresh
+    delta, _ = leg_metrics_delta(snap0)
+    d = delta["counters"]
+    upd = int(d.get("stack_update_bytes_total", 0))
+    out["splice"] = {
+        "stack_bytes": stack_bytes,
+        "update_bytes": upd,
+        "incremental_updates": int(
+            d.get("stack_incremental_updates_total", 0)
+        ),
+        "full_rebuilds": int(d.get("stack_full_rebuilds_total", 0)),
+        # The O(slab) claim, evaluated where it's measured: the dirty
+        # epoch shipped real bytes, and strictly less than half the
+        # stack (a rebuild would ship all of it; the mesh path ships
+        # n_devices slabs per round, the single-device path one
+        # UPDATE_CHUNK of slabs).
+        "o_slab": 0 < upd <= stack_bytes // 2,
+    }
+
+    # Folded MULTICHIP differential (+2 churn epochs) on this same
+    # holder/backend — the correctness gate rides the curve point.
+    try:
+        out["queries_checked"] = mesh_differential(
+            holder, ex_cpu, ex_mesh, MESH_SHARDS, churn_epochs=2
+        )
+        out["ok"] = True
+    except AssertionError as e:
+        out["ok"] = False
+        out["differential_error"] = repr(e)[-800:]
+    delta, _ = leg_metrics_delta(base)
+    out["counters"] = delta["counters"]
+    holder.close()
+    return out
+
+
+def bench_mesh_scaling(checkpoint) -> dict:
+    """Parent side of the mesh_scaling leg: run one --mesh-child
+    subprocess per device count, checkpoint each point, and fold the
+    curve + the MULTICHIP-shaped differential dict into the summary."""
+    import subprocess
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    qps_at: dict[str, Optional[float]] = {}
+    sweep_at: dict[str, Optional[float]] = {}
+    children: dict[int, dict] = {}
+    for n in MESH_DEVICES:
+        child: dict = {}
+        tail = ""
+        if on_tpu:
+            # IN-PROCESS point: libtpu holds an exclusive per-process
+            # lock on the chips, so a subprocess could never initialize
+            # the TPU while this bench holds it — and none is needed:
+            # the device INVENTORY is fixed by the hardware, a point
+            # only has to mesh over the first n chips.
+            try:
+                child = _mesh_child(n)
+                rc = 0
+            except Exception as e:  # noqa: BLE001 — one failed point
+                # must not zero the leg (capture-proof contract)
+                rc = 1
+                tail = repr(e)[-800:]
+        else:
+            # SUBPROCESS point: virtual CPU platforms fix their device
+            # count at first jax import, so each count needs a fresh
+            # interpreter with its own forced inventory.
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                env.get("XLA_FLAGS", ""),
+            ).strip()
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--mesh-child", str(n)],
+                    env=env, capture_output=True, text=True,
+                    timeout=MESH_CHILD_TIMEOUT,
+                )
+                rc = proc.returncode
+                if rc == 0 and proc.stdout.strip():
+                    child = json.loads(proc.stdout.strip().splitlines()[-1])
+                else:
+                    tail = (proc.stderr or proc.stdout or "")[-800:]
+            except subprocess.TimeoutExpired:
+                rc = -1
+                tail = (
+                    f"mesh child n={n} timed out after {MESH_CHILD_TIMEOUT}s"
+                )
+        child.setdefault("n_devices", n)
+        child["rc"] = rc
+        if tail:
+            child["tail"] = tail
+        children[n] = child
+        key = str(n)
+        qps_at[key] = child.get("qps")
+        sweep_at[key] = child.get("sweep_ms_device_only")
+        checkpoint(
+            f"mesh@{n}",
+            **{
+                f"mesh_qps_at_{n}_devices": child.get("qps"),
+                f"mesh_sweep_ms_at_{n}_devices": child.get(
+                    "sweep_ms_device_only"
+                ),
+            },
+        )
+    n_max = max(children)
+    top = children[n_max]
+    q1 = qps_at.get("1")
+    qmax = qps_at.get(str(n_max))
+    sweeps = [v for v in sweep_at.values() if v is not None]
+    return {
+        "mesh_devices": MESH_DEVICES,
+        "mesh_qps_at_devices": qps_at,
+        "mesh_sweep_ms_device_only_at_devices": sweep_at,
+        "mesh_qps_scaling_vs_1": (
+            round(qmax / q1, 2) if q1 and qmax else None
+        ),
+        # Monotone along the curve = each added device made the
+        # device-only sweep no slower (the acceptance reading; expect
+        # it on real multi-chip hardware, not on a shared-core CPU
+        # container — see env_note).
+        "mesh_sweep_monotonic": (
+            all(a >= b for a, b in zip(sweeps, sweeps[1:]))
+            if len(sweeps) == len(MESH_DEVICES) and sweeps else None
+        ),
+        "mesh_splice": top.get("splice"),
+        "mesh_differential_ok_at_devices": {
+            str(n): c.get("ok") for n, c in children.items()
+        },
+        "mesh_child_counters": {
+            str(n): c.get("counters") for n, c in children.items()
+        },
+        # MULTICHIP_* keys preserved (the standalone runner's artifact
+        # shape, now one leg of the one bench artifact).
+        "multichip": {
+            "n_devices": n_max,
+            "rc": top.get("rc", -1),
+            "ok": top.get("ok"),
+            "skipped": top.get("skipped") or False,
+            "tail": top.get("tail", "") or top.get("differential_error", ""),
+        },
+        "mesh_env_note": (
+            None if on_tpu else
+            "virtual CPU devices (--xla_force_host_platform_device_count) "
+            "share this host's cores: the curve exercises the sharded "
+            "code path, not real per-chip bandwidth"
+        ),
+    }
+
+
 def main():
     out: dict = {
         "partial": True,
@@ -2157,6 +2590,7 @@ def main():
     checkpoint("degraded_qps", **bench_degraded_qps())
     checkpoint("ingest_under_load", **bench_ingest_under_load())
     checkpoint("rolling_restart", **bench_rolling_restart())
+    checkpoint("mesh_scaling", **bench_mesh_scaling(checkpoint))
 
     out.update(
         {
@@ -2173,4 +2607,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--mesh-child":
+        # One mesh_scaling curve point (spawned by bench_mesh_scaling;
+        # also runnable by hand for a single-shot mesh measurement).
+        print(json.dumps(_mesh_child(int(sys.argv[2]))))
+    else:
+        main()

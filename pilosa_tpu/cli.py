@@ -47,10 +47,37 @@ def cmd_server(args) -> int:
         try:
             from pilosa_tpu.exec.tpu import TPUBackend
 
+            # mesh-devices (ISSUE r13): shard the block stacks over a
+            # device mesh so the serving programs run under shard_map
+            # with ICI collectives. A count the platform cannot satisfy
+            # raises MeshConfigError — caught below like any unusable
+            # device, logged with the structured message — instead of
+            # silently under-sharding a node sized for more chips.
+            mesh = None
+            if cfg.mesh_devices:
+                import jax
+
+                from pilosa_tpu.parallel import MeshConfigError, ShardMesh
+
+                devices = jax.devices()
+                want = (
+                    len(devices) if cfg.mesh_devices < 0 else cfg.mesh_devices
+                )
+                if want > len(devices):
+                    raise MeshConfigError(
+                        f"mesh-devices={want} but only {len(devices)} "
+                        "devices are visible"
+                    )
+                if want > 1:
+                    mesh = ShardMesh(devices[:want])
             backend = TPUBackend(
-                holder, max_bytes=cfg.max_hbm_bytes or None
+                holder, mesh=mesh, max_bytes=cfg.max_hbm_bytes or None
             )
-            log.printf("executor=tpu: device backend enabled")
+            log.printf(
+                "executor=tpu: device backend enabled (%d device%s)",
+                mesh.n if mesh is not None else 1,
+                "s" if mesh is not None and mesh.n > 1 else "",
+            )
         except Exception as e:  # no usable device: fall back
             log.printf("executor=tpu unavailable (%s); falling back to cpu", e)
     executor = Executor(holder, backend=backend)

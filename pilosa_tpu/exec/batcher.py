@@ -47,6 +47,19 @@ attribute their whole cost to the `batch_wait` profile phase; the
 leader's dispatch work self-attributes (`device_dispatch` et al.) inside
 the backend calls it makes on behalf of the batch.
 
+Mesh composition (ISSUE r13): when the backend carries a ShardMesh,
+every launch this plane coalesces — count_batch/vec_batch scans, the
+pair-stats sweep, BSI aggregates, TopN popcounts — runs under
+shard_map on the sharded stacks with psum/all_gather merges over ICI;
+the leg descriptors, group keys, and power-of-two slot buckets are
+identical in both regimes (slot padding is a query-axis concern,
+orthogonal to the shard axis the mesh splits), so nothing here
+branches on topology. Coalescing matters MORE under a mesh: each
+launch is a collective across every chip, so the per-launch overhead
+the leader/follower drain amortizes is multiplied by the device
+count. The backend's [Q, S, W] row-batch byte cap is per-device there
+(exec/tpu.py row_batch_async), so mesh row groups chunk n-fold less.
+
 Error isolation: a failed group dispatch retries each member leg
 individually so one client's bad query (unknown field, unsupported
 shape) errors only that client, never the whole window. Only Exception

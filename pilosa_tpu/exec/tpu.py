@@ -83,7 +83,9 @@ from pilosa_tpu.ops.kernels import (
     nary_stats_pershard,
     pair_stats,
     pair_stats_pershard,
+    splice_shard_slabs,
 )
+from pilosa_tpu.parallel.mesh import pad_to_multiple
 from pilosa_tpu.ops.sparse import (
     MIN_CHUNKED_WORDS,
     ChunkedStackBuilder,
@@ -156,10 +158,26 @@ class _StackedBlocks:
     #: first time each count appeared; larger epochs chain this program.
     UPDATE_CHUNK = 8
 
-    def __init__(self, device=None, mesh=None, max_bytes: Optional[int] = None):
+    #: Mesh splice round width PER DEVICE: each round ships one slab per
+    #: device (sharded placement — a device receives only its own slab)
+    #: and one dispatch of the shard_map splice program, so a single
+    #: dirty shard costs O(n_devices) slabs of wire, never O(all
+    #: shards). Wider chunks would multiply the padding wire by the
+    #: device count for no dispatch saving at realistic dirty rates.
+    MESH_UPDATE_CHUNK = 1
+
+    def __init__(self, device=None, mesh=None, max_bytes: Optional[int] = None,
+                 fallback=None):
         self.device = device
         self.mesh = mesh  # ShardMesh or None
         self.max_bytes = max_bytes
+        # Mesh-tier degradation counter (ISSUE r13 satellite: mesh gaps
+        # must not be silent): called with (reason, shape, err) whenever
+        # a mesh-specific fast path bails to the dense/rebuild behavior.
+        # TPUBackend wires its _count_device_fallback here.
+        self._fallback = fallback if fallback is not None else (
+            lambda reason, shape, err: None
+        )
         # key -> (fingerprint, device array, rows_p, per-shard versions).
         self._entries: dict[tuple, tuple[tuple, object, int, Optional[tuple]]] = {}
         self.evictions = 0
@@ -185,8 +203,10 @@ class _StackedBlocks:
     def _pad_shards(self, n: int) -> int:
         if self.mesh is None or self.mesh.n <= 1:
             return n
-        m = self.mesh.n
-        return ((n + m - 1) // m) * m
+        # Shared with ShardMesh.put so both placements agree on the
+        # padded shard axis (zero slabs, semantically inert — see
+        # parallel/mesh.py for the padding contract).
+        return pad_to_multiple(n, self.mesh.n)
 
     def _put(self, host: np.ndarray):
         if self.mesh is not None and self.mesh.n > 1:
@@ -243,6 +263,13 @@ class _StackedBlocks:
                 # blowing HBM. Not cached (None entries are cheap to
                 # recompute and must not evict real stacks).
                 return None, rows_p, vers, None
+            if stale is not None:
+                # A resident stack is being fully re-packed + re-shipped
+                # — the cost the incremental splice exists to avoid. The
+                # mesh differential suite and the bench's under-churn
+                # point assert this stays flat while splices absorb
+                # write epochs.
+                global_stats.count("stack_full_rebuilds_total")
             # Ledger tier attribution, full builds only: which source
             # containers back the resident words (independent of the
             # WIRE tier each chunk chose — the ledger answers "what
@@ -256,6 +283,7 @@ class _StackedBlocks:
                     tiers[0] += a
                     tiers[1] += r
             shape = (s_pad, rows_p, WORDS_PER_SHARD)
+            arr = None
             if self.mesh is None and (nbytes // 4) >= MIN_CHUNKED_WORDS:
                 # Streaming packed upload (VERDICT r4 #1): shard slabs
                 # compress and ship as they pack, so the wire rides
@@ -278,30 +306,64 @@ class _StackedBlocks:
                         builder.skip(slab_words)
                 builder.skip((s_pad - len(shards)) * slab_words)
                 arr = builder.finish()
-            else:
+            elif self.mesh is not None and (nbytes // 4) >= MIN_CHUNKED_WORDS:
+                # Sharded streaming build (ISSUE r13 tentpole 2): one
+                # container-tier ChunkedStackBuilder per mesh device
+                # assembles that device's shard sub-stack, and the
+                # committed sub-arrays stitch into the sharded global
+                # with make_array_from_single_device_arrays — mesh cold
+                # builds ship the same u16-position/run-span wire as
+                # single-device ones instead of a host-dense slab.
+                sub_words = (s_pad // self.mesh.n) * rows_p * WORDS_PER_SHARD
+                if sub_words >= MIN_CHUNKED_WORDS:
+                    try:
+                        arr = self._sharded_stream_build(
+                            frags, shards, rows_p, s_pad
+                        )
+                    except Exception as e:  # noqa: BLE001 — degrade to
+                        # the dense host pack below, counted + logged:
+                        # a stitch/placement failure must serve slow,
+                        # never 500 (same contract as the Mosaic paths).
+                        self._fallback("mesh_stream", shape, e)
+                else:
+                    # Per-device share too small to chunk (padding waste
+                    # would exceed the wire saving) while a single-device
+                    # stack this size WOULD stream — a residual mesh gap,
+                    # visible on /metrics rather than silent.
+                    self._fallback(
+                        "mesh_stream", shape,
+                        "per-device sub-stack below MIN_CHUNKED_WORDS",
+                    )
+            if arr is None:
                 host = np.zeros(shape, dtype=np.uint32)
                 for i, s in enumerate(shards):
                     fr = frags[s]
                     if fr is not None:
                         host[i] = pack_fragment(fr, n_rows=rows_p)
                 arr = self._put(host)
-            if self.mesh is None and nbytes >= (64 << 20):
+            if nbytes >= (64 << 20):
                 # Identity-splice warmup: compile the epoch-update scatter
                 # NOW, while the build already costs seconds — the first
                 # write of a serving window must not stall on XLA compile
                 # (it wedged a whole churn window before this). Zero
-                # payloads: only the SHAPES matter for the compile.
-                ix = np.minimum(
-                    np.arange(self.UPDATE_CHUNK, dtype=np.int32), s_pad - 1
-                )
-                slabs0 = np.zeros(
-                    (self.UPDATE_CHUNK, rows_p, WORDS_PER_SHARD), np.uint32
-                )
-                self._warm_update_fn(shape)(
-                    arr,
-                    jax.device_put(slabs0, self.device),
-                    jax.device_put(ix, self.device),
-                )
+                # payloads: only the SHAPES matter for the compile. Under
+                # a mesh the shard_map splice program warms the same way
+                # (valid=0 lanes: executed, content unchanged, result
+                # discarded).
+                if self.mesh is None:
+                    ix = np.minimum(
+                        np.arange(self.UPDATE_CHUNK, dtype=np.int32), s_pad - 1
+                    )
+                    slabs0 = np.zeros(
+                        (self.UPDATE_CHUNK, rows_p, WORDS_PER_SHARD), np.uint32
+                    )
+                    self._warm_update_fn(shape)(
+                        arr,
+                        jax.device_put(slabs0, self.device),
+                        jax.device_put(ix, self.device),
+                    )
+                else:
+                    self._warm_mesh_splice(arr, rows_p)
             return arr, rows_p, vers, tiers
 
         return self._cached_build(key, fingerprint, build)
@@ -316,9 +378,11 @@ class _StackedBlocks:
         caches keyed by array identity (pair/TopN stats) correctly treat
         the update as a fresh write epoch. Returns the updated device
         array, or None when a full rebuild is needed (first build, shape
-        change, too many dirty shards, or a mesh — sharded in-place
-        slices would gather over ICI)."""
-        if stale is None or self.mesh is not None:
+        change, too many dirty shards). Under a mesh the splice runs
+        inside shard_map with per-device slab placement — only the
+        owning device applies its slab, no ICI gather
+        (_splice_sharded)."""
+        if stale is None:
             return None
         old_fp, old_arr, old_rows_p, old_vers = stale
         if (
@@ -335,6 +399,20 @@ class _StackedBlocks:
             1, len(shards) // self.MAX_INCREMENTAL_FRACTION
         ):
             return None
+        if self.mesh is not None:
+            try:
+                return self._splice_sharded(
+                    old_arr, shards, frags, dirty, rows_p
+                )
+            except Exception as e:  # noqa: BLE001 — a shard_map splice
+                # failure (hardware-only compile/VMEM limits) degrades
+                # to the full rebuild, counted + logged so the
+                # regression is visible instead of shipping as a
+                # silently slow correct answer.
+                self._fallback(
+                    "mesh_splice", (old_arr.shape, len(dirty)), e
+                )
+                return None
         # Fixed-chunk scatters, chained: each chunk is one upload + one
         # dispatch of the SAME compiled program (warmed at build time —
         # see _warm_update_fn), so no epoch ever pays an XLA compile in
@@ -377,6 +455,128 @@ class _StackedBlocks:
             fn = jax.jit(lambda arr, sl, ix: arr.at[ix].set(sl))
             self._update_fns[shape] = fn
         return fn
+
+    def _mesh_update_fn(self):
+        """The shard_map dirty-shard splice (ops/kernels.py
+        splice_shard_slabs, ISSUE r13 tentpole 1): every operand sharded
+        P('shards'), so each device receives exactly its own slab/index
+        lane and applies it locally — the epoch update never moves
+        stack bytes over ICI. One jitted wrapper serves every stack
+        shape (jit retraces per shape; _warm_mesh_splice fronts the
+        compile for large stacks)."""
+        fn = self._update_fns.get("mesh")
+        if fn is None:
+            mesh = self.mesh
+            ax = P(mesh.axis)
+            fn = jax.jit(
+                shard_map(
+                    splice_shard_slabs,
+                    mesh=mesh.mesh,
+                    in_specs=(ax, ax, ax, ax),
+                    out_specs=ax,
+                    check_vma=False,
+                )
+            )
+            self._update_fns["mesh"] = fn
+        return fn
+
+    def _mesh_splice_args(self, slabs, idx, valid):
+        """Place one splice round's host operands with the stack's
+        shardings (each device gets only its own lane)."""
+        mesh = self.mesh
+        sh3 = NamedSharding(mesh.mesh, P(mesh.axis, None, None))
+        sh1 = NamedSharding(mesh.mesh, P(mesh.axis))
+        return (
+            jax.device_put(slabs, sh3),
+            jax.device_put(idx, sh1),
+            jax.device_put(valid, sh1),
+        )
+
+    def _warm_mesh_splice(self, arr, rows_p) -> None:
+        """Compile the mesh splice for this stack shape at build time
+        (all-invalid lanes: the program executes, content is unchanged,
+        the result is discarded) so the first write epoch of a serving
+        window never stalls on XLA."""
+        n = self.mesh.n
+        shape = (n * self.MESH_UPDATE_CHUNK, rows_p, WORDS_PER_SHARD)
+        self._mesh_update_fn()(
+            arr,
+            *self._mesh_splice_args(
+                np.zeros(shape, np.uint32),
+                np.zeros(shape[0], np.int32),
+                np.zeros(shape[0], np.uint32),
+            ),
+        )
+
+    def _splice_sharded(self, old_arr, shards, frags, dirty, rows_p):
+        """Mesh counterpart of the single-device chunk chain: dirty
+        shards group by OWNING DEVICE (contiguous blocks of the padded
+        shard axis), and each round ships one slab per device — placed
+        sharded, so a device's host->HBM wire carries only its own
+        dirty slabs — through one dispatch of the shard_map splice.
+        Rounds chain until the deepest per-device dirty list drains; a
+        single dirty shard costs one round (n_devices slabs of wire,
+        all but one of them zero padding) instead of a whole-stack
+        rebuild. Returns a NEW sharded array (identity = write-epoch
+        token, same contract as the single-device path)."""
+        s_pad = old_arr.shape[0]
+        n = self.mesh.n
+        s_local = s_pad // n
+        by_dev: dict[int, list[int]] = {}
+        for i in dirty:
+            by_dev.setdefault(i // s_local, []).append(i)
+        rounds = max(len(v) for v in by_dev.values())
+        fn = self._mesh_update_fn()
+        c = self.MESH_UPDATE_CHUNK
+        arr = old_arr
+        for r0 in range(0, rounds, c):
+            slabs = np.zeros((n * c, rows_p, WORDS_PER_SHARD), np.uint32)
+            idx = np.zeros(n * c, np.int32)
+            valid = np.zeros(n * c, np.uint32)
+            for d, items in by_dev.items():
+                for j in range(c):
+                    if r0 + j >= len(items):
+                        break
+                    i = items[r0 + j]
+                    fr = frags[shards[i]]
+                    if fr is not None:
+                        slabs[d * c + j] = pack_fragment(fr, n_rows=rows_p)
+                    idx[d * c + j] = i - d * s_local
+                    valid[d * c + j] = 1
+            arr = fn(arr, *self._mesh_splice_args(slabs, idx, valid))
+            global_stats.count("stack_update_bytes_total", slabs.nbytes)
+        global_stats.count("stack_incremental_updates_total")
+        global_stats.count("stack_incremental_shards_total", len(dirty))
+        return arr
+
+    def _sharded_stream_build(self, frags, shards, rows_p, s_pad):
+        """Per-device container-tier sub-stack assembly (ISSUE r13
+        tentpole 2): device d's ChunkedStackBuilder receives the shard
+        positions [d*s_local, (d+1)*s_local) — missing fragments and
+        the zero-slab padding tail are skip()s — and the finished
+        committed sub-arrays stitch into the NamedSharding(P('shards'))
+        global without any cross-device traffic."""
+        mesh = self.mesh
+        n = mesh.n
+        s_local = s_pad // n
+        slab_words = rows_p * WORDS_PER_SHARD
+        shape_local = (s_local, rows_p, WORDS_PER_SHARD)
+        builders = [
+            ChunkedStackBuilder(dev, shape_local) for dev in mesh.devices
+        ]
+        for pos in range(s_pad):
+            b = builders[pos // s_local]
+            fr = frags.get(shards[pos]) if pos < len(shards) else None
+            if fr is not None:
+                b.feed_fragment(fr, rows_p)
+            else:
+                b.skip(slab_words)
+        parts = [b.finish() for b in builders]
+        return jax.make_array_from_single_device_arrays(
+            (s_pad, rows_p, WORDS_PER_SHARD),
+            NamedSharding(mesh.mesh, P(mesh.axis, None, None)),
+            parts,
+        )
 
     def get_row(self, index: str, field_obj, shards: tuple[int, ...],
                 view_name: str, row_id: int):
@@ -930,7 +1130,15 @@ class TPUBackend:
         self.holder = holder
         self.cpu = CPUBackend(holder)
         self.mesh = mesh if (mesh is not None and mesh.n > 1) else None
-        self.blocks = _StackedBlocks(device, self.mesh, max_bytes)
+        # Fallback-counter state before the block store: _StackedBlocks
+        # routes its mesh-tier degradations (reason=mesh_*) through
+        # _count_device_fallback, which reads these.
+        self.stats = global_stats
+        self._fallback_logged: set = set()
+        self.logger = None
+        self.blocks = _StackedBlocks(
+            device, self.mesh, max_bytes, fallback=self._count_device_fallback
+        )
         self._fns: dict = {}
         self._fns_lock = threading.RLock()
         # Host-resident pair-stats cache: (index, fa, fb, shards) ->
@@ -968,18 +1176,16 @@ class TPUBackend:
         # request cost ~12% of serving CPU.
         self._plan_cache: dict = {}
         self._plan_lock = threading.Lock()
-        self.stats = global_stats
-        # Shapes whose device fast path already logged a fallback: the
-        # broad except sites must not be silent (VERDICT r3 weak #7 — a
-        # Mosaic VMEM failure and a logic error looked identical), but
-        # must also not log once per query.
-        self._fallback_logged: set = set()
-        self.logger = None
+        # Background-compile the fixed-shape sparse-upload programs so
+        # a cold stack build never pays their XLA compile on its
+        # critical path (ops/sparse.py; idempotent per device). Under a
+        # mesh every device runs its own sub-stack builder (ISSUE r13
+        # tentpole 2), so each warms its own program set.
         if self.mesh is None:
-            # Background-compile the fixed-shape sparse-upload programs
-            # so a cold stack build never pays their XLA compile on its
-            # critical path (ops/sparse.py; idempotent per device).
             warm_chunk_programs(self.blocks.device)
+        else:
+            for dev in self.mesh.devices:
+                warm_chunk_programs(dev)
 
     def _count_device_fallback(self, reason: str, shape, err) -> None:
         """Count (and log once per shape) a device-fast-path fallback so
@@ -2637,7 +2843,7 @@ class TPUBackend:
         """The stack shapes a dispatch for these fields WILL use —
         computable from fragment heights without packing anything, so
         the sweep program can AOT-compile while the stacks build."""
-        s = len(shards_t)
+        s = self.blocks._pad_shards(len(shards_t))
         shapes = []
         for v in views:
             n_rows = 1
@@ -2661,9 +2867,11 @@ class TPUBackend:
         host: point writes delta-apply against probes of the other
         fields, anything else re-derives just the dirty shards' rows —
         no stack fetch, no device round trip, same two-tier design and
-        exactness discipline as the pair table."""
-        if self.mesh is not None:
-            return None
+        exactness discipline as the pair table. Mesh-capable since
+        ISSUE r13: the cold sweep runs the nary pershard kernel under
+        shard_map (per-device shard chunks, output gathered once at
+        readback) and the host table then absorbs churn exactly as on
+        one chip."""
         fobjs = [fo for _, fo in fields]
         if len({id(f) for f in fobjs}) != len(fobjs):
             return None  # repeated field: delta ordering is ambiguous
@@ -2755,11 +2963,37 @@ class TPUBackend:
         def flat(fb, gb, *extras):
             return nary_stats_pershard(fb, gb, extras, interpret=interpret)
 
-        fn = (
-            jax.jit(flat)
-            .lower(*[jax.ShapeDtypeStruct(s, jnp.uint32) for s in shapes])
-            .compile()
-        )
+        if self.mesh is None:
+            fn = (
+                jax.jit(flat)
+                .lower(*[jax.ShapeDtypeStruct(s, jnp.uint32) for s in shapes])
+                .compile()
+            )
+        else:
+            # Mesh variant (ISSUE r13 tentpole 3): the kernel runs on
+            # each device's local shard chunk and the per-shard output
+            # [K, S, rf, rg] stays sharded on its shard axis (dim 1);
+            # the dispatch's np.asarray readback gathers it once, cold,
+            # and the host table absorbs every later epoch. AOT-lowered
+            # against sharded avals so the prewarm thread really
+            # compiles (same contract as the single-device branch).
+            mesh = self.mesh
+            body = shard_map(
+                flat,
+                mesh=mesh.mesh,
+                in_specs=(P(mesh.axis),) * len(shapes),
+                out_specs=P(None, mesh.axis),
+                check_vma=False,
+            )
+            sharding = NamedSharding(mesh.mesh, P(mesh.axis, None, None))
+            fn = (
+                jax.jit(body)
+                .lower(*[
+                    jax.ShapeDtypeStruct(s, jnp.uint32, sharding=sharding)
+                    for s in shapes
+                ])
+                .compile()
+            )
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -2785,7 +3019,11 @@ class TPUBackend:
             k_total *= rh
         d_stats = k_total * rs[0] * rs[1]
         s_pad = stacks[0].shape[0]
-        if s_pad > MAX_PAIR_SHARDS or d_stats > (1 << 16):
+        # The int32 accumulator bound applies to what the KERNEL sees:
+        # the whole shard axis on one chip, the per-device chunk under a
+        # mesh (shard_map splits the axis before the kernel runs).
+        s_kernel = s_pad // (self.mesh.n if self.mesh is not None else 1)
+        if s_kernel > MAX_PAIR_SHARDS or d_stats > (1 << 16):
             return None
         if s_pad * d_stats * 4 > self.MAX_PAIR_PERSHARD_BYTES:
             return None  # table too big to retain: generic path sweeps
@@ -3198,7 +3436,14 @@ class TPUBackend:
                     slot_index[k] = len(unique)
                     unique.append(i)
                 slot_of[i] = slot_index[k]
-            slab_bytes = s_pad * WORDS_PER_SHARD * 4
+            # Per-DEVICE slab bytes: the cap guards device memory, and
+            # under a mesh the [Q, S, W] output is sharded over the
+            # shard axis so each device holds only its 1/n chunk — a
+            # whole-axis figure would shrink mesh launches n-fold below
+            # what the HBM actually permits.
+            slab_bytes = (
+                s_pad // (self.mesh.n if self.mesh is not None else 1)
+            ) * WORDS_PER_SHARD * 4
             # Rounded DOWN to a power of two: a full chunk's slot bucket
             # then equals per_chunk exactly, so bucket padding can never
             # inflate a launch past the byte cap it exists to enforce.

@@ -486,6 +486,41 @@ def expand_run_spans(acc, lo, hi, nnz):
 
 
 # ---------------------------------------------------------------------------
+# Sharded dirty-shard splice (ISSUE r13 tentpole 1): the per-device body
+# of the mesh incremental stack update. Runs INSIDE shard_map — every
+# operand is the device's local block of a NamedSharding(P('shards'))
+# placement, so splicing never gathers the stack over ICI; each device
+# applies only the slabs addressed to it.
+# ---------------------------------------------------------------------------
+
+
+def splice_shard_slabs(block, slabs, idx, valid):
+    """Splice dirty shard slabs into one device's local stack block.
+
+    block: uint32[S_local, R, W] — this device's shard slabs.
+    slabs: uint32[C, R, W] — replacement slabs for this device (padding
+        entries are ignored via `valid`).
+    idx: int32[C] — LOCAL shard positions (0..S_local-1) each slab
+        lands at; padding entries may hold any in-range value.
+    valid: uint32[C] — 1 for live slabs, 0 for padding.
+
+    Applied as a short sequential chain of predicated
+    dynamic_update_slice steps (C is a small fixed chunk), NOT one
+    scatter: a scatter with duplicate indices — a clamped padding entry
+    colliding with a live slab's slot — has undefined write order,
+    while the chain is deterministic (later entries win, and padding
+    entries rewrite the current content, a no-op). Returns a NEW array;
+    callers rely on the identity change as the write-epoch token."""
+    s_local = block.shape[0]
+    for j in range(slabs.shape[0]):
+        li = jnp.clip(idx[j], 0, s_local - 1)
+        cur = jax.lax.dynamic_slice_in_dim(block, li, 1, axis=0)
+        upd = jnp.where(valid[j] != 0, slabs[j][None], cur)
+        block = jax.lax.dynamic_update_slice_in_dim(block, upd, li, axis=0)
+    return block
+
+
+# ---------------------------------------------------------------------------
 # Ragged-occupancy slot masking (ISSUE r11 batching plane): the batched
 # serving programs in exec/tpu.py pad a group's query slots up to a fixed
 # slot-count bucket so a handful of compiled signatures serve any

@@ -150,6 +150,39 @@ def _dev_key(device) -> str:
     return f"default-{jax.default_backend()}"
 
 
+def _pin(device):
+    """SingleDeviceSharding for a NON-default device, else None. The AOT
+    `.lower().compile()` path binds an executable to the default device
+    unless the avals carry a sharding; per-device mesh sub-stack
+    builders (exec/tpu.py sharded cold build) need their programs
+    compiled FOR their device or every call would raise a committed-
+    operand/executable device mismatch."""
+    if device is None or device == jax.devices()[0]:
+        return None
+    from jax.sharding import SingleDeviceSharding
+
+    return SingleDeviceSharding(device)
+
+
+def _sds(shape, dtype, device):
+    """ShapeDtypeStruct pinned to `device` when it is non-default."""
+    pin = _pin(device)
+    if pin is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=pin)
+
+
+def _jit_out(fn, device, **kw):
+    """jax.jit with outputs pinned to `device` when non-default — the
+    zero-argument accumulator builders have no operand to carry the
+    placement, so the out_shardings pin is what lands them on the right
+    mesh device."""
+    pin = _pin(device)
+    if pin is not None:
+        kw["out_shardings"] = pin
+    return jax.jit(fn, **kw)
+
+
 def _get_prog(name, key, build):
     full = (name,) + key
     with _progs_lock:
@@ -195,8 +228,8 @@ def _chunk_prog(device, bucket: int):
         return (
             jax.jit(decompress)
             .lower(
-                jax.ShapeDtypeStruct((CHUNK_WORDS // 32,), jnp.uint32),
-                jax.ShapeDtypeStruct((bucket,), jnp.uint32),
+                _sds((CHUNK_WORDS // 32,), jnp.uint32, device),
+                _sds((bucket,), jnp.uint32, device),
             )
             .compile()
         )
@@ -218,9 +251,9 @@ def _place_prog(device, n_pad: int):
         return (
             jax.jit(place, donate_argnums=0)
             .lower(
-                jax.ShapeDtypeStruct((n_pad,), jnp.uint32),
-                jax.ShapeDtypeStruct((CHUNK_WORDS,), jnp.uint32),
-                jax.ShapeDtypeStruct((), jnp.int32),
+                _sds((n_pad,), jnp.uint32, device),
+                _sds((CHUNK_WORDS,), jnp.uint32, device),
+                _sds((), jnp.int32, device),
             )
             .compile()
         )
@@ -230,7 +263,9 @@ def _place_prog(device, n_pad: int):
 
 def _zeros_prog(device, n_pad: int):
     def build():
-        return jax.jit(lambda: jnp.zeros(n_pad, jnp.uint32)).lower().compile()
+        return _jit_out(
+            lambda: jnp.zeros(n_pad, jnp.uint32), device
+        ).lower().compile()
 
     return _get_prog("zeros", (_dev_key(device), n_pad), build)
 
@@ -249,7 +284,7 @@ def _final_prog(device, n_pad: int, shape: tuple):
         donate = (0,) if n == n_pad else ()
         return (
             jax.jit(final, donate_argnums=donate)
-            .lower(jax.ShapeDtypeStruct((n_pad,), jnp.uint32))
+            .lower(_sds((n_pad,), jnp.uint32, device))
             .compile()
         )
 
@@ -261,7 +296,9 @@ def _chunk_zeros_prog(device):
     n = CHUNK_WORDS
 
     def build():
-        return jax.jit(lambda: jnp.zeros(n, jnp.uint32)).lower().compile()
+        return _jit_out(
+            lambda: jnp.zeros(n, jnp.uint32), device
+        ).lower().compile()
 
     return _get_prog("chunk_zeros", (_dev_key(device), n), build)
 
@@ -275,8 +312,8 @@ def _or_prog(device):
         return (
             jax.jit(lambda a, b: a | b, donate_argnums=0)
             .lower(
-                jax.ShapeDtypeStruct((n,), jnp.uint32),
-                jax.ShapeDtypeStruct((n,), jnp.uint32),
+                _sds((n,), jnp.uint32, device),
+                _sds((n,), jnp.uint32, device),
             )
             .compile()
         )
@@ -295,10 +332,10 @@ def _pos_prog(device):
         return (
             jax.jit(expand_array_positions, donate_argnums=0)
             .lower(
-                jax.ShapeDtypeStruct((n,), jnp.uint32),
-                jax.ShapeDtypeStruct((p,), jnp.uint16),
-                jax.ShapeDtypeStruct((s,), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32),
+                _sds((n,), jnp.uint32, device),
+                _sds((p,), jnp.uint16, device),
+                _sds((s,), jnp.int32, device),
+                _sds((), jnp.int32, device),
             )
             .compile()
         )
@@ -317,10 +354,10 @@ def _run_prog(device):
         return (
             jax.jit(expand_run_spans, donate_argnums=0)
             .lower(
-                jax.ShapeDtypeStruct((n,), jnp.uint32),
-                jax.ShapeDtypeStruct((r,), jnp.int32),
-                jax.ShapeDtypeStruct((r,), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32),
+                _sds((n,), jnp.uint32, device),
+                _sds((r,), jnp.int32, device),
+                _sds((r,), jnp.int32, device),
+                _sds((), jnp.int32, device),
             )
             .compile()
         )

@@ -10,4 +10,4 @@ computed by shard_map-ed kernels, and reductions ride ICI collectives
 traffic remains RPC at the cluster layer (pilosa_tpu/cluster).
 """
 
-from pilosa_tpu.parallel.mesh import ShardMesh
+from pilosa_tpu.parallel.mesh import MeshConfigError, ShardMesh, pad_to_multiple

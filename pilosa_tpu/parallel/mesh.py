@@ -8,6 +8,14 @@ psum/all_gather them over ICI — the XLA-collective replacement for the
 reference's HTTP scatter-gather (SURVEY.md §2.2). The programs
 themselves live in exec/tpu.py (TPUBackend._program/_pair_program);
 this class is the topology object they build against.
+
+Padding contract: shard_map needs the leading (shard) axis divisible by
+the device count, so placements pad it up to the next multiple with
+ALL-ZERO slabs. Zero slabs are semantically inert everywhere the
+backend reduces — a zero bitmap word contributes nothing to any
+popcount, bitwise verb, BSI plane scan, or pair/group matrix cell — so
+padded positions never change an answer; hosts that slice results
+per-shard simply stop at the real shard count.
 """
 
 from __future__ import annotations
@@ -20,19 +28,50 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+class MeshConfigError(ValueError):
+    """A ShardMesh cannot be built from the given device set (empty
+    device list — e.g. a mesh-devices count larger than the platform
+    offers after slicing). Structured so callers can distinguish a
+    topology misconfiguration from a generic placement failure."""
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of m that is >= n — the shared shard-axis
+    padding rule (ShardMesh.put and exec/tpu._StackedBlocks._pad_shards
+    must agree, or a stack placed by one would mis-shape for the
+    other's programs)."""
+    if m <= 1:
+        return n
+    return ((n + m - 1) // m) * m
+
+
 class ShardMesh:
     def __init__(self, devices: Optional[Sequence] = None, axis: str = "shards"):
         if devices is None:
             devices = jax.devices()
+        devices = list(devices)
+        if not devices:
+            raise MeshConfigError(
+                "ShardMesh needs at least one device (got an empty device "
+                "list; check mesh-devices against the platform inventory)"
+            )
         self.axis = axis
-        self.devices = list(devices)
+        self.devices = devices
         self.mesh = Mesh(np.array(self.devices), (axis,))
         self.n = len(self.devices)
         self._sharding = NamedSharding(self.mesh, P(axis))
 
     def put(self, host_array: np.ndarray):
-        """Place a [n_shards, ...] stacked array sharded over the mesh."""
-        assert host_array.shape[0] % self.n == 0, (
-            f"leading dim {host_array.shape[0]} not divisible by {self.n} devices"
-        )
+        """Place a [n_shards, ...] stacked array sharded over the mesh.
+        A leading dim that isn't a multiple of the device count pads up
+        with zero slabs (see the module docstring's padding contract) —
+        callers keep indexing by their real shard positions and ignore
+        the tail."""
+        s = host_array.shape[0]
+        s_pad = pad_to_multiple(s, self.n)
+        if s_pad != s:
+            padded = np.zeros((s_pad,) + host_array.shape[1:],
+                              dtype=host_array.dtype)
+            padded[:s] = host_array
+            host_array = padded
         return jax.device_put(host_array, self._sharding)

@@ -158,6 +158,13 @@ class Config:
     # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
     # via row paging instead of whole-stack residency.
     max_hbm_bytes: int = 0
+    # Shard the HBM block stacks over this many devices with a
+    # jax.sharding.Mesh (parallel/mesh.py): programs run under
+    # shard_map with psum/all_gather merges over ICI, replacing
+    # intra-node scatter-gather (ISSUE r13). 0 = single device;
+    # -1 = every visible device; N > visible devices fails boot with a
+    # structured MeshConfigError rather than silently under-sharding.
+    mesh_devices: int = 0
     # -- latency SLO objectives (ISSUE r10) --------------------------------
     # Each objective: {metric, quantile, threshold_s, window_s} —
     # "quantile of <metric> must stay under threshold_s seconds over
@@ -257,6 +264,7 @@ class Config:
             "max-import-bytes": self.max_import_bytes,
             "max-pending-wal": self.max_pending_wal,
             "max-hbm-bytes": self.max_hbm_bytes,
+            "mesh-devices": self.mesh_devices,
             "max-result-cache-bytes": self.max_result_cache_bytes,
             "max-staleness": self.max_staleness,
             "cache-enabled": self.cache_enabled,
@@ -309,6 +317,7 @@ class Config:
             "max-import-bytes": "max_import_bytes",
             "max-pending-wal": "max_pending_wal",
             "max-hbm-bytes": "max_hbm_bytes",
+            "mesh-devices": "mesh_devices",
             "max-result-cache-bytes": "max_result_cache_bytes",
             "max-staleness": "max_staleness",
             "cache-enabled": "cache_enabled",
@@ -368,6 +377,7 @@ class Config:
             pre + "MAX_IMPORT_BYTES": ("max_import_bytes", int),
             pre + "MAX_PENDING_WAL": ("max_pending_wal", int),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
+            pre + "MESH_DEVICES": ("mesh_devices", int),
             pre + "MAX_RESULT_CACHE_BYTES": ("max_result_cache_bytes", int),
             pre + "MAX_STALENESS": ("max_staleness", int),
             pre + "CACHE_ENABLED": (
@@ -421,6 +431,7 @@ class Config:
             f"max-import-bytes = {c.max_import_bytes}\n"
             f"max-pending-wal = {c.max_pending_wal}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
+            f"mesh-devices = {c.mesh_devices}\n"
             f"max-result-cache-bytes = {c.max_result_cache_bytes}\n"
             f"max-staleness = {c.max_staleness}\n"
             f"cache-enabled = {str(c.cache_enabled).lower()}\n"

@@ -43,6 +43,12 @@ SMOKE_ENV = {
     "BENCH_ROLLING_READERS": "2",
     "BENCH_ROLLING_SETTLE": "0.3",
     "BENCH_ROLLING_CONVERGE_TIMEOUT": "45",
+    # Tiny mesh-scaling leg (r13): two curve points exercise the
+    # subprocess-per-device-count machinery, the folded MULTICHIP
+    # differential, and the under-churn splice counters — not a curve.
+    "BENCH_MESH_DEVICES": "1,2",
+    "BENCH_MESH_SHARDS": "8",
+    "BENCH_MESH_SECONDS": "0.3",
 }
 
 
@@ -100,11 +106,28 @@ def test_bench_smoke(tmp_path):
         assert len(blob["rolling_restart_windows"]) == 3
         assert all(w["reconverged"] for w in blob["rolling_restart_windows"])
         assert blob["rolling_restart_lost_writes"] == []
+    # The r13 mesh_scaling keys the driver's acceptance reads: the
+    # per-device curve, the folded MULTICHIP verdict (its historical
+    # key shape preserved), and the under-churn splice proof.
+    assert set(blob["mesh_qps_at_devices"]) == {"1", "2"}
+    assert set(blob["mesh_sweep_ms_device_only_at_devices"]) == {"1", "2"}
+    assert "mesh_sweep_monotonic" in blob
+    assert "mesh_qps_scaling_vs_1" in blob
+    mc = blob["multichip"]
+    assert set(mc) >= {"n_devices", "rc", "ok", "skipped", "tail"}
+    if not mc["skipped"]:
+        assert mc["ok"] is True and mc["rc"] == 0, mc
+        assert blob["mesh_differential_ok_at_devices"]["2"] is True
+        sp = blob["mesh_splice"]
+        # One dirty shard spliced O(slab) bytes — never a full rebuild.
+        assert sp["incremental_updates"] >= 1 and sp["full_rebuilds"] == 0, sp
+        assert sp["o_slab"] is True, sp
     # Every leg checkpointed along the way.
     for leg in ("build", "cold_build", "tpu_batch", "single_query",
                 "minmax_churn", "http", "qps@1", "qps@4",
                 "concurrency_sweep", "zipf@1", "zipf@4", "zipf_cache",
-                "ingest_under_load", "rolling_restart"):
+                "ingest_under_load", "rolling_restart",
+                "mesh@1", "mesh@2", "mesh_scaling"):
         assert leg in blob["legs_done"], blob["legs_done"]
     # The partial artifact also landed complete on disk.
     disk = json.loads(open(env["BENCH_PARTIAL_PATH"]).read())

@@ -1,0 +1,269 @@
+"""Mesh-vs-single-device equivalence suite (ISSUE r13).
+
+Runs on the forced 8-virtual-device CPU platform (tests/conftest.py):
+a sharded TPUBackend must answer every query family byte-identically
+to the CPU oracle AND to a single-device TPUBackend, across write-
+churn epochs — with the dirty-shard SPLICE (not a full rebuild)
+absorbing each epoch on the resident sharded stacks, asserted via the
+stack_incremental_updates_total / stack_full_rebuilds_total counters.
+
+Also the ShardMesh unit contract (ISSUE r13 satellite): pad-to-multiple
+zero-slab placement instead of the old divisibility assert, and a
+structured MeshConfigError on an empty device list.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+tpu_mod = pytest.importorskip(
+    "pilosa_tpu.exec.tpu", reason="device backend needs jax shard_map"
+)
+
+from pilosa_tpu.core import Holder  # noqa: E402
+from pilosa_tpu.core.field import options_for_int  # noqa: E402
+from pilosa_tpu.exec import Executor  # noqa: E402
+from pilosa_tpu.exec.batcher import ShardLegBatcher  # noqa: E402
+from pilosa_tpu.exec.result import result_to_json  # noqa: E402
+from pilosa_tpu.exec.tpu import TPUBackend  # noqa: E402
+from pilosa_tpu.parallel import (  # noqa: E402
+    MeshConfigError,
+    ShardMesh,
+    pad_to_multiple,
+)
+from pilosa_tpu.shardwidth import SHARD_WIDTH  # noqa: E402
+from pilosa_tpu.utils.stats import global_stats  # noqa: E402
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data")).open()
+    yield h
+    h.close()
+
+
+N_SHARDS = 11  # not a multiple of 8: exercises the zero-slab padding
+
+#: Every device-lowered query family (the acceptance list: Count, Row,
+#: Intersect, TopN, Sum, Min, Max, GroupBy — plus the verb/BSI variants
+#: that ride the same programs).
+FAMILIES = [
+    "Count(Row(f=1))",
+    "Count(Intersect(Row(f=1), Row(g=7)))",
+    "Count(Union(Row(f=1), Row(f=2), Row(f=3)))",
+    "Count(Difference(Row(f=1), Row(g=7)))",
+    "Count(Xor(Row(f=1), Row(g=7)))",
+    "Count(Not(Row(f=1)))",
+    "Row(f=2)",
+    "Intersect(Row(f=1), Row(g=7))",
+    "TopN(f, n=3)",
+    "TopN(f, Row(g=7), n=2)",
+    "Sum(field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
+    "Count(Row(v > 100))",
+    "Count(Row(v >< [-100, 100]))",
+    "GroupBy(Rows(f))",
+    "GroupBy(Rows(f), Rows(g))",
+    "GroupBy(Rows(f), Rows(g), Rows(hh))",
+]
+
+
+def _setup(holder, rng):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.create_field("hh")
+    idx.create_field("v", options_for_int(-500, 500))
+    span = N_SHARDS * SHARD_WIDTH
+    for row in (1, 2, 3):
+        cols = np.unique(rng.integers(0, span, 6000, dtype=np.uint64))
+        idx.field("f").import_bits(
+            np.full(cols.size, row, dtype=np.uint64), cols
+        )
+        idx.existence_field().import_bits(
+            np.zeros(cols.size, dtype=np.uint64), cols
+        )
+    cols = np.unique(rng.integers(0, span, 4000, dtype=np.uint64))
+    idx.field("g").import_bits(np.full(cols.size, 7, dtype=np.uint64), cols)
+    cols = np.unique(rng.integers(0, span, 1500, dtype=np.uint64))
+    idx.field("hh").import_bits(
+        rng.integers(0, 2, cols.size, dtype=np.uint64), cols
+    )
+    cols = np.unique(rng.integers(0, span, 900, dtype=np.uint64))
+    idx.field("v").import_value(cols, rng.integers(-500, 501, cols.size))
+    return idx
+
+
+def _answers(ex, queries=FAMILIES):
+    return {
+        q: [result_to_json(r) for r in ex.execute("i", q)] for q in queries
+    }
+
+
+def _stack_counters():
+    c = global_stats.snapshot()["counters"]
+    return {
+        k: c.get(k, 0.0)
+        for k in (
+            "stack_incremental_updates_total",
+            "stack_incremental_shards_total",
+            "stack_full_rebuilds_total",
+            "stack_update_bytes_total",
+        )
+    }
+
+
+class TestMeshDifferential:
+    """Forced 8-device mesh vs CPU oracle vs single-device backend,
+    across churn epochs, splice-not-rebuild asserted."""
+
+    def test_families_identical_across_churn_epochs(self, holder, rng):
+        idx = _setup(holder, rng)
+        ex_cpu = Executor(holder)
+        be_one = TPUBackend(holder)
+        ex_one = Executor(holder, backend=be_one)
+        be_mesh = TPUBackend(holder, mesh=ShardMesh())
+        ex_mesh = Executor(holder, backend=be_mesh)
+        ex_mesh.batcher = ShardLegBatcher(be_mesh)
+
+        # Epoch 0 (cold builds) …
+        want = _answers(ex_cpu)
+        assert _answers(ex_one) == want
+        assert _answers(ex_mesh) == want
+
+        # … then churn epochs: bit writes on existing rows (splice-able
+        # on the resident stacks) + BSI value writes, each followed by
+        # the full family sweep on all three engines.
+        base = _stack_counters()
+        for k in range(2):
+            idx.field("f").set_bit(1, 5 + k * 131)
+            idx.field("g").set_bit(7, 3 * SHARD_WIDTH + 17 + k)
+            idx.field("v").set_value(29 + k * 97, (-1) ** k * (333 - k))
+            want = _answers(ex_cpu)
+            assert _answers(ex_mesh) == want, f"epoch {k}"
+            assert _answers(ex_one) == want, f"epoch {k}"
+        after = _stack_counters()
+        # The epochs were absorbed by dirty-shard splices on the
+        # already-resident stacks; the full-rebuild counter stays FLAT
+        # (the fragment_rebuilds-style invariant the splice exists for).
+        assert after["stack_incremental_updates_total"] > base[
+            "stack_incremental_updates_total"
+        ]
+        assert after["stack_full_rebuilds_total"] == base[
+            "stack_full_rebuilds_total"
+        ]
+
+    def test_mesh_splice_is_o_dirty(self, holder, rng):
+        """One dirty shard ships O(slab) bytes into the sharded stack
+        (n_devices slabs, one per device lane), never the whole stack."""
+        _setup(holder, rng)
+        mesh = ShardMesh()
+        be = TPUBackend(holder, mesh=mesh)
+        ex = Executor(holder, backend=be)
+        ex.execute("i", "Row(f=1)")  # cold build
+        f_obj = be._field("i", "f")
+        block, rows_p = be.blocks.get("i", f_obj, tuple(range(N_SHARDS)))
+        stack_bytes = int(np.prod(block.shape)) * 4
+        base = _stack_counters()
+        holder.index("i").field("f").set_bit(1, 5)
+        got = [result_to_json(r) for r in ex.execute("i", "Row(f=1)")]
+        want = [result_to_json(r) for r in Executor(holder).execute("i", "Row(f=1)")]
+        assert got == want
+        after = _stack_counters()
+        assert after["stack_incremental_updates_total"] == base[
+            "stack_incremental_updates_total"
+        ] + 1
+        assert after["stack_incremental_shards_total"] == base[
+            "stack_incremental_shards_total"
+        ] + 1
+        assert after["stack_full_rebuilds_total"] == base[
+            "stack_full_rebuilds_total"
+        ]
+        shipped = after["stack_update_bytes_total"] - base[
+            "stack_update_bytes_total"
+        ]
+        # One splice round: one slab per device lane — strictly under
+        # the 16-slab padded stack this shape produces.
+        assert shipped == mesh.n * rows_p * (SHARD_WIDTH // 32) * 4
+        assert shipped < stack_bytes
+
+    def test_mesh_batched_paths_match_singles(self, holder, rng):
+        """The batching plane's group launches (count/row/bsi/topn legs)
+        through a meshed backend agree with per-query execution."""
+        from pilosa_tpu.pql import parse_string
+
+        _setup(holder, rng)
+        be = TPUBackend(holder, mesh=ShardMesh())
+        batcher = ShardLegBatcher(be)
+        shards = list(range(N_SHARDS))
+        calls = [
+            parse_string(f"Intersect(Row(f={r}), Row(g=7))").calls[0]
+            for r in (1, 2, 3)
+        ]
+        singles = [be.count_shards("i", c, shards) for c in calls]
+        assert batcher.count("i", calls, shards) == singles
+        row_call = parse_string("Intersect(Row(f=1), Row(g=7))").calls[0]
+        assert (
+            batcher.row("i", row_call, shards).columns().tolist()
+            == be.bitmap_call("i", row_call, shards).columns().tolist()
+        )
+        assert batcher.topn("i", "f", shards, 3) == be.topn_field(
+            "i", "f", shards, 3
+        )
+        assert batcher.bsi("bsi_sum", "i", "v", shards) == be.bsi_sum(
+            "i", "v", shards
+        )
+
+    def test_mesh_groupn_tensor_serves_and_absorbs_churn(self, holder, rng):
+        """The N>=3 group tensor (host-maintained per-shard table) is
+        mesh-enabled: cold sweep under shard_map, then a write epoch
+        resolves on the host with no re-dispatch."""
+        idx = _setup(holder, rng)
+        be = TPUBackend(holder, mesh=ShardMesh())
+        ex = Executor(holder, backend=be)
+        ex_cpu = Executor(holder)
+        q = "GroupBy(Rows(f), Rows(g), Rows(hh))"
+        want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+        assert [result_to_json(r) for r in ex.execute("i", q)] == want
+        assert be._groupn_cache, "mesh GroupN should populate the tensor cache"
+        c0 = global_stats.snapshot()["counters"].get(
+            "groupn_incremental_updates_total", 0.0
+        )
+        idx.field("f").set_bit(2, 7)
+        want = [result_to_json(r) for r in ex_cpu.execute("i", q)]
+        assert [result_to_json(r) for r in ex.execute("i", q)] == want
+        assert global_stats.snapshot()["counters"].get(
+            "groupn_incremental_updates_total", 0.0
+        ) > c0
+
+
+class TestShardMeshUnit:
+    def test_put_pads_to_device_multiple(self):
+        mesh = ShardMesh()
+        arr = np.arange(
+            N_SHARDS * 4, dtype=np.uint32
+        ).reshape(N_SHARDS, 4)
+        placed = mesh.put(arr)
+        assert placed.shape[0] == pad_to_multiple(N_SHARDS, mesh.n)
+        host = np.asarray(placed)
+        np.testing.assert_array_equal(host[:N_SHARDS], arr)
+        # Zero-slab padding: semantically inert for every reduction.
+        assert not host[N_SHARDS:].any()
+
+    def test_put_exact_multiple_unpadded(self):
+        mesh = ShardMesh()
+        arr = np.ones((mesh.n * 2, 3), dtype=np.uint32)
+        assert mesh.put(arr).shape == arr.shape
+
+    def test_empty_device_list_is_structured_error(self):
+        with pytest.raises(MeshConfigError):
+            ShardMesh(devices=[])
+        assert issubclass(MeshConfigError, ValueError)
+
+    def test_pad_to_multiple(self):
+        assert pad_to_multiple(11, 8) == 16
+        assert pad_to_multiple(16, 8) == 16
+        assert pad_to_multiple(1, 8) == 8
+        assert pad_to_multiple(5, 1) == 5
+        assert pad_to_multiple(0, 8) == 0
