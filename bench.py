@@ -349,6 +349,32 @@ def phase_means_ms(metrics_text: str, baseline: tuple = None) -> dict:
     }
 
 
+def phase_totals_inproc() -> tuple:
+    """phase_totals over the in-process registry (the bench server and
+    direct-backend legs share global_stats) — the per-window baseline
+    for phase_delta_ms."""
+    return phase_totals(global_stats.prometheus_text())
+
+
+def phase_delta_ms(baseline: tuple) -> dict:
+    """{phase: mean ms per profile sample} accumulated since `baseline`
+    (a phase_totals_inproc() snapshot). The ISSUE r14 serving-collapse
+    attribution: every sweep/zipf window records its own host_reduce/
+    serialize means so a regrown host loop is visible per leg in every
+    future BENCH capture."""
+    return phase_means_ms(global_stats.prometheus_text(), baseline=baseline)
+
+
+def payload_bytes_snapshot() -> float:
+    """Cumulative http_response_payload_bytes_total (body bytes written
+    by the HTTP layer) from the in-process registry."""
+    snap = global_stats.snapshot()["counters"]
+    return sum(
+        v for k, v in snap.items()
+        if k.startswith("http_response_payload_bytes_total")
+    )
+
+
 def hist_quantiles_ms(family: str, baseline: Optional[dict] = None,
                       tag: str = "") -> Optional[dict]:
     """Server-side p50/p95/p99/p999 (ms, bucket-interpolated) of one
@@ -468,6 +494,10 @@ LEG_COUNTER_FAMILIES = (
     # insert/eviction attribution — a window's hit rate is
     # rescache_hits / (hits + misses) from these deltas.
     "rescache_",
+    # Serving-path payload accounting (ISSUE r14): body bytes written
+    # per leg — with the window length this is the leg's
+    # payload_bytes_per_s serving-throughput figure.
+    "http_response_payload_bytes_total",
     # Cluster-lifecycle families (ISSUE r9): resize job/fetch/lease
     # accounting and the anti-entropy repair loop — the rolling-restart
     # drill's convergence attribution.
@@ -806,7 +836,7 @@ def _bench_client_loop(host, port, path, body_of, deadline, on_success,
         conn.close()
 
 
-def bench_http(holder, be, queries) -> tuple[dict, float]:
+def bench_http(holder, be, queries) -> tuple:
     """Drive the REAL serving surface: POST /index/bench/query against an
     in-process HTTP server whose executor has the device backend + the
     cross-request micro-batcher — the exact path a client hits.
@@ -906,10 +936,20 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     qps_at_rate = {}
     achieved_rate = {}
     walks0 = walk_totals()
+    payload_bps = None
     for w in WRITE_RATES:
         seconds = SECONDS if w == 0 else CHURN_SECONDS
         key = str(int(w) if w == int(w) else w)
+        payload0 = payload_bytes_snapshot()
+        t_w = time.time()
         qps_at_rate[key], achieved = run_window(w, seconds)
+        if w == 0:
+            # The leg's serving-throughput-in-bytes figure (ISSUE r14):
+            # response payload per second over the read-only window.
+            payload_bps = round(
+                (payload_bytes_snapshot() - payload0)
+                / max(time.time() - t_w, 1e-9), 1,
+            )
         qps_at_rate[key] = round(qps_at_rate[key], 1)
         achieved_rate[key] = round(achieved, 1)
     # Churn-walk leg (ISSUE r7): the whole rate sweep must resolve its
@@ -944,7 +984,7 @@ def bench_http(holder, be, queries) -> tuple[dict, float]:
     srv.close()
     return (
         qps_at_rate, achieved_rate, lat[len(lat) // 2], http_phase_ms,
-        aborts, churn_walks, http_server_ms,
+        aborts, churn_walks, http_server_ms, payload_bps,
     )
 
 
@@ -1025,10 +1065,14 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
     occupancy_at: dict[str, Optional[float]] = {}
     launches_at: dict[str, int] = {}
     server_ms_at: dict[str, Optional[dict]] = {}
+    phase_ms_at: dict[str, dict] = {}
+    payload_bps_at: dict[str, float] = {}
     try:
         for n in CONCURRENCY:
             hist0 = global_stats.histogram_snapshot()
             counters0 = global_stats.snapshot()["counters"]
+            phase0 = phase_totals_inproc()
+            payload0 = payload_bytes_snapshot()
             counts = [0] * n
             deadline = time.time() + SECONDS
 
@@ -1055,11 +1099,19 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
                 "http_request_duration_seconds", hist0,
                 tag='route="post_query"',
             )
+            # Per-window phase-delta columns (ISSUE r14): the collapse
+            # proof — and any regrown host loop — visible per leg.
+            phase_ms_at[key] = phase_delta_ms(phase0)
+            payload_bps_at[key] = round(
+                (payload_bytes_snapshot() - payload0) / elapsed, 1
+            )
             checkpoint(
                 f"qps@{n}",
                 **{
                     f"qps_at_{n}_clients": qps_at[key],
                     f"batch_occupancy_mean_at_{n}": occupancy_at[key],
+                    f"phase_ms_at_{n}_clients": phase_ms_at[key],
+                    f"payload_bytes_per_s_at_{n}": payload_bps_at[key],
                 },
             )
     finally:
@@ -1070,6 +1122,8 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
         "batch_occupancy_mean_at_clients": occupancy_at,
         "device_launches_at_clients": launches_at,
         "concurrency_server_ms": server_ms_at,
+        "concurrency_phase_ms": phase_ms_at,
+        "payload_bytes_per_s_at_clients": payload_bps_at,
     }
     base = qps_at.get("1")
     if base:
@@ -1160,17 +1214,32 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
 
     qps_at: dict[str, float] = {}
     hit_at: dict[str, Optional[float]] = {}
+    phase_ms_at: dict[str, dict] = {}
+    payload_bps_at: dict[str, float] = {}
     try:
         for n in CONCURRENCY:
+            phase0 = phase_totals_inproc()
+            payload0 = payload_bytes_snapshot()
+            t_w = time.time()
             q, r = run_window(n, ZIPF_SECONDS)
+            elapsed_w = max(time.time() - t_w, 1e-9)
             key = str(n)
             qps_at[key] = round(q, 1)
             hit_at[key] = round(r, 4) if r is not None else None
+            # Hit-path serialize proof (ISSUE r14): wire-bytes hits
+            # splice pre-encoded fragments, so the per-request
+            # serialize mean on a hot window must sit near zero.
+            phase_ms_at[key] = phase_delta_ms(phase0)
+            payload_bps_at[key] = round(
+                (payload_bytes_snapshot() - payload0) / elapsed_w, 1
+            )
             checkpoint(
                 f"zipf@{n}",
                 **{
                     f"zipf_qps_at_{n}_clients": qps_at[key],
                     f"zipf_hit_rate_at_{n}": hit_at[key],
+                    f"zipf_phase_ms_at_{n}_clients": phase_ms_at[key],
+                    f"zipf_payload_bytes_per_s_at_{n}": payload_bps_at[key],
                 },
             )
         nmax = max(CONCURRENCY)
@@ -1249,6 +1318,8 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
         "zipf_pool": len(queries),
         "zipf_qps_at_clients": qps_at,
         "zipf_hit_rate_at_clients": hit_at,
+        "zipf_phase_ms_at_clients": phase_ms_at,
+        "zipf_payload_bytes_per_s_at_clients": payload_bps_at,
         "zipf_churn_phase_qps": phase_qps,
         "zipf_hit_rate_phases": phase_hit,
         "zipf_churn_writes": wrote[0],
@@ -2555,7 +2626,7 @@ def main():
     )
     (
         qps_at_rate, achieved_rate, http_p50, http_phase_ms, aborts,
-        http_churn_walks, http_server_ms,
+        http_churn_walks, http_server_ms, http_payload_bps,
     ) = bench_http(h, be, queries)
     http_qps = qps_at_rate.get("0", next(iter(qps_at_rate.values())))
     checkpoint(
@@ -2563,6 +2634,9 @@ def main():
         qps_at_write_rate=qps_at_rate,
         write_rate_achieved=achieved_rate,
         http_single_p50_ms=round(http_p50 * 1e3, 2),
+        # Serving throughput in bytes (ISSUE r14): response payload per
+        # second over the read-only window.
+        payload_bytes_per_s=http_payload_bps,
         # Per-REQUEST server-side distribution from the serving
         # histogram — the client p50 above should sit inside it; a gap
         # is client-side queueing or a stalled reader, now visible.

@@ -56,6 +56,12 @@ class ExecOptions:
     # skips lookup AND population — the always-fresh escape hatch the
     # staleness contract documents (counted rescache_bypass_total).
     cache_bypass: bool = False
+    # Wire-bytes plumbing (ISSUE r14 tentpole 3): when the caller
+    # provides a list, the executor appends ONE item per result — the
+    # result-cache token (hit or committed miss) or None — so the
+    # serialization layer can serve/attach pre-encoded response bytes
+    # on the entry (exec/rescache.py wire_for/attach_wire).
+    wire_sink: Optional[list] = None
 
 
 class Executor:
@@ -225,6 +231,8 @@ class Executor:
                                 if tokens[k] is not None:
                                     cache.commit(tokens[k], int(v))
                     results.extend(out)
+                    if opt.wire_sink is not None:
+                        opt.wire_sink.extend(tokens)
                     i += run
                     continue
                 call = calls[i]
@@ -253,6 +261,8 @@ class Executor:
                         if token.hit:
                             prof.incr("cache_hits")
                             results.append(token.value)
+                            if opt.wire_sink is not None:
+                                opt.wire_sink.append(token)
                             i += 1
                             continue
                     else:
@@ -273,6 +283,8 @@ class Executor:
                 if token is not None:
                     cache.commit(token, result)
                 results.append(result)
+                if opt.wire_sink is not None:
+                    opt.wire_sink.append(token)
                 i += 1
             # Phase breakdown on the executor span so /debug/traces shows
             # where each trace's time went (serialize happens above this
@@ -404,7 +416,8 @@ class Executor:
         if isinstance(result, Row) and idx.options.keys and idx.translate_store is not None:
             cols = result.columns()
             result.keys = idx.translate_store.translate_ids(
-                [int(v) for v in cols.tolist()]
+                # lint: allow-hot-serialize(key translation necessarily builds one Python string per id; the id list is that lookup's input, not serialization output)
+                cols.tolist()
             )
         if isinstance(result, PairsField):
             f = idx.field(result.field_name) if result.field_name else None

@@ -89,10 +89,12 @@ _EXISTENCE_CALLS = ("Not", "All")
 
 class _Token:
     """One begin()'d lookup: either a hit carrying the value, or a miss
-    carrying the key + pre-execution epoch vector for commit()."""
+    carrying the key + pre-execution epoch vector for commit(). After a
+    hit or a retained commit, `entry` links to the cache entry so the
+    serialization layer can read/attach pre-encoded wire bytes."""
 
     __slots__ = ("key", "index", "fields_sig", "views_sig", "hit", "value",
-                 "stale_by", "_shard_set", "_pql")
+                 "stale_by", "entry", "_shard_set", "_pql")
 
     def __init__(self, key, index, fields_sig, views_sig):
         self.key = key
@@ -102,11 +104,18 @@ class _Token:
         self.hit = False
         self.value = None
         self.stale_by = 0
+        self.entry = None
+
+
+#: Wire-bytes memo bound per entry: one fragment per encoding-flags
+#: combination (today: JSON with/without columns). A response shape the
+#: entry has not served yet just encodes once more.
+_MAX_WIRE_VARIANTS = 4
 
 
 class _Entry:
     __slots__ = ("key", "index", "pql", "shard_set", "value", "nbytes",
-                 "fields_sig", "views_sig", "hits", "inserted_mono")
+                 "fields_sig", "views_sig", "hits", "inserted_mono", "wire")
 
     def __init__(self, key, index, pql, shard_set, value, nbytes,
                  fields_sig, views_sig):
@@ -120,6 +129,12 @@ class _Entry:
         self.views_sig = views_sig
         self.hits = 0
         self.inserted_mono = time.monotonic()
+        # Pre-encoded response fragments keyed by encoding flags
+        # (ISSUE r14 tentpole 3): a hit serves these bytes straight
+        # into the response envelope, skipping `serialize` entirely.
+        # Attached lazily by the serialization layer (attach_wire);
+        # accounted bytes charge the encoded payload.
+        self.wire: dict = {}
 
 
 def result_nbytes(value: Any) -> int:
@@ -145,7 +160,12 @@ def result_nbytes(value: Any) -> int:
     if isinstance(value, str):
         return 56 + len(value)
     if isinstance(value, Row):
-        n = 112 + int(value.columns().nbytes)
+        # Size from the LAZY representation: count() reads the columns
+        # array length (or sums container cardinalities) without
+        # forcing a lazy Row to materialize the full uint64 column
+        # array just to read .nbytes (ISSUE r14 satellite — insert-time
+        # accounting used to materialize every cached Row).
+        n = 112 + 8 * value.count()
         if value.keys:
             n += sum(56 + len(k) for k in value.keys)
         if value.attrs:
@@ -410,6 +430,7 @@ class ResultCache:
                 token.hit = True
                 token.value = entry.value
                 token.stale_by = behind
+                token.entry = entry
                 global_stats.with_tags(f"index:{index}").count(
                     "rescache_hits_total"
                 )
@@ -476,10 +497,70 @@ class ResultCache:
             self.evictions += evicted
             global_stats.gauge("rescache_resident_bytes", self._resident)
             global_stats.gauge("rescache_entries", len(self._entries))
+        token.entry = entry
         stats = global_stats.with_tags(f"index:{token.index}")
         stats.count("rescache_inserts_total")
         if evicted:
             stats.count("rescache_evictions_total", evicted)
+
+    # -- wire-bytes plane (ISSUE r14 tentpole 3) ----------------------------
+
+    def wire_for(self, token: Optional[_Token], flags) -> Optional[bytes]:
+        """The pre-encoded response fragment for a hit/committed token
+        under one encoding-flags combination, or None (encode fresh,
+        then attach_wire). Entry revalidation already happened in
+        begin(); the fragment is a pure function of (value, flags), so
+        no further freshness check is needed."""
+        if token is None or token.entry is None:
+            return None
+        return token.entry.wire.get(flags)
+
+    def attach_wire(self, token: Optional[_Token], flags, data: bytes) -> None:
+        """Memoize one encoded response fragment on the token's entry so
+        the NEXT hit writes these bytes instead of re-paying serialize.
+        Byte accounting charges the encoded payload: the ledger grows by
+        len(data) and the LRU bound still holds (entries carrying wire
+        bytes are exactly as evictable as before)."""
+        entry = token.entry if token is not None else None
+        if entry is None or len(entry.wire) >= _MAX_WIRE_VARIANTS:
+            return
+        if entry.nbytes + len(data) > self.max_bytes:
+            # commit()'s oversized guard, mirrored for the wire payload
+            # (code review r14): an entry whose ENCODED form would
+            # exceed the whole budget must neither pin the ledger above
+            # max_bytes nor flush every other live entry on its way in.
+            # The fragment is simply not memoized — hits re-encode.
+            return
+        evicted = 0
+        with self._lock:
+            if flags in entry.wire:
+                return
+            entry.wire[flags] = data
+            # Charge only while the entry is live in the ledger; a
+            # just-evicted entry's memo still serves THIS request's
+            # token but owes the ledger nothing.
+            if self._entries.get(entry.key) is entry:
+                entry.nbytes += len(data)
+                self._resident += len(data)
+                while (
+                    self._resident > self.max_bytes
+                    and len(self._entries) > 1
+                ):
+                    k, cold = next(iter(self._entries.items()))
+                    if cold is entry:
+                        break  # never evict the entry being served
+                    self._entries.pop(k)
+                    self._resident -= cold.nbytes
+                    evicted += 1
+                self.evictions += evicted
+                global_stats.gauge(
+                    "rescache_resident_bytes", self._resident
+                )
+                global_stats.gauge("rescache_entries", len(self._entries))
+        if evicted:
+            global_stats.with_tags(f"index:{token.index}").count(
+                "rescache_evictions_total", evicted
+            )
 
     def count_bypass(self, index: str, n: int = 1) -> None:
         """An X-Pilosa-Cache: bypass request skipped N lookups."""

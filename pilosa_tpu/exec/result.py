@@ -140,6 +140,7 @@ def result_to_json(result: Any) -> Any:
     if result is None:
         return None
     if isinstance(result, Row):
+        # lint: allow-hot-serialize(legacy dict encoder kept as the byte-compat oracle; the serving path rides utils/fastjson)
         out: dict[str, Any] = {"columns": result.columns().tolist()}
         if result.keys:
             out = {"keys": result.keys, "columns": []}

@@ -74,6 +74,7 @@ from pilosa_tpu.ops.blocks import (
     pack_row,
     pack_rows,
     unpack_row,
+    unpack_slab_columns,
 )
 from pilosa_tpu.ops.kernels import (
     MAX_PAIR_SHARDS,
@@ -1733,10 +1734,28 @@ class TPUBackend:
         ONE resident stack instead of thrashing the cache with per-shard
         repacks (each would replace the (index, field, view) entry)."""
         idx = self.holder.index(index)
+        # lint: allow-hot-serialize(shard inventory is schema-sized and feeds list ops, not serialization)
         shards = idx.available_shards().to_array().tolist() if idx else []
         if shard in shards:
             return tuple(shards), shards.index(shard)
         return (shard,), 0
+
+    @staticmethod
+    def _slab_row(host: np.ndarray, shards) -> Row:
+        """uint32[R, W] host slab whose rows align with `shards` ->
+        lazy columns-backed Row via ONE vectorized whole-slab pass
+        (ops/blocks.py unpack_slab_columns). Rows re-order (and DEDUPE)
+        by shard first: Row.from_columns requires a sorted-unique
+        column array, and a user-supplied shard list may repeat a shard
+        (?shards=3,3) — the old per-shard merge() unioned duplicates
+        idempotently, so this path must too (code review r14)."""
+        bases = np.asarray(shards, dtype=np.uint64) * np.uint64(SHARD_WIDTH)
+        if bases.size > 1:
+            uniq, first = np.unique(bases, return_index=True)
+            if uniq.size != bases.size or not np.array_equal(uniq, bases):
+                host = host[first]
+                bases = uniq
+        return Row.from_columns(unpack_slab_columns(host, bases))
 
     def bitmap_call_shard(self, index: str, c: Call, shard: int) -> Row:
         shards_t, pos = self._resident_shards(index, shard)
@@ -1745,7 +1764,13 @@ class TPUBackend:
         except _Unsupported:
             return self.cpu.bitmap_call_shard(index, c, shard)
         slab = self._program("vec", spec, False)(blocks, scalars)
-        return Row.from_segment(shard, Bitmap(unpack_row(np.asarray(slab[pos]))))
+        # Lazy columns-backed Row: unpack_row output is sorted and the
+        # shard base is a scalar add — no roaring construction unless a
+        # set-algebra caller materializes.
+        cols = unpack_row(np.asarray(slab[pos])) + np.uint64(
+            shard
+        ) * np.uint64(SHARD_WIDTH)
+        return Row.from_columns(cols)
 
     def bitmap_call(self, index: str, c: Call, shards: list[int]) -> Row:
         """Whole-query bitmap materialization: evaluate the stack ONCE and
@@ -1757,6 +1782,7 @@ class TPUBackend:
         # Assemble against the index's full resident stack when it covers
         # the request, so subset queries don't replace the cached stack.
         idx = self.holder.index(index)
+        # lint: allow-hot-serialize(shard inventory is schema-sized and feeds list ops, not serialization)
         avail = idx.available_shards().to_array().tolist() if idx else []
         pos_of = {s: i for i, s in enumerate(avail)}
         if avail and all(s in pos_of for s in shards):
@@ -1778,24 +1804,29 @@ class TPUBackend:
             "device_dispatch"
         ):
             slab = self._program("vec", spec, False)(blocks, scalars)
-        # Subset requests gather on device first: reading the whole
-        # [S_pad, W] slab back for one shard would move ~120 MB over the
-        # relay link when 128 KiB is needed.
-        with prof.phase("host_reduce"):
-            if len(positions) * 4 <= slab.shape[0]:
+            # Subset requests gather on device first: reading the whole
+            # [S_pad, W] slab back for one shard would move ~120 MB over
+            # the relay link when 128 KiB is needed.
+            sub = len(positions) * 4 <= slab.shape[0]
+            if sub:
                 slab = slab[jnp.asarray(positions, dtype=jnp.int32)]
-                host = np.asarray(slab)  # [len(positions), W]
-                rows = zip(range(len(positions)), shards)
-            else:
-                host = np.asarray(slab)  # [S_pad, W], one readback
-                rows = zip(positions, shards)
-            out = Row()
-            for pos, s in rows:
-                words = host[pos]
-                if not words.any():
-                    continue
-                out.merge(Row.from_segment(s, Bitmap(unpack_row(words))))
-            return out
+            # Block HERE so device_dispatch carries the device round
+            # trip and host_reduce is pure host-side work (ISSUE r14:
+            # the phase table's post-collapse contract,
+            # docs/observability.md).
+            jax.block_until_ready(slab)
+        with prof.phase("host_reduce"):
+            # Whole-slab vectorized materialization: one readback, one
+            # unpackbits+flatnonzero pass, shard bases added vectorized
+            # -> ONE sorted column array backing a lazy Row. Replaces
+            # the per-shard unpack/Bitmap/merge loop (ISSUE r14).
+            host = np.asarray(slab)
+            if not sub:
+                if positions == list(range(len(positions))):
+                    host = host[: len(positions)]  # contiguous: a view
+                else:
+                    host = host[np.asarray(positions, dtype=np.intp)]
+            return self._slab_row(host, shards)
 
     def count_shard(self, index: str, c: Call, shard: int) -> int:
         return self.count_shards(index, c, [shard])
@@ -1818,9 +1849,12 @@ class TPUBackend:
             "device_dispatch"
         ):
             partials = self._program("count", spec, reduce_dev)(blocks, scalars)
-        # Host sum in Python ints: exact for any shard count. The
-        # readback (np.asarray) blocks on the device round trip, so this
-        # phase carries the relay RTT floor — the bench subtracts it.
+            # Block HERE: device_dispatch carries the device round trip
+            # (and the relay RTT floor), host_reduce only the host-side
+            # arithmetic — the phase table's post-collapse contract
+            # (ISSUE r14, docs/observability.md).
+            jax.block_until_ready(partials)
+        # Host sum in Python ints: exact for any shard count.
         with prof.phase("host_reduce"):
             return int(np.asarray(partials, dtype=np.uint64).sum())
 
@@ -2568,6 +2602,7 @@ class TPUBackend:
             # set would fingerprint-miss on first query and the repack
             # would REPLACE the preheated entry.
             shards = tuple(
+                # lint: allow-hot-serialize(preheat inventory is schema-sized, off the serving path)
                 int(s) for s in idx.available_shards().to_array().tolist()
             )
             if not shards:
@@ -3343,30 +3378,55 @@ class TPUBackend:
                     "pilosa.count_batch"
                 ), prof.phase("device_dispatch"):
                     out = self._program("count", spec, reduce_dev)(blocks, ())
-                pending.append((idxs, out, True))
+                pending.append((idxs, out, None))
                 continue
+            # Slot dedupe by scalar bytes (ISSUE r14; the row_batch_async
+            # idiom): a coalesced Zipfian window re-submits the same hot
+            # call trees dozens of times per drain, and the scan's device
+            # cost is O(slots) — Q must be the number of DISTINCT
+            # queries, never the number of submitted legs (347 legs of a
+            # 32-query pool used to scan 512 padded slots per launch).
+            slot_index: dict[tuple, int] = {}
+            unique: list[int] = []
+            slot_of: dict[int, int] = {}
+            for i in idxs:
+                k = tuple(
+                    np.asarray(s, dtype=np.uint32).tobytes()
+                    for s in assembled[i][1]
+                )
+                if k not in slot_index:
+                    slot_index[k] = len(unique)
+                    unique.append(i)
+                slot_of[i] = slot_index[k]
             scalars = self._padded_slot_scalars(
-                [assembled[i][1] for i in idxs], _slot_bucket(len(idxs))
+                [assembled[i][1] for i in unique], _slot_bucket(len(unique))
             )
             with jax.profiler.TraceAnnotation(
                 "pilosa.count_batch"
             ), prof.phase("device_dispatch"):
                 out = self._program("count_batch", spec, reduce_dev)(blocks, scalars)
-            pending.append((idxs, out, False))
+            pending.append((idxs, out, slot_of))
 
         def resolve() -> list[int]:
-            with current_profile().phase("host_reduce"):
-                for idxs, out, shared in pending:
+            prof_r = current_profile()
+            with prof_r.phase("device_dispatch"):
+                # The device wait belongs to the dispatch phase;
+                # host_reduce below is pure host arithmetic (ISSUE r14).
+                # Dispatches are already enqueued, so blocking here does
+                # not undo the callers' batch pipelining.
+                jax.block_until_ready([out for _, out, _ in pending])
+            with prof_r.phase("host_reduce"):
+                for idxs, out, slot_of in pending:
                     arr = np.asarray(out, dtype=np.uint64)
-                    if shared:
+                    if slot_of is None:  # shared zero-scalar program
                         val = int(arr.sum())  # scalar, or [S] partials
                         for i in idxs:
                             results[i] = val
                         continue
                     if arr.ndim == 2:  # [Q, S] partials past device-sum bound
                         arr = arr.sum(axis=1)
-                    for j, i in enumerate(idxs):
-                        results[i] = int(arr[j])
+                    for i in idxs:
+                        results[i] = int(arr[slot_of[i]])
             for i in fallbacks:
                 results[i] = self.count_shards(index, calls[i], list(shards_t))
             return results  # type: ignore[return-value]
@@ -3393,6 +3453,7 @@ class TPUBackend:
         the batcher then re-dispatches legs individually so only the
         offending submitter sees the error."""
         idx = self.holder.index(index)
+        # lint: allow-hot-serialize(shard inventory is schema-sized and feeds list ops, not serialization)
         avail = idx.available_shards().to_array().tolist() if idx else []
         pos_of = {s: i for i, s in enumerate(avail)}
         if avail and all(s in pos_of for s in shards):
@@ -3480,33 +3541,45 @@ class TPUBackend:
         pos_dev = jnp.asarray(positions, dtype=jnp.int32) if sub else None
 
         def resolve() -> list[Row]:
-            with current_profile().phase("host_reduce"):
+            prof_r = current_profile()
+            with prof_r.phase("device_dispatch"):
+                # The device wait belongs to the dispatch phase (the
+                # leader pays it once per launch); host_reduce below is
+                # pure host-side materialization (ISSUE r14).
+                gathered = []
                 for idxs, slot_of, outs, per_chunk in pending:
-                    hosts = []
+                    g = []
                     for out in outs:
                         if sub:
                             out = (
                                 out[pos_dev] if out.ndim == 2
                                 else out[:, pos_dev, :]
                             )
-                        hosts.append(np.asarray(out))
-                    row_pos = (
-                        list(range(len(positions))) if sub else positions
-                    )
+                        g.append(out)
+                    jax.block_until_ready(g)
+                    gathered.append(g)
+            with prof_r.phase("host_reduce"):
+                row_pos = list(range(len(positions))) if sub else positions
+                contiguous = row_pos == list(range(len(row_pos)))
+                sel = None if contiguous else np.asarray(
+                    row_pos, dtype=np.intp
+                )
+                for (idxs, slot_of, outs, per_chunk), g in zip(
+                    pending, gathered
+                ):
+                    hosts = [np.asarray(out) for out in g]
                     for i in idxs:
                         slot = slot_of[i]
                         h = hosts[slot // per_chunk]
                         slab = h if h.ndim == 2 else h[slot % per_chunk]
-                        row = Row()
-                        for pos, s in zip(row_pos, shards):
-                            words = slab[pos]
-                            if words.any():
-                                row.merge(
-                                    Row.from_segment(
-                                        s, Bitmap(unpack_row(words))
-                                    )
-                                )
-                        results[i] = row
+                        slab = (
+                            slab[: len(row_pos)] if contiguous
+                            else slab[sel]
+                        )
+                        # One whole-slab vectorized pass per query ->
+                        # lazy columns-backed Row (replaces the
+                        # per-shard unpack/Bitmap/merge loop).
+                        results[i] = self._slab_row(slab, shards)
             for i in fallbacks:
                 results[i] = self.bitmap_call(index, calls[i], list(shards))
             return results  # type: ignore[return-value]

@@ -781,6 +781,33 @@ class Bitmap:
             self.op_n += 1
         return True
 
+    @staticmethod
+    def from_sorted_array(vs: np.ndarray) -> "Bitmap":
+        """Bulk-build from SORTED-UNIQUE uint64 values, skipping the
+        np.unique re-sort add_many pays (ISSUE r14: the vectorized slab
+        decode emits sorted output already — the Roaring reference's
+        word-level bulk path). One container constructed per key group,
+        no per-value work; copies each lows slice so the source buffer
+        is never pinned."""
+        bm = Bitmap()
+        v = np.ascontiguousarray(vs, dtype=np.uint64)
+        if v.size == 0:
+            return bm
+        keys = v >> np.uint64(16)
+        lows = (v & np.uint64(0xFFFF)).astype(np.uint16)
+        boundaries = np.nonzero(np.diff(keys))[0] + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [keys.size]))
+        for s, e in zip(starts, ends):
+            cnt = int(e - s)
+            chunk = lows[s:e]
+            if cnt <= ARRAY_MAX_SIZE:
+                c = Container(TYPE_ARRAY, chunk.copy(), cnt)
+            else:
+                c = Container(TYPE_BITMAP, _as_bitmap_words(chunk), cnt)
+            bm._put(int(keys[s]), c)
+        return bm
+
     def add_many(self, vs: np.ndarray, log: bool = True) -> int:
         """Batch add; one AddBatch op-log record (reference DirectAddN)."""
         vs = np.asarray(vs, dtype=np.uint64)

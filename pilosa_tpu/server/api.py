@@ -196,6 +196,7 @@ class API:
         exclude_columns: bool = False,
         remote: bool = False,
         cache_bypass: bool = False,
+        wire_sink: Optional[list] = None,
     ) -> tuple[list[Any], list[dict]]:
         """Raw executor results + column attr sets (shared by the JSON and
         protobuf response encoders)."""
@@ -235,6 +236,7 @@ class API:
             exclude_columns=exclude_columns,
             column_attrs=column_attrs,
             cache_bypass=cache_bypass,
+            wire_sink=wire_sink,
         )
         from pilosa_tpu.cluster.client import ClientError
         from pilosa_tpu.cluster.cluster import ShardUnavailableError
@@ -304,6 +306,61 @@ class API:
                 out["columnAttrSets"] = attr_sets
             return out
 
+    def query_bytes(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[list[int]] = None,
+        column_attrs: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+        remote: bool = False,
+        cache_bypass: bool = False,
+    ) -> bytes:
+        """The serving path's JSON response body as BYTES (with trailing
+        newline), byte-identical to json.dumps(self.query(...)) + "\\n"
+        (pinned by tests/test_fastjson.py). Two collapses vs query()
+        (ISSUE r14): results encode through utils/fastjson's vectorized
+        template fragments instead of tolist()+json.dumps, and a result-
+        cache hit splices its entry's pre-encoded wire bytes straight
+        into the envelope — hits skip `serialize` work entirely."""
+        from pilosa_tpu.utils import fastjson
+
+        wire_sink: list = []
+        results, attr_sets = self.query_results(
+            index, query, shards=shards, column_attrs=column_attrs,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns, remote=remote,
+            cache_bypass=cache_bypass, wire_sink=wire_sink,
+        )
+        from pilosa_tpu.utils.deadline import DeadlineExceeded, check_deadline
+        from pilosa_tpu.utils.qprofile import current_profile
+
+        try:
+            check_deadline("serialize")
+        except DeadlineExceeded as e:
+            raise APIError(str(e), status=504, code="deadline-exceeded") from e
+        cache = getattr(self.executor, "rescache", None)
+        flags = ("json", exclude_columns)
+        with current_profile().phase("serialize"):
+            frags: list[bytes] = []
+            for i, r in enumerate(results):
+                token = wire_sink[i] if i < len(wire_sink) else None
+                frag = (
+                    cache.wire_for(token, flags)
+                    if cache is not None else None
+                )
+                if frag is None:
+                    frag = fastjson.encode_result(r, exclude_columns)
+                    if cache is not None and token is not None:
+                        cache.attach_wire(token, flags, frag)
+                frags.append(frag)
+            return fastjson.response_body(
+                frags,
+                attr_sets if (column_attrs and not exclude_columns)
+                else None,
+            )
+
     def query_proto(self, index: str, query: str, **kw) -> bytes:
         """Protobuf QueryResponse (reference QueryResponse public.proto:66;
         Go client libraries speak this both ways)."""
@@ -323,6 +380,7 @@ class API:
             if r.keys:
                 out["keys"] = r.keys
             elif not exclude_columns:
+                # lint: allow-hot-serialize(legacy dict path kept as the byte-compat oracle for query_bytes; tests diff the two)
                 out["columns"] = r.columns().tolist()
             else:
                 out["columns"] = []
@@ -338,6 +396,7 @@ class API:
         seen: set[int] = set()
         for r in results:
             if isinstance(r, Row):
+                # lint: allow-hot-serialize(attr plane: the column set keys Python dict lookups into the attr store, not serialization)
                 seen.update(int(c) for c in r.columns().tolist())
         out = []
         for col in sorted(seen):
@@ -808,6 +867,7 @@ class API:
         if shard is not None:
             return self._export_shard_local(idx, f, shard)
         parts = []
+        # lint: allow-hot-serialize(export walks the schema-sized shard inventory, off the serving path)
         for s in f.available_shards().to_array().tolist():
             s = int(s)
             v = f.view("standard")

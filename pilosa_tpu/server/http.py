@@ -18,7 +18,9 @@ from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import __version__
+from pilosa_tpu.utils import fastjson
 from pilosa_tpu.utils.qprofile import profile_scope
+from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.server.api import API, APIError
 from pilosa_tpu.server.wire import (
     ImportRequest,
@@ -40,6 +42,27 @@ _PPROF_LOCK = threading.Lock()
 #: Process start, for /debug/vars uptime — monotonic: uptime is a
 #: duration, an NTP step must not dent it (lint: monotonic-time).
 _START_TIME = time.monotonic()
+
+#: Per-second cache of the RFC 7231 Date header value: rendering it
+#: (email.utils.formatdate) costs more than assembling the rest of a
+#: small response. Immutable (second, bytes) tuple swap — safe under
+#: concurrent handler threads.
+_DATE_CACHE: tuple[int, bytes] = (0, b"")
+
+
+def _http_date() -> bytes:
+    """Current Date header value, re-rendered at most once per second.
+    Wall clock by protocol: Date is a calendar timestamp peers compare
+    against their own clocks, never a duration."""
+    global _DATE_CACHE
+    now = int(time.time())  # lint: allow-monotonic-time(HTTP Date header is a wall-clock calendar stamp by RFC 7231)
+    sec, rendered = _DATE_CACHE
+    if sec != now:
+        from email.utils import formatdate
+
+        rendered = formatdate(now, usegmt=True).encode("latin-1")
+        _DATE_CACHE = (now, rendered)
+    return rendered
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -593,19 +616,42 @@ class _Handler(BaseHTTPRequestHandler):
                content_type: str = "application/json",
                headers: Optional[dict] = None) -> None:
         if content_type == "application/json":
-            data = (json.dumps(obj) + "\n").encode()
+            # fastjson.dumps == json.dumps bytes (the generic fallback
+            # encoder) — every JSON reply stays on one byte contract.
+            data = fastjson.dumps(obj) + b"\n"
         elif isinstance(obj, bytes):
             data = obj
         else:
             data = str(obj).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(data)))
+        self._reply_bytes(
+            data, status=status, content_type=content_type, headers=headers
+        )
+
+    def _reply_bytes(self, data: bytes, status: int = 200,
+                     content_type: str = "application/json",
+                     headers: Optional[dict] = None) -> None:
+        """Write one complete response — status line, headers, body —
+        with a SINGLE wfile.write (one sendall, one TCP segment for
+        small responses). The stdlib send_response/send_header path
+        buffers headers but still pays a separate body write plus a
+        strftime-equivalent Date render per response; this is the
+        serialize-phase floor for every reply (ISSUE r14 tentpole 2).
+        Semantics match send_response: Server/Date headers included,
+        keep-alive framing via Content-Length, request logging elided
+        (log_message is a no-op here)."""
+        reason = self.responses[status][0] if status in self.responses else ""
+        head = (
+            f"{self.protocol_version} {status} {reason}\r\n"
+            f"Server: {self.version_string()}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(data)}\r\n"
+        )
         if headers:
             for k, v in headers.items():
-                self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(data)
+                head += f"{k}: {v}\r\n"
+        buf = head.encode("latin-1") + b"Date: " + _http_date() + b"\r\n\r\n"
+        global_stats.count("http_response_payload_bytes_total", len(data))
+        self.wfile.write(buf + data)
 
     #: Machine-readable fallback `code` per status, so EVERY 4xx/5xx JSON
     #: body out of this layer carries one (ISSUE r9 satellite — the peer
@@ -894,15 +940,24 @@ class _Handler(BaseHTTPRequestHandler):
                         content_type="application/x-protobuf",
                     )
                     return
-                with prof.phase("serialize"):
+                with prof.phase("resp_write"):
                     self._reply(
                         data, content_type="application/x-protobuf",
                         headers=self._cache_marker(prof),
                     )
                 return
-            out = self.api.query(index, query, **kw)
-            with prof.phase("serialize"):
-                self._reply(out, headers=self._cache_marker(prof))
+            # Zero-copy serving path (ISSUE r14): the API layer hands
+            # back the COMPLETE response body bytes (vectorized
+            # fragment encoding; cache hits splice pre-encoded wire
+            # bytes), and the reply is one header+body sendall.
+            data = self.api.query_bytes(index, query, **kw)
+            # resp_write, not serialize: the body is already encoded
+            # (query_bytes' serialize phase), and this write's wall time
+            # is dominated by the GIL/scheduler handoff around the send
+            # — a queueing signal, not serialization cost (the raw send
+            # is ~1 µs; docs/observability.md phase table).
+            with prof.phase("resp_write"):
+                self._reply_bytes(data, headers=self._cache_marker(prof))
 
     @staticmethod
     def _cache_marker(prof) -> Optional[dict]:
@@ -1635,6 +1690,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(
             {
                 "views": sorted(f.views),
+                # lint: allow-hot-serialize(debug route over the schema-sized shard inventory)
                 "availableShards": f.available_shards().to_array().tolist(),
             }
         )

@@ -51,6 +51,7 @@ PHASES = (
     "host_reduce",
     "batch_wait",
     "serialize",
+    "resp_write",
 )
 
 _qid_counter = itertools.count(1)

@@ -1,0 +1,32 @@
+"""Seeded hot-serialize violations (tests/test_lint.py asserts the
+checker fires on each): a .tolist() in a result path, and a per-element
+int(...) comprehension over array data. The waivered site and the
+vectorized/scalar-source functions must NOT fire."""
+
+import json
+
+
+def bad_tolist(row):
+    # VIOLATION: one PyLong boxed per column, then json walks them all.
+    return json.dumps({"columns": row.columns().tolist()})
+
+
+def bad_int_loop(row):
+    # VIOLATION: per-element re-boxing of array data.
+    return [int(c) for c in row.columns()]
+
+
+def waivered_inventory(idx):
+    # lint: allow-hot-serialize(fixture: demonstrates a consumed waiver)
+    return idx.available_shards().to_array().tolist()
+
+
+def good_vectorized(row):
+    from pilosa_tpu.utils.fastjson import encode_uints
+
+    return b'{"columns": [' + encode_uints(row.columns()) + b"]}"
+
+
+def good_scalar_source(raw):
+    # Parsing a query string: the source is not array data.
+    return [int(s) for s in raw.split(",")]

@@ -89,6 +89,16 @@ def test_bench_smoke(tmp_path):
     assert "batch_occupancy_mean_at_clients" in blob
     assert "device_launches_at_clients" in blob
     assert "client_retries" in blob and "client_aborts" in blob
+    # The r14 serving-collapse keys: per-window host_reduce/serialize
+    # phase deltas and payload throughput on the sweep + zipf legs,
+    # plus the http leg's headline bytes/s figure.
+    assert set(blob["concurrency_phase_ms"]) == {"1", "4"}
+    assert set(blob["payload_bytes_per_s_at_clients"]) == {"1", "4"}
+    assert set(blob["zipf_phase_ms_at_clients"]) == {"1", "4"}
+    assert set(blob["zipf_payload_bytes_per_s_at_clients"]) == {"1", "4"}
+    assert blob["payload_bytes_per_s"] > 0
+    for win in blob["payload_bytes_per_s_at_clients"].values():
+        assert win > 0
     # The r8 ingest-under-load keys the driver's acceptance reads.
     assert blob["ingest_rows_per_s"] > 0
     assert blob["ingest_read_qps_under_load"] > 0

@@ -139,6 +139,20 @@ class TestCheckersFire:
         # append functions do not fire.
         assert any(w.used for w in f.waivers)
 
+    def test_hot_serialize_fixture(self):
+        """The seeded .tolist() + int-comprehension fire; the waivered
+        inventory, vectorized encode, and scalar-source comprehension
+        do not (ISSUE r14 satellite)."""
+        from tools.lint.checkers.hot_serialize import HotSerializeChecker
+
+        f = load_fixture("hot_serialize_bad.py")
+        got = list(HotSerializeChecker().check_file(f))
+        msgs = " | ".join(v.message for v in got)
+        assert len(got) == 2
+        assert ".tolist()" in msgs
+        assert "per-element int(...)" in msgs
+        assert any(w.used for w in f.waivers)
+
     def test_metric_tags_fixture(self):
         f = load_fixture("metric_tags_bad.py")
         got = list(TagCardinalityChecker().check_file(f))
@@ -361,7 +375,7 @@ class TestFramework:
     def test_registry_rules_unique_and_documented(self):
         checkers = make_checkers()
         rules = [c.rule for c in checkers]
-        assert len(rules) == len(set(rules)) == 11
+        assert len(rules) == len(set(rules)) == 12
         for c in checkers:
             assert c.rule and c.doc, f"{type(c).__name__} lacks rule/doc"
 

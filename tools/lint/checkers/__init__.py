@@ -17,6 +17,7 @@ from tools.lint.checkers.deadline_scope import DeadlineScopeChecker
 from tools.lint.checkers.durable_write import DurableWriteChecker
 from tools.lint.checkers.error_codes import ErrorCodeChecker
 from tools.lint.checkers.exceptions import ExceptDisciplineChecker
+from tools.lint.checkers.hot_serialize import HotSerializeChecker
 from tools.lint.checkers.jax_dispatch import JaxDispatchChecker
 from tools.lint.checkers.lock_discipline import LockDisciplineChecker
 from tools.lint.checkers.metrics import MetricDocsChecker, TagCardinalityChecker
@@ -30,6 +31,7 @@ def make_checkers():
         MonotonicTimeChecker(),
         ErrorCodeChecker(),
         JaxDispatchChecker(),
+        HotSerializeChecker(),
         LockDisciplineChecker(),
         SharedStateChecker(),
         DeadlineScopeChecker(),

@@ -1,0 +1,88 @@
+"""hot-serialize: per-element result-encoding loops are waiver-only.
+
+ISSUE r14 collapsed the three Python hot loops between device readback
+and socket write (whole-slab row materialization, vectorized
+integer-array-to-ASCII in utils/fastjson.py, wire-bytes cache hits).
+This rule keeps them collapsed: in the device-result and serving layers
+(`pilosa_tpu/exec/`, `pilosa_tpu/server/`) a `.tolist()` call — one
+PyLong boxed per element — or a per-element `int(...)` conversion loop
+over array data is a violation unless it carries a reasoned waiver
+(legitimate: schema-sized inventories, cold debug routes, the legacy
+dict encoders the byte-compat tests diff against).
+
+Two sub-rules:
+- tolist: any `.tolist()` call.
+- int-loop: a list/set/generator comprehension whose element is
+  `int(...)` and whose iteration source involves `.tolist()`,
+  `.columns()`, or `.to_array()` — i.e. re-boxing array data one
+  element at a time. Comprehensions over genuinely scalar Python
+  sources (query-string splits, protobuf decode lists) do not match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.lint.core import Checker, SourceFile, Violation
+
+_ARRAY_SOURCES = ("tolist", "columns", "to_array")
+
+
+def _iter_touches_array(comp: ast.AST) -> bool:
+    for gen in comp.generators:
+        for node in ast.walk(gen.iter):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ARRAY_SOURCES
+            ):
+                return True
+    return False
+
+
+class HotSerializeChecker(Checker):
+    rule = "hot-serialize"
+    doc = (".tolist() / per-element int loops in the device-result and "
+           "serving layers regrow the collapsed serialize phase")
+    scope = ("pilosa_tpu/exec/", "pilosa_tpu/server/")
+
+    def check_file(self, f: SourceFile) -> Iterable[Violation]:
+        for node in ast.walk(f.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "tolist"
+                and not node.args
+            ):
+                if f.waive(self.rule, node.lineno, node.end_lineno):
+                    continue
+                yield Violation(
+                    rule=self.rule, path=f.rel, line=node.lineno,
+                    message=".tolist() boxes one PyLong per element",
+                    hint="keep the numpy array (utils/fastjson "
+                         "encode_uints/encode_varints encode arrays "
+                         "directly); waiver schema-sized or cold-path "
+                         "uses: # lint: allow-hot-serialize(<why>)",
+                )
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)
+            ):
+                elt = node.elt
+                if not (
+                    isinstance(elt, ast.Call)
+                    and isinstance(elt.func, ast.Name)
+                    and elt.func.id == "int"
+                ):
+                    continue
+                if not _iter_touches_array(node):
+                    continue
+                if f.waive(self.rule, node.lineno, node.end_lineno):
+                    continue
+                yield Violation(
+                    rule=self.rule, path=f.rel, line=node.lineno,
+                    message="per-element int(...) loop over array data",
+                    hint="operate on the array (vectorized encode / "
+                         "np casts); waiver deliberate cold paths: "
+                         "# lint: allow-hot-serialize(<why>)",
+                )
