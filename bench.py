@@ -505,6 +505,11 @@ LEG_COUNTER_FAMILIES = (
     "anti_entropy_",
     "cluster_state_transitions_total",
     "cluster_coordinator_promotions_total",
+    # Replica-consistency families (ISSUE r15): the partition_heal
+    # leg's directed-repair attribution (anti_entropy_ above covers the
+    # direction/skip counters) plus the read-path divergence plane.
+    "replica_divergence_blocks_total",
+    "read_repair_",
 )
 
 
@@ -1499,6 +1504,133 @@ def bench_degraded_qps() -> dict:
         "degraded_healthy_qps": round(healthy, 1),
         "degraded_qps": round(degraded, 1),
         "degraded_qps_ratio": round(degraded / healthy, 3) if healthy else None,
+    }
+
+
+def bench_partition_heal() -> dict:
+    """Partition-and-heal drill (ISSUE r15 tentpole 4): a 2-node
+    replica_n=2 harness cluster is symmetrically partitioned
+    (SymmetricPartition — both directions blackholed with one call),
+    DIVERGENT sets AND clears land on both sides (replica-local, the
+    exact state a real partition leaves: each class in its own 100-row
+    block so every resolution arm of the epoch matrix exercises), the
+    partition heals, and anti-entropy passes drive convergence.
+
+    Captured: convergence seconds (heal -> every fragment byte-identical
+    on both replicas, epochs included), resurrected_bits (cleared bits
+    that came back — the pre-r15 union-repair bug; MUST be 0),
+    propagated/lost divergent sets, and the directed-repair counter
+    split for BOTH heal directions (remote_wins = a node adopted the
+    peer's newer block, local_wins = it kept its own newer block). The
+    checkpoint's leg_metrics delta carries the anti_entropy_* /
+    replica_divergence / read_repair families (LEG_COUNTER_FAMILIES).
+    Self-contained: own holder, own cluster."""
+    from pilosa_tpu.cluster.client import ClientError
+    from pilosa_tpu.cluster.sync import HolderSyncer
+    from tests.cluster_harness import SymmetricPartition, TestCluster
+
+    n_shards = int(os.environ.get("BENCH_PARTITION_SHARDS", "4"))
+    timeout_s = float(os.environ.get("BENCH_PARTITION_TIMEOUT", "60"))
+
+    def frag(cn, shard):
+        return (
+            cn.holder.index("ph").field("f").view("standard").fragment(shard)
+        )
+
+    def directed_split() -> dict:
+        snap = global_stats.snapshot()["counters"]
+        out = {}
+        for k, v in snap.items():
+            if k.startswith("anti_entropy_directed_repairs_total"):
+                d = k.partition('direction="')[2].partition('"')[0] or "untagged"
+                out[d] = out.get(d, 0) + v
+        return out
+
+    with TestCluster(2, replica_n=2) as tc:
+        tc.create_index("ph")
+        tc.create_field("ph", "f")
+        # Replicated seed: rows 1 and 205 (blocks 0 and 2) in every
+        # shard — the rows the divergent clears will tombstone.
+        sets = []
+        for s in range(n_shards):
+            sets.append(f"Set({s * SHARD_WIDTH + 3}, f=1)")
+            sets.append(f"Set({s * SHARD_WIDTH + 4}, f=205)")
+        tc.query(0, "ph", " ".join(sets))
+        tc.await_shard_convergence("ph")
+        with SymmetricPartition(tc, 0, 1, timeout=0.5) as part:
+            part.partition()
+            # Prove the partition is real and symmetric: one RPC each
+            # way must fail at the transport.
+            proven = 0
+            for src, dst in ((tc[0], tc[1]), (tc[1], tc[0])):
+                try:
+                    src.cluster.client.status(dst.node)
+                except ClientError:
+                    proven += 1
+            # Divergence on BOTH sides, each class its own block:
+            #   block 0: node1 clears the seeded row-1 bit   (tombstone ->0)
+            #   block 1: node0 sets a new row-110 bit        (set    0->1)
+            #   block 2: node0 clears the seeded row-205 bit (tombstone ->1)
+            #   block 3: node1 sets a new row-310 bit        (set    1->0)
+            divergent = 0
+            for s in range(n_shards):
+                f0, f1 = frag(tc[0], s), frag(tc[1], s)
+                f0.set_bit(110, s * SHARD_WIDTH + 7)
+                f0.clear_bit(205, s * SHARD_WIDTH + 4)
+                f1.set_bit(310, s * SHARD_WIDTH + 9)
+                f1.clear_bit(1, s * SHARD_WIDTH + 3)
+                divergent += 4
+            directed0 = directed_split()
+            part.heal()
+            t0 = time.perf_counter()
+            passes = 0
+
+            def converged() -> bool:
+                for s in range(n_shards):
+                    if (
+                        frag(tc[0], s).block_sums_epochs()
+                        != frag(tc[1], s).block_sums_epochs()
+                    ):
+                        return False
+                return True
+
+            while not converged() and time.perf_counter() - t0 < timeout_s:
+                for cn in tc.nodes:
+                    HolderSyncer(cn.cluster).sync_holder()
+                passes += 1
+            convergence_s = time.perf_counter() - t0
+            ok = converged()
+        # Post-heal audit: every clear stayed cleared (zero
+        # resurrections — the flipped r9 contract), every divergent set
+        # propagated to both replicas.
+        resurrected = 0
+        propagated = 0
+        for s in range(n_shards):
+            for cn in (tc[0], tc[1]):
+                fr = frag(cn, s)
+                if fr.storage.contains(1 * SHARD_WIDTH + (s * SHARD_WIDTH + 3) % SHARD_WIDTH):
+                    resurrected += 1
+                if fr.storage.contains(205 * SHARD_WIDTH + (s * SHARD_WIDTH + 4) % SHARD_WIDTH):
+                    resurrected += 1
+                if fr.storage.contains(110 * SHARD_WIDTH + (s * SHARD_WIDTH + 7) % SHARD_WIDTH):
+                    propagated += 1
+                if fr.storage.contains(310 * SHARD_WIDTH + (s * SHARD_WIDTH + 9) % SHARD_WIDTH):
+                    propagated += 1
+        directed1 = directed_split()
+        deltas = {
+            d: round(directed1.get(d, 0) - directed0.get(d, 0))
+            for d in set(directed0) | set(directed1)
+            if directed1.get(d, 0) - directed0.get(d, 0) > 0
+        }
+    return {
+        "partition_heal_proven_blackholed": proven == 2,
+        "partition_heal_divergent_bits": divergent,
+        "partition_heal_converged": ok,
+        "partition_heal_convergence_s": round(convergence_s, 3) if ok else None,
+        "partition_heal_sync_passes": passes,
+        "partition_heal_resurrected_bits": resurrected,
+        "partition_heal_propagated_set_bits": propagated,
+        "partition_heal_directed_repairs": deltas,
     }
 
 
@@ -2662,6 +2794,7 @@ def main():
     checkpoint("concurrency_sweep", **sweep)
     checkpoint("zipf_cache", **bench_zipf_cache(h, be, checkpoint))
     checkpoint("degraded_qps", **bench_degraded_qps())
+    checkpoint("partition_heal", **bench_partition_heal())
     checkpoint("ingest_under_load", **bench_ingest_under_load())
     checkpoint("rolling_restart", **bench_rolling_restart())
     checkpoint("mesh_scaling", **bench_mesh_scaling(checkpoint))

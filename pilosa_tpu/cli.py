@@ -244,6 +244,18 @@ def cmd_server(args) -> int:
             SyncDaemon(cluster, interval=cfg.anti_entropy_interval, logger=log).start()
         )
         daemons.append(FailureDetector(cluster, logger=log).start())
+        if cfg.read_repair_queue > 0:
+            # Read-path divergence monitor (ISSUE r15 tentpole 2):
+            # hedge races' replica-pair answers feed a bounded queue of
+            # background checksum diffs + targeted epoch-directed
+            # repairs, surfaced at /debug/consistency.
+            from pilosa_tpu.cluster.consistency import DivergenceMonitor
+
+            daemons.append(
+                DivergenceMonitor(
+                    cluster, max_queue=cfg.read_repair_queue, logger=log
+                ).start()
+            )
         return cluster
 
     daemons = []

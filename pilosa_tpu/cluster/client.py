@@ -120,6 +120,11 @@ class InternalClient:
         # Per-peer circuit breakers: OWN instance per client (per node),
         # never shared — see breaker.py on asymmetric partitions.
         self.breakers = breakers if breakers is not None else BreakerRegistry()
+        # Peer view-epoch piggyback sink (ISSUE r15 tentpole 3): every
+        # response carrying X-Pilosa-View-Epochs — remote query legs,
+        # replica writes — hands the parsed payload here. The cluster
+        # layer installs its epoch-map fold; None drops them.
+        self.on_peer_epochs = None
 
     # -- plumbing ----------------------------------------------------------
 
@@ -232,9 +237,11 @@ class InternalClient:
                     req, timeout=timeout, context=self.ssl_context
                 ) as resp:
                     data = resp.read()
-                    # email.message.Message: case-insensitive .get(),
-                    # captured only on request (checksum verification).
+                    # email.message.Message: case-insensitive .get();
+                    # returned to the caller only on request (checksum
+                    # verification), always consulted for piggybacks.
                     resp_headers = resp.headers if want_headers else None
+                    self._fold_epoch_header(resp.headers)
             except urllib.error.HTTPError as e:
                 detail = ""
                 err_code = ""
@@ -291,6 +298,24 @@ class InternalClient:
         except json.JSONDecodeError as e:
             stats.with_tags("class:decode").count("peer_rpc_errors_total")
             raise ClientError(f"{method} {url}: invalid JSON response: {e}") from e
+
+    def _fold_epoch_header(self, headers) -> None:
+        """Parse an X-Pilosa-View-Epochs piggyback into the installed
+        sink. Malformed payloads are dropped silently: the piggyback is
+        an optimization plane — losing one means a cache entry ages a
+        little later via the next fold, never a wrong answer (entries
+        only SERVE when the map matches what was recorded)."""
+        if self.on_peer_epochs is None or headers is None:
+            return
+        raw = headers.get("X-Pilosa-View-Epochs")
+        if not raw:
+            return
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            return
+        if isinstance(payload, dict) and payload.get("node"):
+            self.on_peer_epochs(payload)
 
     # -- queries (reference http/client.go QueryNode :268) -----------------
 
@@ -398,22 +423,43 @@ class InternalClient:
 
     # -- fragment sync (reference http/client.go:591-780) ------------------
 
-    def fragment_blocks(self, uri, index: str, field: str, view: str, shard: int) -> list[tuple[int, int]]:
+    def fragment_blocks(self, uri, index: str, field: str, view: str, shard: int) -> list[tuple[int, int, int]]:
+        """[(block, checksum, epoch)] — epoch 0 when the peer predates
+        the epoch plane (rolling upgrades: an absent field degrades the
+        caller to union repair, never a directed wipe)."""
         out = self._do(
             "GET", uri,
             f"/internal/fragment/blocks?index={index}&field={field}&view={view}&shard={shard}",
             op="fragment_blocks",
         )
-        return [(int(b["id"]), int(b["checksum"])) for b in out.get("blocks", [])]
+        return [
+            (int(b["id"]), int(b["checksum"]), int(b.get("epoch", 0)))
+            for b in out.get("blocks", [])
+        ]
 
-    def block_data(self, uri, index: str, field: str, view: str, shard: int, block: int) -> bytes:
-        return self._do(
+    def block_data(self, uri, index: str, field: str, view: str, shard: int, block: int) -> tuple[bytes, int]:
+        """One block's bytes + the epoch of exactly those bytes
+        (X-Pilosa-Block-Epoch, read with the data under one fragment
+        lock on the serving side — a peer write between the checksum
+        snapshot and this fetch would otherwise ship post-write data
+        the syncer stamps with the pre-write epoch). Epoch 0 when the
+        block is epoch-unknown or the peer predates the header."""
+        out = self._do(
             "GET", uri,
             f"/internal/fragment/block/data?index={index}&field={field}&view={view}"
             f"&shard={shard}&block={block}",
             raw=True,
             op="block_data",
+            want_headers=True,
         )
+        data, headers = out
+        epoch = 0
+        raw_epoch = (headers.get("X-Pilosa-Block-Epoch") or "") if headers else ""
+        try:
+            epoch = int(raw_epoch)
+        except ValueError:
+            pass
+        return data, epoch
 
     def retrieve_shard(self, uri, index: str, field: str, view: str, shard: int) -> bytes:
         """Whole-fragment roaring payload (reference RetrieveShardFromURI
@@ -451,6 +497,20 @@ class InternalClient:
                     code="checksum-mismatch",
                 )
         return data
+
+    def repair_fragment(self, uri, index: str, field: str, view: str,
+                        shard: int, blocks=None) -> int:
+        """Ask a replica to run one targeted epoch-directed repair pass
+        on its own copy of a fragment (the read-repair plane's fan-out,
+        ISSUE r15 tentpole 2). Returns the peer's repaired-block count."""
+        body = json.dumps({
+            "index": index, "field": field, "view": view,
+            "shard": int(shard),
+            "blocks": sorted(int(b) for b in blocks) if blocks else [],
+        }).encode()
+        out = self._do("POST", uri, "/internal/fragment/repair", body,
+                       op="repair_fragment")
+        return int(out.get("repaired", 0))
 
     def field_state(self, uri, index: str, field: str) -> dict:
         """Peer field state: view names + available shards (anti-entropy

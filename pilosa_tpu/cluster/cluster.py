@@ -129,6 +129,25 @@ class Cluster:
         # reconverges without operator re-seeding.
         self.topology_file: Optional[str] = None
         self._topology_file_lock = threading.Lock()
+        # Read-path divergence monitor (cluster/consistency.py, ISSUE
+        # r15 tentpole 2): when wired, a hedge race's two answers are
+        # handed over for a background checksum diff + targeted repair.
+        self.divergence = None
+        # Per-peer view-epoch map (ISSUE r15 tentpole 3): node id ->
+        # {index -> {field -> {"structure": int, "views": {view: gen}}}}
+        # folded from X-Pilosa-View-Epochs piggybacks on internal RPC
+        # responses (remote query legs, replica writes) and from the
+        # failure detector's /status probes. The clustered coordinator's
+        # result cache keys fan-out answers on this map — see
+        # rescache_peer_epochs below.
+        self._peer_epochs: dict[str, dict] = {}
+        self._peer_epochs_lock = threading.Lock()
+        # Shard-set -> covering-peer memo for the provider's hot path
+        # (one topology walk per distinct shard tuple, not per lookup);
+        # invalidated wholesale on any membership change. Both the memo
+        # and the generation it keys on share the peer-epoch lock.
+        self._owners_memo: dict = {}
+        self._topo_gen = 0
 
     def persist_topology(self) -> None:
         """Best-effort atomic rewrite of the topology file; a failed
@@ -166,6 +185,14 @@ class Cluster:
         self.api = api
         if self.holder is not None:
             self.holder.broadcast_shard = self._on_local_new_shard
+        # Peer view-epoch piggybacks fold into this node's epoch map
+        # (ISSUE r15 tentpole 3) — and when a result cache is wired,
+        # the provider below is what lets a CLUSTERED coordinator
+        # consult it: fan-out answers key on the merged (local + peer)
+        # epoch vector instead of being uncacheable.
+        self.client.on_peer_epochs = self.fold_peer_epochs
+        if getattr(executor, "rescache", None) is not None:
+            executor.rescache.peer_epochs_provider = self.rescache_peer_epochs
         # Keyed translation routes through the coordinator primary.
         from pilosa_tpu.cluster.sync import wrap_translate_stores
 
@@ -336,6 +363,187 @@ class Cluster:
     def shard_nodes_json(self, index: str, shard: int) -> list[dict]:
         return [n.to_json() for n in self.topology.shard_nodes(index, shard)]
 
+    # -- peer view-epoch plane (ISSUE r15 tentpole 3) ----------------------
+
+    @staticmethod
+    def _merge_report(stored: dict, new: dict) -> dict:
+        """Per-view monotone merge of two same-incarnation reports: the
+        new snapshot is the base (additions adopted), but no individual
+        stored generation may regress — the report walk on the peer is
+        lock-free, so a report can be TORN (one view read pre-mint,
+        another post), and a per-report max guard alone would let a
+        torn report with a high max fold a regressed view generation
+        back over a newer one, re-validating a cache entry a
+        synchronous write invalidation already killed. Generations are
+        per-process monotone, so per-view max is exact. (A view deleted
+        within one incarnation lingers as a ghost at its last
+        generation until the peer restarts — it can never change again,
+        so an equality-compared signature through it is stable, never
+        stale.)"""
+        out = dict(new)
+        for fname, old_f in stored.items():
+            new_f = out.get(fname)
+            if not isinstance(old_f, dict):
+                continue
+            if not isinstance(new_f, dict):
+                out[fname] = old_f
+                continue
+            merged = dict(new_f)
+            old_s, new_s = old_f.get("structure"), new_f.get("structure")
+            if isinstance(old_s, int) and (
+                not isinstance(new_s, int) or old_s > new_s
+            ):
+                merged["structure"] = old_s
+            old_v = old_f.get("views")
+            if isinstance(old_v, dict):
+                new_v = merged.get("views")
+                mv = dict(new_v) if isinstance(new_v, dict) else {}
+                for vname, g in old_v.items():
+                    cur = mv.get(vname)
+                    if isinstance(g, int) and (
+                        not isinstance(cur, int) or g > cur
+                    ):
+                        mv[vname] = g
+                merged["views"] = mv
+            out[fname] = merged
+        return out
+
+    @staticmethod
+    def _report_max(report: dict) -> int:
+        """Newest generation anywhere in one index's epoch report — the
+        report's ORDER among reports from the same peer, because a
+        peer's generations all come from one monotonic per-process
+        counter."""
+        top = 0
+        for f in report.values():
+            if not isinstance(f, dict):
+                continue
+            s = f.get("structure")
+            if isinstance(s, int) and s > top:
+                top = s
+            views = f.get("views")
+            if isinstance(views, dict):
+                for g in views.values():
+                    if isinstance(g, int) and g > top:
+                        top = g
+        return top
+
+    def fold_peer_epochs(self, payload: dict) -> None:
+        """Fold one piggybacked epoch report ({"node": id, "indexes":
+        {index: {field: {"structure": int, "views": {view: gen}}}}})
+        into the per-peer map. Reports are whole-index snapshots —
+        generations are minted from one monotonic per-process counter
+        (wall-seeded, so a restarted peer can never repeat a value) and
+        the cache compares them for EQUALITY only. Folds can arrive OUT
+        OF ORDER (a slow read leg's response races a later write's), so
+        a report only replaces the stored one when its newest
+        generation is >= the stored report's: an older snapshot folding
+        back over a newer one would re-validate a cache entry that a
+        synchronous write invalidation already killed. (A deletion-only
+        change can lower the max — that stale entry lasts only until
+        the peer's next mint, and a deleted field can't serve anyway.)"""
+        node_id = payload.get("node")
+        indexes = payload.get("indexes")
+        boot = payload.get("boot")
+        if not node_id or not isinstance(indexes, dict):
+            return
+        if node_id == self.local_node.id:
+            return  # our own loopback report: the local vector covers it
+        # Entries store (boot, report_max, report). Same-incarnation
+        # folds MERGE per-view monotone (see _merge_report: torn
+        # reports must never regress an individual generation; merge is
+        # commutative, so arrival order stops mattering entirely). A
+        # boot change — the peer restarted; its post-clock-step counter
+        # may mint below its previous life — or an unknown boot
+        # (mixed-version peers) replaces wholesale: the reborn process
+        # is fresh truth, deletions included. The incoming report's max
+        # walk happens out here, unlocked; the merge walk runs under
+        # the lock but only per FOLD (one per RPC response), never on
+        # the cache-lookup path.
+        prepared = [
+            (index, self._report_max(report), report)
+            for index, report in indexes.items()
+            if isinstance(report, dict)
+        ]
+        if not prepared:
+            return
+        with self._peer_epochs_lock:
+            per_node = self._peer_epochs.setdefault(node_id, {})
+            for index, mx, report in prepared:
+                stored = per_node.get(index)
+                if (
+                    stored is not None
+                    and boot is not None
+                    and stored[0] == boot
+                ):
+                    report = self._merge_report(stored[2], report)
+                    mx = max(mx, stored[1])
+                per_node[index] = (boot, mx, report)
+
+    def _covering_peers(self, index: str, shards_t: tuple) -> frozenset:
+        """Node ids (excluding this node) owning any replica of any
+        covered shard — every node whose writes could change a fan-out
+        answer over this shard set. Memoized per (index, shard tuple,
+        membership generation)."""
+        with self._peer_epochs_lock:
+            key = (index, shards_t, self._topo_gen)
+            got = self._owners_memo.get(key)
+        if got is not None:
+            return got
+        local_id = self.local_node.id
+        out = set()
+        for s in shards_t:
+            for n in self.topology.shard_nodes(index, s):
+                if n.id != local_id:
+                    out.add(n.id)
+        got = frozenset(out)
+        with self._peer_epochs_lock:
+            if len(self._owners_memo) > 64:
+                self._owners_memo.clear()
+            self._owners_memo[key] = got
+        return got
+
+    def rescache_peer_epochs(self, index: str, field_names, shards_t: tuple):
+        """The result cache's peer-epoch provider: a tuple signature of
+        every covering peer's last-reported epochs for the covered
+        fields, or None when any covering peer's state is unknown
+        (nothing piggybacked yet — the first fan-out populates the map,
+        so only the answer AFTER it becomes cacheable). () means the
+        shard set is covered locally and no peer vector is needed.
+
+        Freshness contract (docs/administration.md "Result caching"):
+        the map advances on every internal RPC response from a peer —
+        coordinator-routed writes invalidate synchronously — and on the
+        failure detector's ~1 s /status probes, which bound the
+        staleness window for writes entering via other nodes."""
+        peers = self._covering_peers(index, shards_t)
+        if not peers:
+            return ()
+        # Lock held only for the ref grabs: folds REPLACE a peer's
+        # report wholesale (never mutate in place), so the references
+        # are stable snapshots and the O(fields x views) signature walk
+        # + sorts run outside the lock every RPC piggyback fold and
+        # every other cache lookup contends for.
+        reports = []
+        with self._peer_epochs_lock:
+            for nid in sorted(peers):
+                entry = self._peer_epochs.get(nid, {}).get(index)
+                per_index = entry[2] if entry else None
+                if not per_index:
+                    return None
+                reports.append((nid, per_index))
+        out = []
+        for nid, per_index in reports:
+            for fname in field_names:
+                frep = per_index.get(fname)
+                if not isinstance(frep, dict):
+                    return None
+                out.append((nid, fname, -1, frep.get("structure")))
+                views = frep.get("views") or {}
+                for vname in sorted(views):
+                    out.append((nid, fname, vname, views[vname]))
+        return tuple(out)
+
     # -- mapReduce (reference executor.go:2460-2613) -----------------------
 
     def _routable_nodes(self, index, shards):
@@ -500,6 +708,31 @@ class Cluster:
                 global_stats.with_tags(f"won:{won}").count(
                     "hedged_requests_total"
                 )
+                # The hedge RACED two replicas over one shard set — a
+                # free consistency probe (ISSUE r15 tentpole 2). The
+                # winner's response plus its still-inflight sibling
+                # identify both replicas; the checksum diff runs on the
+                # monitor's thread, never here (one bounded-queue
+                # append). Observed at scoring time because the loser's
+                # answer usually lands AFTER this gather returns.
+                if self.divergence is not None:
+                    from pilosa_tpu.cluster.consistency import call_fields
+
+                    for r in inflight.values():
+                        if (
+                            r["parent"] == rec["parent"]
+                            and r["node"].id != resp.node.id
+                        ):
+                            common = set(r["shards"]) & set(resp.shards)
+                            if common:
+                                # Scoped to the fields the hedged read
+                                # touched: the probe diffs what the
+                                # race witnessed, the sweep covers the
+                                # rest of the schema.
+                                self.divergence.observe(
+                                    index, common, resp.node.id,
+                                    r["node"].id, fields=call_fields(c),
+                                )
             if got_any:
                 result = reduce_fn(result, resp.result)
             else:
@@ -919,6 +1152,8 @@ class Cluster:
             if "replicaN" in msg:
                 # lint: allow-shared-state(membership swap: each store is a GIL-atomic publish and readers tolerate one stale view until the next CLUSTER_STATUS frame)
                 self.topology.replica_n = int(msg["replicaN"])
+                with self._peer_epochs_lock:
+                    self._topo_gen += 1  # replica fan changes ownership
             if "nodes" in msg:
                 new_nodes = sorted(
                     (Node.from_json(d) for d in msg["nodes"]), key=lambda n: n.id
@@ -926,6 +1161,16 @@ class Cluster:
                 self.topology.nodes = new_nodes
                 with self._repair_lock:
                     self._repair_attempted.clear()
+                # Membership moved: shard ownership may have too — the
+                # covering-peer memo keys on this generation, and a
+                # departed peer's epoch report must not keep validating
+                # cache entries it can no longer witness.
+                live = {n.id for n in new_nodes}
+                with self._peer_epochs_lock:
+                    self._topo_gen += 1
+                    for nid in list(self._peer_epochs):
+                        if nid not in live:
+                            del self._peer_epochs[nid]
                 # Membership changed: re-negotiate control-plane wire
                 # format per peer (a replaced node may speak binary now).
                 self.broadcaster.reset_wire_negotiation()

@@ -110,6 +110,15 @@ class Resizer:
         # Aggregate bandwidth pacing across concurrent fetch workers.
         self._bw_lock = threading.Lock()
         self._bw_next = 0.0
+        # (index, shard) pairs an active instruction is currently
+        # migrating onto this node (ISSUE r15 satellite): the
+        # anti-entropy / read-repair planes skip these — a repair
+        # sourced mid-move would treat a half-migrated fragment as
+        # truth. Guarded by its own leaf lock: the hot consumer is the
+        # sync loop, which must not contend on the resizer RLock the
+        # coordinator's inline instruction-follow holds.
+        self._migrating: set[tuple[str, int]] = set()
+        self._migrating_lock = threading.Lock()
         # Set on every node while it should clean after the topology flips.
         self._needs_clean = False
         cluster.resizer = self
@@ -663,6 +672,15 @@ class Resizer:
         sources = msg.get("sources", [])
         global_stats.gauge("resize_migration_sources_total", len(sources))
         global_stats.gauge("resize_migration_sources_done", 0)
+        # Window the whole instruction's shard set as migration-in-flight
+        # (not per-source): a queued-but-unfetched source is about to be
+        # overwritten, so repairing it mid-window is wasted work at best
+        # and a half-block ship at worst (ISSUE r15 satellite).
+        inflight_keys = {
+            (str(s.get("index")), int(s.get("shard", 0))) for s in sources
+        }
+        with self._migrating_lock:
+            self._migrating |= inflight_keys
         # Bounded fan-out (ISSUE r9 tentpole 2): fetch_concurrency
         # workers pull sources off a shared queue; failures are
         # aggregated and reported in the completion's error field (the
@@ -717,10 +735,20 @@ class Resizer:
             threading.Thread(target=worker, daemon=True)
             for _ in range(min(workers, max(len(sources), 1)))
         ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            # The move window is over (success, cancel, or error): the
+            # repair planes may touch these shards again. Set-difference,
+            # not clear: an overlapping instruction for OTHER shards
+            # keeps its registrations (two windows sharing a shard — a
+            # failover re-delivery — just degrade that shard to the
+            # pre-skip behavior one pass early, which is safe).
+            with self._migrating_lock:
+                self._migrating -= inflight_keys
         # Unconditional final set: sources skipped at the tail (field not
         # held locally) must not leave _done below _total forever — that
         # is the wedged-resize signature and would be a standing false
@@ -740,6 +768,13 @@ class Resizer:
                 f"{len(errors)} of {len(sources)} fragment sources "
                 "failed: " + "; ".join(errors[:3])
             )
+
+    def migration_in_flight(self, index: str, shard: int) -> bool:
+        """True while an active instruction is migrating this shard onto
+        this node — the anti-entropy/read-repair skip predicate
+        (anti_entropy_skipped_total{reason=resizing})."""
+        with self._migrating_lock:
+            return (index, int(shard)) in self._migrating
 
     # -- migration fetch plane (ISSUE r9 tentpole 2) -----------------------
 
@@ -784,7 +819,14 @@ class Resizer:
             )
             if data is None:
                 continue  # absent on every surviving source
-            f.import_roaring(shard, data, view_name=view_name)
+            # epoch_unknown: this is a COPY of another replica's data,
+            # not a new write — minting fresh block epochs here would
+            # out-date genuinely newer blocks on surviving replicas and
+            # let directed repair wipe them with this (possibly stale)
+            # migrated snapshot.
+            f.import_roaring(
+                shard, data, view_name=view_name, epoch_unknown=True
+            )
             self._throttle(len(data))
         f.add_available_shard(shard)
         global_stats.count("resize_fragments_fetched_total")

@@ -241,6 +241,16 @@ class HolderSyncer:
                             self.cluster.local_node.id, index_name, shard
                         ):
                             continue
+                        if self._migration_in_flight(index_name, shard):
+                            # A resize is mid-move on this shard: a
+                            # repair sourced from a half-migrated peer
+                            # fragment would ship a partial block as
+                            # truth. Skip; the post-resize pass heals
+                            # (ISSUE r15 satellite).
+                            global_stats.with_tags("reason:resizing").count(
+                                "anti_entropy_skipped_total"
+                            )
+                            continue
                         repaired += self._sync_fragment(
                             index_name, f, view_name, shard
                         )
@@ -297,7 +307,34 @@ class HolderSyncer:
             for view_name in state.get("views", []):
                 f.create_view_if_not_exists(view_name)
 
-    def _sync_fragment(self, index: str, f, view_name: str, shard: int) -> int:
+    def _migration_in_flight(self, index: str, shard: int) -> bool:
+        rz = self.cluster.resizer
+        return rz is not None and rz.migration_in_flight(index, shard)
+
+    def _sync_fragment(self, index: str, f, view_name: str, shard: int,
+                       only_blocks=None) -> int:
+        """Epoch-directed anti-entropy for one fragment (ISSUE r15
+        tentpole 1). The wire ships per-block (checksum, epoch); a
+        differing block resolves by the matrix:
+
+          both epochs known, unequal  -> directed copy from the HIGHER
+                                         epoch (clears included — this
+                                         is what lets tombstones
+                                         propagate); the lower side
+                                         adopts the winner's epoch so
+                                         replicas converge on both axes.
+          both known, equal           -> union (two distinct writes can
+                                         never share a stamp within one
+                                         fragment, so an equal-epoch
+                                         disagreement means the epoch
+                                         plane cannot order them).
+          either side unknown (0)     -> union (mixed-version peers,
+                                         pre-upgrade data, crash-dropped
+                                         sidecars) — NEVER a directed
+                                         wipe of data nobody can date.
+
+        `only_blocks` (read-repair plane) restricts the pass to the
+        named block ids."""
         v = f.view(view_name)
         frag = v.fragment(shard) if v is not None else None
         repaired = 0
@@ -310,29 +347,149 @@ class HolderSyncer:
                 continue  # peer has no fragment (404) or is unreachable
             if not peer_blocks:
                 continue
-            local_blocks = dict(frag.checksum_blocks()) if frag is not None else {}
-            for block_id, checksum in peer_blocks:
-                if local_blocks.get(block_id) == checksum:
+            local_blocks = (
+                {b: (s, e) for b, s, e in frag.block_sums_epochs()}
+                if frag is not None
+                else {}
+            )
+            for block_id, checksum, peer_epoch in peer_blocks:
+                if only_blocks is not None and block_id not in only_blocks:
+                    continue
+                local_sum, local_epoch = local_blocks.get(block_id, (0, 0))
+                if local_sum == checksum:
+                    continue
+                directed = (
+                    peer_epoch > 0
+                    and local_epoch > 0
+                    and peer_epoch != local_epoch
+                )
+                if directed and local_epoch > peer_epoch:
+                    # Our block is newer: keep it. The peer's own pass
+                    # (or its read-repair) pulls ours — counted so both
+                    # heal directions are visible from one registry.
+                    global_stats.with_tags("direction:local_wins").count(
+                        "anti_entropy_directed_repairs_total"
+                    )
                     continue
                 try:
-                    data = self.cluster.client.block_data(
+                    data, wire_epoch = self.cluster.client.block_data(
                         peer, index, f.name, view_name, shard, block_id
                     )
                 except ClientError:
                     continue
+                # The epoch that rode WITH the data supersedes the
+                # snapshot's: a peer write between the two RPCs shipped
+                # newer bytes, and stamping them with the older
+                # snapshot epoch would diverge the epoch axis (epochs
+                # only grow, so the higher-wins decision still holds).
+                # wire_epoch 0 means the peer's block went
+                # epoch-UNKNOWN in flight (a union merge landed there):
+                # the directed/pull decision's basis is gone — zeroing
+                # peer_epoch degrades this block to union.
+                if wire_epoch > 0:
+                    peer_epoch = wire_epoch
+                else:
+                    peer_epoch = 0
+                    directed = False
                 if frag is None:
                     frag = v.create_fragment_if_not_exists(shard) if v is not None else None
                     if frag is None:
                         frag = f.create_view_if_not_exists(
                             view_name
                         ).create_fragment_if_not_exists(shard)
-                added, _ = frag.merge_block(block_id, data)
-                if added:
-                    repaired += 1
-                    global_stats.with_tags("kind:fragment").count(
-                        "anti_entropy_blocks_repaired_total"
+                    local_blocks = {
+                        b: (s, e) for b, s, e in frag.block_sums_epochs()
+                    }
+                # Pure pull into a block we have NO data and NO epoch
+                # for: the union result IS the peer's block, so copying
+                # it (epoch included) keeps replicas convergent on both
+                # axes — and nothing local can be wiped, because there
+                # is nothing local. Counted as the classic missed-write
+                # block repair (kind=fragment), NOT as a directed
+                # repair: the direction family is reserved for
+                # epoch-ARBITRATED resolutions between two dated blocks.
+                pull = (
+                    not directed
+                    and local_sum == 0
+                    and local_epoch == 0
+                    and peer_epoch > 0
+                )
+                if directed or pull:
+                    # expected_local_epoch closes the snapshot-to-
+                    # replace race: a client write landing between the
+                    # (checksum, epoch) snapshot and this call minted a
+                    # newer local epoch the decision never saw —
+                    # replace_block skips (None) instead of wiping the
+                    # acked write, and the next pass re-evaluates.
+                    result = frag.replace_block(
+                        block_id, data, peer_epoch,
+                        expected_local_epoch=local_epoch,
                     )
+                    if result is None:
+                        global_stats.with_tags("reason:stale-epoch").count(
+                            "anti_entropy_skipped_total"
+                        )
+                        continue
+                    added, removed = result
+                    if added or removed:
+                        repaired += 1
+                        if directed:
+                            global_stats.with_tags(
+                                "direction:remote_wins"
+                            ).count("anti_entropy_directed_repairs_total")
+                        else:
+                            global_stats.with_tags("kind:fragment").count(
+                                "anti_entropy_blocks_repaired_total"
+                            )
+                else:
+                    added, _ = frag.merge_block(block_id, data)
+                    if added:
+                        repaired += 1
+                        global_stats.with_tags("kind:fragment").count(
+                            "anti_entropy_blocks_repaired_total"
+                        )
         return repaired
+
+    def sync_fragment_targeted(self, index: str, field: str, view_name: str,
+                               shard: int, blocks=None) -> int:
+        """One fragment's epoch-directed repair, outside the full pass —
+        the read-repair queue's unit of work. Skips (0) while the shard
+        is mid-migration, exactly like the daemon pass."""
+        from pilosa_tpu.utils.deadline import Deadline, current_deadline, deadline_scope
+
+        holder = self.cluster.holder
+        idx = holder.index(index) if holder is not None else None
+        f = idx.field(field) if idx is not None else None
+        if f is None:
+            return 0
+        # Ownership guard, same as the daemon pass: a read-repair RPC
+        # can land MINUTES after the hedge observation (bounded queue x
+        # per-probe budget), by which time a resize may have moved the
+        # shard off this node — repairing here would recreate and
+        # repopulate a fragment cleanup already removed.
+        if not self.cluster.topology.owns_shard(
+            self.cluster.local_node.id, index, shard
+        ):
+            global_stats.with_tags("reason:not-owner").count(
+                "anti_entropy_skipped_total"
+            )
+            return 0
+        if self._migration_in_flight(index, shard):
+            global_stats.with_tags("reason:resizing").count(
+                "anti_entropy_skipped_total"
+            )
+            return 0
+        # Budget the repair's peer RPCs (deadline-scope rule): the
+        # /internal/fragment/repair handler and the divergence worker
+        # both land here; an inherited request budget is honored, a
+        # bare call gets its own bound so a stalled replica can't pin
+        # the caller.
+        d = current_deadline()
+        with deadline_scope(d if d is not None else Deadline(30.0)):
+            return self._sync_fragment(
+                index, f, view_name, shard,
+                only_blocks=set(blocks) if blocks else None,
+            )
 
     def _sync_attrs(self, index: str, field_name: Optional[str], store) -> int:
         """100-id block diff + merge (reference holder.go:975-1067)."""
@@ -542,6 +699,17 @@ class FailureDetector:
         """
         if not st:
             return
+        # View-epoch piggyback on the probe plane (ISSUE r15 tentpole
+        # 3): every probe response refreshes the peer's epoch report, so
+        # the clustered result cache's staleness window for writes that
+        # never route through the coordinator is bounded by the probe
+        # interval.
+        epochs = st.get("indexEpochs")
+        if isinstance(epochs, dict):
+            self.cluster.fold_peer_epochs(
+                {"node": peer.id, "boot": st.get("indexEpochsBoot"),
+                 "indexes": epochs}
+            )
         local = {n.id: n for n in self.cluster.topology.nodes}
         local_id = self.cluster.local_node.id
         for nd in st.get("nodes", []):

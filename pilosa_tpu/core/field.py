@@ -29,8 +29,9 @@ from pilosa_tpu.core.timequantum import (
 from pilosa_tpu.core.view import (
     VIEW_STANDARD,
     View,
-    _generation_counter,
     bsi_view_name,
+    mint_generation,
+    publish_watermark,
 )
 from pilosa_tpu.roaring import Bitmap, serialize
 from pilosa_tpu.roaring.codec import deserialize
@@ -158,8 +159,10 @@ class Field:
 
     def _bump_structure(self) -> None:
         # Atomic global counter (see core/view.py): concurrent bumps must
-        # never collapse into one observable value.
-        self.structure_version = next(_generation_counter)
+        # never collapse into one observable value. Watermark published
+        # only after the store, per the view.py protocol.
+        self.structure_version = mint_generation()
+        publish_watermark(self.structure_version)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -535,10 +538,10 @@ class Field:
             frag.bulk_import(rows_v[ssel], cols_v[ssel], clear=clear)
             self.add_available_shard(int(shard))
 
-    def import_roaring(self, shard: int, data: bytes, view_name: str = VIEW_STANDARD, clear: bool = False) -> int:
+    def import_roaring(self, shard: int, data: bytes, view_name: str = VIEW_STANDARD, clear: bool = False, epoch_unknown: bool = False) -> int:
         frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(shard)
         self.add_available_shard(shard)
-        return frag.import_roaring(data, clear=clear)
+        return frag.import_roaring(data, clear=clear, epoch_unknown=epoch_unknown)
 
     # -- TopN -------------------------------------------------------------
 

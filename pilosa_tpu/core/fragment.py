@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import deque
 from typing import Callable, Iterable, Optional
 
@@ -46,6 +47,15 @@ BSI_SIGN_BIT = 1
 BSI_OFFSET_BIT = 2
 
 CACHE_EXT = ".cache"
+
+# Per-block last-write-epoch sidecar (ISSUE r15 tentpole 1). Written
+# atomically at clean close and after every snapshot rewrite, keyed to
+# the storage file's byte size at write time: on open the sidecar is
+# adopted only when the sizes still match — any WAL bytes appended (or
+# torn away) after the last sidecar write cannot be attributed to
+# blocks, so those epochs are dropped and the fragment degrades to
+# union repair (never a misdirected wipe) until fresh writes re-stamp.
+EPOCHS_EXT = ".epochs"
 
 # Decoded-row LRU bound: a TopN over a 50k-row fragment must not pin 50k
 # bitmaps (r1 weak #7). 2048 rows ≈ a full rank-cache recalc working set.
@@ -252,6 +262,19 @@ class Fragment:
         # Lazily-computed per-block checksums, invalidated by row on write
         # (reference caches block checksums too, fragment.go:1762-1776).
         self._block_sums: dict[int, int] = {}
+        # Per-block last-write epoch (ISSUE r15 tentpole 1): a hybrid
+        # wall-nanosecond stamp minted on every mutation that touches
+        # the block, monotone per fragment (max(now, prev+1)) so a
+        # stepped-back clock can never re-order this fragment's own
+        # writes. Epochs are COMPARED ACROSS REPLICAS by anti-entropy
+        # ("higher epoch wins" directed repair), which is exactly why
+        # they must be wall-derived: a per-process counter says nothing
+        # about which replica wrote last. A block with no entry is
+        # epoch-UNKNOWN (pre-upgrade data, crash-dropped sidecar) and
+        # degrades to union repair. An entry persists after the block
+        # empties — that is the tombstone that lets clears propagate.
+        self._block_epochs: dict[int, int] = {}
+        self._epoch_clock = 0
         # Ring of recent single-bit mutations (version, row, local_col,
         # sign) — the exact deltas the TPU backend's host stats tables
         # apply per write epoch instead of re-deriving whole shard slabs
@@ -338,6 +361,7 @@ class Fragment:
             # admission gate must see a crash-looped node's backlog.
             self._backlog_reported = 0
             self._report_backlog()
+            self._load_block_epochs()
         mx = self.storage.max()
         self.max_row_id = mx // SHARD_WIDTH if self.storage.any() else 0
         # A missing/stale .cache (e.g. after a crash — it is only flushed
@@ -379,6 +403,55 @@ class Fragment:
             self.path, replay.torn_reason, replay.torn_offset, dropped,
         )
 
+    def _load_block_epochs(self) -> None:
+        """Adopt the persisted per-block epochs iff the sidecar still
+        describes the storage file on disk (size match — see EPOCHS_EXT).
+        Any failure degrades to epoch-unknown, never an error: union
+        repair is always a safe fallback."""
+        import json
+
+        if self.path is None:
+            return
+        try:
+            with open(self.path + EPOCHS_EXT) as f:
+                data = json.load(f)
+            wal_size = int(data.get("walSize", -1))
+            clock = int(data.get("clock", 0))
+            epochs = {
+                int(k): int(v) for k, v in (data.get("epochs") or {}).items()
+            }
+        except (OSError, ValueError, TypeError, AttributeError):
+            return
+        # The clock floor adopts even when the epochs don't: a reopened
+        # fragment must never mint below its previous incarnation.
+        self._epoch_clock = max(self._epoch_clock, clock)
+        if wal_size != os.path.getsize(self.path):
+            return
+        self._block_epochs.update(epochs)
+
+    def _save_block_epochs(self) -> None:
+        """Atomic sidecar rewrite (tmp + os.replace, the durable-write
+        discipline), stamped with the CURRENT storage file size. Called
+        with self.lock held, after any pending WAL bytes are down (clean
+        close; snapshot phase 3). Best-effort: a failed save just means
+        the next open degrades those blocks to union repair."""
+        import json
+
+        if self.path is None:
+            return
+        try:
+            payload = json.dumps({
+                "walSize": os.path.getsize(self.path),
+                "clock": self._epoch_clock,
+                "epochs": {str(k): v for k, v in self._block_epochs.items()},
+            })
+            tmp = self.path + EPOCHS_EXT + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path + EPOCHS_EXT)
+        except OSError:
+            pass
+
     def _report_backlog(self) -> None:
         """Publish this fragment's un-snapshotted op delta into the
         process-wide WAL backlog. Called with self.lock held (or before
@@ -409,6 +482,10 @@ class Fragment:
                 # appender makes this a no-op).
                 if self.storage.op_writer is not None:
                     self.storage.op_writer.flush()
+                # Every WAL byte is down: the sidecar's size stamp now
+                # describes exactly this file, so the next open adopts
+                # the epochs (directed repair survives clean restarts).
+                self._save_block_epochs()
                 self._file.close()
                 self._file = None
                 self.storage.op_writer = None
@@ -579,6 +656,11 @@ class Fragment:
             os.replace(tmp, self.path)
             self.storage.op_n -= op_n_at_clone
             self._report_backlog()
+            # The rewrite changed the storage file's size: refresh the
+            # epoch sidecar under the same lock so a crash after this
+            # point still finds a size-matched sidecar (a crash BETWEEN
+            # replace and save just degrades to union repair).
+            self._save_block_epochs()
             # Adopt the clone's RLE-repacked containers into LIVE
             # storage wherever the live container is still the exact
             # object the clone snapshotted (no write touched it since):
@@ -599,7 +681,27 @@ class Fragment:
 
     # -- mutation ---------------------------------------------------------
 
-    def _mutated(self, row_ids: Optional[Iterable[int]] = None) -> None:
+    def _mint_epoch(self) -> int:
+        """One hybrid last-write epoch: wall nanoseconds, clamped to
+        strictly-after this fragment's previous mint so a stepped-back
+        clock cannot reorder our own writes. Called with self.lock held.
+        Wall clock is the point — replicas compare these stamps to
+        decide whose block is newer (directed anti-entropy), the same
+        cross-node-ordering class as the tracing span-start waiver; the
+        value never enters duration/deadline arithmetic."""
+        # lint: allow-monotonic-time(cross-replica write ordering: directed repair compares these stamps between nodes, which only the wall clock can order)
+        now = time.time_ns()
+        self._epoch_clock = max(now, self._epoch_clock + 1)
+        return self._epoch_clock
+
+    def _mutated(self, row_ids: Iterable[int],
+                 epoch: Optional[int] = None) -> None:
+        """row_ids is REQUIRED on purpose: every mutation path knows its
+        touched rows, and an argless "stamp everything" default would
+        re-date blocks whose content didn't change — a re-dated stale
+        block WINS directed repair over a peer's genuinely newer one
+        (silent write loss). A new mutation path that truly can't name
+        its rows must degrade those blocks to epoch-unknown instead."""
         self.version += 1
         # Owning view's data-generation bump (set in view._new_fragment):
         # lets stack caches check freshness in O(1) instead of walking
@@ -607,13 +709,22 @@ class Fragment:
         # the view's mutation journal (view.dirty_shards_since).
         if self.on_mutate is not None:
             self.on_mutate(self.shard)
-        if row_ids is None:
-            self._row_cache.clear()
-            self._block_sums.clear()
-        else:
-            for r in row_ids:
-                self._row_cache.pop(r, None)
-                self._block_sums.pop(r // HASH_BLOCK_SIZE, None)
+        # epoch: None mints a fresh local write stamp; a repair adopting
+        # a peer's block passes the PEER's epoch so both replicas
+        # converge to the same (checksum, epoch); 0 marks the block
+        # epoch-unknown (union-merged mixtures).
+        if epoch is None:
+            epoch = self._mint_epoch()
+        for r in row_ids:
+            self._row_cache.pop(r, None)
+            b = r // HASH_BLOCK_SIZE
+            self._block_sums.pop(b, None)
+            self._block_epochs[b] = epoch
+
+    def _present_blocks(self) -> set:
+        """Block ids with at least one container of data right now."""
+        block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
+        return {(k << 16) // block_span for k in self.storage.keys()}
 
     #: bit_ops ring capacity: covers any realistic point-write burst
     #: between two stats-table refreshes; overflow just means the next
@@ -816,7 +927,7 @@ class Fragment:
                 old_sign = 0 ^ ch
             changed = ch or changed
             if changed:
-                self._mutated()
+                self._mutated(range(BSI_OFFSET_BIT + bit_depth))
                 old_v = -old_u if old_sign else old_u
                 self._record_value_op(old_ok, old_v if old_ok else 0, True, value)
                 top = BSI_OFFSET_BIT + bit_depth - 1
@@ -843,7 +954,7 @@ class Fragment:
                     else:
                         old_u |= 1 << (r - BSI_OFFSET_BIT)
             if changed:
-                self._mutated()
+                self._mutated(range(BSI_OFFSET_BIT + bit_depth))
                 old_v = -old_u if old_sign else old_u
                 self._record_value_op(old_ok, old_v if old_ok else 0, False, 0)
             self._increment_op_n()
@@ -1173,7 +1284,14 @@ class Fragment:
                     shift = SHARD_WIDTH_EXP - 16
                     rows_touched = np.unique(keys >> np.uint32(shift))
                     self._rebuild_cache_rows(rows_touched.astype(np.uint64))
-                    self._mutated()
+                    # Only the touched rows' blocks get a fresh write
+                    # epoch, and only when bits actually moved: an
+                    # argless or no-op stamp would re-date blocks whose
+                    # content didn't change, and a re-dated stale block
+                    # WINS directed repair over a peer's genuinely
+                    # newer one.
+                    if changed:
+                        self._mutated(int(r) for r in rows_touched)
                     if keys.size:
                         self.max_row_id = max(
                             self.max_row_id, int(keys[-1]) >> shift
@@ -1184,11 +1302,18 @@ class Fragment:
                 column_ids % np.uint64(SHARD_WIDTH)
             )
             if clear:
-                self.storage.remove_many(positions)
+                nchanged = self.storage.remove_many(positions)
             else:
-                self.storage.add_many(positions)
-            self._rebuild_cache_rows(np.unique(row_ids))
-            self._mutated()
+                nchanged = self.storage.add_many(positions)
+            rows_touched = np.unique(row_ids)
+            self._rebuild_cache_rows(rows_touched)
+            # Block-granular stamp, skipped entirely on a no-op import
+            # (an idempotent re-import must not re-date blocks and win
+            # directed repair over a peer's newer data). A PARTIAL
+            # no-op still stamps every touched row's block — per-block
+            # change split isn't available from the batch return.
+            if nchanged:
+                self._mutated(int(r) for r in rows_touched)
             if not clear and row_ids.size:
                 self.max_row_id = max(self.max_row_id, int(row_ids.max()))
             self._increment_op_n()
@@ -1207,6 +1332,7 @@ class Fragment:
         targets = np.array([last[int(c)] for c in cols], dtype=np.uint64)
         cols_bm = Bitmap(cols)
         to_clear = []
+        cleared_rows = []
         for row_id in self.row_ids():
             hit = self._row_bitmap(row_id).intersect(cols_bm).to_array()
             if not hit.size:
@@ -1215,11 +1341,20 @@ class Fragment:
             stale = hit[tgt != np.uint64(row_id)]
             if stale.size:
                 to_clear.append(np.uint64(row_id * SHARD_WIDTH) + stale)
+                cleared_rows.append(np.uint64(row_id))
+        nchanged = 0
         if to_clear:
-            self.storage.remove_many(np.concatenate(to_clear))
-        self.storage.add_many(targets * np.uint64(SHARD_WIDTH) + cols)
-        self._rebuild_cache_rows(np.unique(np.concatenate([targets, np.asarray(row_ids, dtype=np.uint64)])))
-        self._mutated()
+            nchanged += self.storage.remove_many(np.concatenate(to_clear))
+        nchanged += self.storage.add_many(
+            targets * np.uint64(SHARD_WIDTH) + cols
+        )
+        rows_touched = np.unique(np.concatenate(
+            [targets, np.asarray(row_ids, dtype=np.uint64),
+             np.asarray(cleared_rows, dtype=np.uint64)]
+        ))
+        self._rebuild_cache_rows(rows_touched)
+        if nchanged:  # no-op imports never re-date blocks
+            self._mutated(int(r) for r in rows_touched)
         if targets.size:
             self.max_row_id = max(self.max_row_id, int(targets.max()))
         self._increment_op_n()
@@ -1269,27 +1404,55 @@ class Fragment:
             if clear:
                 to_clear.extend(to_set)
                 to_set = []
+            nchanged = 0
             if to_set:
-                self.storage.add_many(np.concatenate(to_set))
+                nchanged += self.storage.add_many(np.concatenate(to_set))
             # The clear pass erases any PREVIOUS values of these columns
             # (overwrite semantics). A fresh fragment has nothing to
             # erase — skipping the per-plane remove sweep cut the bench
             # BSI build ~2.5x (it dominated import_value on cold loads).
             if to_clear and not fresh:
-                self.storage.remove_many(np.concatenate(to_clear))
-            self._mutated()
+                nchanged += self.storage.remove_many(np.concatenate(to_clear))
+            if nchanged:  # no-op imports never re-date blocks
+                self._mutated(range(BSI_OFFSET_BIT + bit_depth))
             top = BSI_OFFSET_BIT + bit_depth - 1
             if not clear and top > self.max_row_id:
                 self.max_row_id = top
             self._increment_op_n()
 
-    def import_roaring(self, data: bytes, clear: bool = False) -> int:
+    def import_roaring(self, data: bytes, clear: bool = False,
+                       epoch_unknown: bool = False) -> int:
         """Union/clear a pre-serialized roaring bitmap in one op
-        (reference fragment.importRoaring :2255)."""
+        (reference fragment.importRoaring :2255). `epoch_unknown` is for
+        COPIES of data that already exists elsewhere (resize shard
+        migration): minting a fresh epoch would out-date the genuinely
+        newer blocks surviving replicas hold, and directed repair would
+        then wipe them with this stale copy — unknown degrades those
+        blocks to union repair until a real write stamps them."""
         with self.lock:
-            changed = self.storage.import_roaring_bits(data, clear=clear)
-            self._rebuild_cache_rows(np.array(self.row_ids()))
-            self._mutated()
+            # One parse serves both the import and the epoch stamping.
+            other = deserialize(data)
+            changed = self.storage.import_roaring_bits(
+                data, clear=clear, parsed=other
+            )
+            if changed:
+                self._rebuild_cache_rows(np.array(self.row_ids()))
+                # Stamp only the rows the blob spans (container key >>
+                # shift is the row, SHARD_WIDTH being a multiple of the
+                # 2^16 container span) and only when bits actually
+                # moved: an argless or no-op stamp would re-date blocks
+                # whose content didn't change, and a re-dated stale
+                # block wins directed repair over a peer's genuinely
+                # newer one (an idempotent re-import must not out-date
+                # a write the re-imported data predates).
+                shift = SHARD_WIDTH_EXP - 16
+                rows = sorted({int(k) >> shift for k in other.keys()})
+                self._mutated(rows, epoch=0 if epoch_unknown else None)
+                if epoch_unknown:
+                    # 0 = absent entry (merge_block's discipline): these
+                    # blocks are honestly unknown, not tombstoned-at-0.
+                    for r in rows:
+                        self._block_epochs.pop(r // HASH_BLOCK_SIZE, None)
             if self.storage.any():
                 self.max_row_id = self.storage.max() // SHARD_WIDTH
             self._increment_op_n()
@@ -1313,7 +1476,7 @@ class Fragment:
         with self.lock:
             out = []
             block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
-            blocks = sorted({(k << 16) // block_span for k in self.storage.keys()})
+            blocks = sorted(self._present_blocks())
             for b in blocks:
                 cached = self._block_sums.get(b)
                 if cached is not None:
@@ -1329,6 +1492,38 @@ class Fragment:
                     self._block_sums[b] = 0
             return out
 
+    def block_sums_epochs(self) -> list[tuple[int, int, int]]:
+        """[(block_id, checksum, epoch)] — the directed-repair wire
+        payload (ISSUE r15 tentpole 1). Unlike checksum_blocks this
+        ALSO reports tombstones: a block with no data but a known epoch
+        ships as (id, 0, epoch), which is how a block-wide clear
+        propagates to a replica still holding the old bits. epoch 0 =
+        unknown (pre-upgrade data, dropped sidecar) — the peer must
+        union, never directed-copy."""
+        with self.lock:  # RLock: checksum_blocks re-enters safely
+            sums = dict(self.checksum_blocks())
+            out = []
+            for b in sorted(set(sums) | set(self._block_epochs)):
+                out.append((b, sums.get(b, 0), self._block_epochs.get(b, 0)))
+            return out
+
+    def block_epoch(self, block_id: int) -> int:
+        with self.lock:
+            return self._block_epochs.get(block_id, 0)
+
+    def block_data_epoch(self, block_id: int) -> tuple[bytes, int]:
+        """Serialized block + its CURRENT epoch under ONE lock
+        acquisition — the directed-repair wire pair. Reading them in
+        two separate acquisitions would let a write land in between and
+        pair newer data with an older epoch: the adopter would hold the
+        peer's post-write bits dated pre-write, permanently diverged on
+        the epoch axis (and a skewed clock could then lose a genuine
+        write to the peer's older block)."""
+        with self.lock:  # RLock: block_data re-enters safely
+            return self.block_data(block_id), self._block_epochs.get(
+                block_id, 0
+            )
+
     def block_data(self, block_id: int) -> bytes:
         """Serialized sub-bitmap for one block (positions block-relative),
         for anti-entropy merge (reference fragment.BlockData)."""
@@ -1337,22 +1532,126 @@ class Fragment:
             sub = self.storage.offset_range(0, block_id * block_span, (block_id + 1) * block_span)
             return serialize(sub)
 
+    def _block_rows(self, block_id: int) -> np.ndarray:
+        lo = block_id * HASH_BLOCK_SIZE
+        return np.array(
+            [r for r in self.row_ids() if lo <= r < lo + HASH_BLOCK_SIZE],
+            dtype=np.uint64,
+        )
+
     def merge_block(self, block_id: int, data: bytes) -> tuple[int, int]:
         """Union a peer's block into ours; returns (added, _) counts
         (reference fragment.mergeBlock :1875 — the reference computes
-        set/clear diffs; we union, matching its add-path)."""
+        set/clear diffs; we union, matching its add-path). The union
+        path is the epoch-UNKNOWN fallback: the merged block is a
+        mixture no single write epoch describes, so its epoch resets to
+        unknown until the next real write stamps it (a block the union
+        left unchanged keeps its epoch — nothing moved)."""
         with self.lock:
             other = deserialize(data)
             block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
             abs_bm = other.offset_range(block_id * block_span, 0, block_span)
             before = self.storage.count()
             self.storage.union_in_place(abs_bm)
+            added = self.storage.count() - before
+            if added == 0:
+                return 0, 0
             # Log the change so the WAL stays consistent.
             if self.storage.op_writer is not None:
-                self.storage.op_writer.append_roaring(serialize(abs_bm), self.storage.count() - before, False)
+                self.storage.op_writer.append_roaring(serialize(abs_bm), added, False)
             self._rebuild_cache_rows(np.array(self.row_ids()))
-            self._mutated()
-            return self.storage.count() - before, 0
+            self._mutated(
+                range(block_id * HASH_BLOCK_SIZE,
+                      (block_id + 1) * HASH_BLOCK_SIZE),
+                epoch=0,
+            )
+            self._block_epochs.pop(block_id, None)  # 0 = absent entry
+            if self.storage.any():
+                self.max_row_id = max(
+                    self.max_row_id, self.storage.max() // SHARD_WIDTH
+                )
+            return added, 0
+
+    def replace_block(self, block_id: int, data: bytes, epoch: int,
+                      expected_local_epoch: Optional[int] = None):
+        """Directed repair (ISSUE r15 tentpole 1): make this block
+        byte-identical to the peer's — clears included — and ADOPT the
+        peer's epoch, so both replicas converge to the same
+        (checksum, epoch) pair. Returns (added, removed) bit counts.
+        The WAL logs the remove-then-add as two self-contained roaring
+        ops, so crash replay reproduces the repaired state exactly.
+
+        `expected_local_epoch` closes the snapshot-to-replace race: the
+        sync pass decides "remote wins" from a (checksum, epoch)
+        snapshot taken BEFORE its block_data RPCs, and a client write
+        landing in that window mints a higher local epoch the decision
+        never saw — replacing anyway would remove just-acknowledged
+        bits and re-date the block to the peer's OLDER epoch. When the
+        block's current epoch no longer matches, returns None without
+        touching anything (the next pass re-evaluates against fresh
+        epochs)."""
+        with self.lock:
+            if (
+                expected_local_epoch is not None
+                and self._block_epochs.get(block_id, 0)
+                != expected_local_epoch
+            ):
+                return None
+            other = deserialize(data)
+            block_span = HASH_BLOCK_SIZE * SHARD_WIDTH
+            new_abs = other.offset_range(block_id * block_span, 0, block_span)
+            # offset == start keeps the slice in ABSOLUTE positions —
+            # block_data() ships block-relative (offset 0), so both
+            # sides of the diff must rebase to the same space.
+            old_abs = self.storage.offset_range(
+                block_id * block_span,
+                block_id * block_span,
+                (block_id + 1) * block_span,
+            )
+            to_remove = old_abs.difference(new_abs)
+            to_add = new_abs.difference(old_abs)
+            removed = to_remove.count()
+            added = to_add.count()
+            # Rows present BEFORE the removal: a row the tombstone copy
+            # wholly clears is gone from row_ids() afterwards, and
+            # rebuilding only the after-rows would leave its stale rank
+            # cache entry serving TopN (bulk_add(r, 0) is what pops it).
+            rows_before = self._block_rows(block_id)
+            if removed:
+                self.storage.remove_many(to_remove.to_array())
+                if self.storage.op_writer is not None:
+                    self.storage.op_writer.append_roaring(
+                        serialize(to_remove), removed, True
+                    )
+            if added:
+                self.storage.add_many(to_add.to_array())
+                if self.storage.op_writer is not None:
+                    self.storage.op_writer.append_roaring(
+                        serialize(to_add), added, False
+                    )
+            if added or removed:
+                self._rebuild_cache_rows(
+                    np.union1d(rows_before, self._block_rows(block_id))
+                )
+                rows_touched = range(
+                    block_id * HASH_BLOCK_SIZE, (block_id + 1) * HASH_BLOCK_SIZE
+                )
+                self._mutated(rows_touched, epoch=epoch)
+            # The adopted epoch lands even when the data already agreed
+            # (replicas converge on the epoch axis too).
+            self._block_epochs[block_id] = epoch
+            # HLC receive rule (same floor discipline as sidecar
+            # reload): our next mint must land strictly AFTER any epoch
+            # we adopted, or a skewed-back local clock would stamp a
+            # subsequent genuine write BELOW the epoch the block already
+            # carries — and the peer's older block would win directed
+            # repair, wiping the newer write everywhere.
+            self._epoch_clock = max(self._epoch_clock, epoch)
+            if self.storage.any():
+                self.max_row_id = max(
+                    self.max_row_id, self.storage.max() // SHARD_WIDTH
+                )
+            return added, removed
 
     # -- maintenance -------------------------------------------------------
 

@@ -10,16 +10,75 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 from collections import deque
 from typing import Callable, Optional
 
-from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.fragment import CACHE_EXT, EPOCHS_EXT, Fragment
 
 # Process-global version source: next() is atomic under the GIL, values
 # are unique and monotonic, so concurrent bumps can never collapse into
 # one observable token (used for view generations and field structure
-# versions alike).
-_generation_counter = itertools.count(1)
+# versions alike). Seeded from the wall clock (nanoseconds) so a
+# RESTARTED process can never re-mint a generation value an earlier
+# incarnation already handed out: peer nodes equality-compare these
+# tokens (the piggybacked view-epoch plane, ISSUE r15 tentpole 3), and
+# a counter restarting at 1 would let a rebooted peer's fresh
+# generation collide with a value a coordinator recorded before the
+# reboot — a stale cache entry would revalidate against new data.
+# Within one process the seed is just an origin shift: increments stay
+# +1 per mutation, so max-staleness "generations behind" arithmetic is
+# unchanged.
+# lint: allow-monotonic-time(epoch seed: cross-restart/cross-node token uniqueness needs the wall clock; never used in duration math)
+_generation_counter = itertools.count(time.time_ns())
+
+# Process-wide freshness watermark: "is every generation minted up to
+# this value already VISIBLE where epoch-report walks read?" in one
+# lockless int read. Lets per-request epoch reports (the
+# X-Pilosa-View-Epochs piggyback) memoize their encoded payload and
+# rebuild only when something actually changed. The publish protocol
+# is two-step ON PURPOSE: mint_generation() hands out the token, the
+# caller STORES it where readers look (view.generation /
+# field.structure_version), and only then publish_watermark() raises
+# the watermark — so a reader that observes watermark >= g is
+# guaranteed the store of g already landed. Publishing the watermark
+# inside the mint (one-step) would let a walker read the NEW watermark
+# but the OLD generation attr mid-store, memoize the stale payload
+# under the new watermark, and serve it until the next mint anywhere.
+# max-under-lock keeps the watermark monotone across racing
+# publishers; the store itself is a plain GIL-atomic int publish, so
+# readers never need the lock.
+_mint_lock = threading.Lock()
+_generation_watermark = 0
+
+# Process-incarnation token (unique per boot for the same reason
+# generations are: the counter is wall-seeded). Carried on epoch
+# reports so a peer can tell "this node restarted" apart from "this
+# report is older" — a restart after a backwards clock step mints
+# generations BELOW the previous incarnation's, and an order-only fold
+# guard would reject every fresh report from the reborn process.
+BOOT_ID = next(_generation_counter)
+
+
+def mint_generation() -> int:
+    """One fresh generation token. Store it where readers look BEFORE
+    calling publish_watermark(g) — see the protocol note above."""
+    return next(_generation_counter)
+
+
+def publish_watermark(g: int) -> None:
+    """Raise the watermark to g (monotone; no-op if already past)."""
+    global _generation_watermark
+    with _mint_lock:
+        if g > _generation_watermark:
+            # lint: allow-shared-state(plain GIL-atomic int publish, stores serialized by _mint_lock and guarded monotone; the lockless reader sees old-or-new, never torn — a lagging read only costs one memo rebuild, never staleness, because consumers re-check the watermark AFTER building what they memoize)
+            _generation_watermark = g
+
+
+def generation_watermark() -> int:
+    """Newest PUBLISHED generation process-wide (lockless read)."""
+    return _generation_watermark
+
 
 VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
@@ -68,7 +127,8 @@ class View:
         # Seeded from the counter: pristine views must NOT share a token,
         # or a deleted-and-recreated field could match a stale cache
         # fingerprint keyed by (index, field) alone.
-        self.generation = next(_generation_counter)
+        self.generation = mint_generation()
+        publish_watermark(self.generation)  # after the store, per protocol
         # Structure-only callback (fragment create/delete): invalidates
         # the owning field's available-shards cache without paying for it
         # on every data write.
@@ -107,7 +167,11 @@ class View:
 
     def _bump_data(self, shard: Optional[int] = None) -> None:
         with self._journal_lock:
-            self.generation = next(_generation_counter)
+            self.generation = mint_generation()
+            # Watermark raised only once the new generation is readable
+            # on the attr — a walker observing the watermark must never
+            # still read the old value (see the module protocol note).
+            publish_watermark(self.generation)
             j = self._journal
             if j and shard is not None and j[-1][2] == shard:
                 # Contiguous same-shard run: extend in place. Any
@@ -212,6 +276,7 @@ class View:
                 self._bump_data()
                 if self.on_structure_change is not None:
                     self.on_structure_change()
-                cache_path = (frag.path or "") + ".cache"
-                if frag.path and os.path.exists(cache_path):
-                    os.remove(cache_path)
+                for ext in (CACHE_EXT, EPOCHS_EXT):
+                    side = (frag.path or "") + ext
+                    if frag.path and os.path.exists(side):
+                        os.remove(side)

@@ -150,13 +150,22 @@ class Executor:
         translate = self._needs_translation(idx)
         if query.calls and not prof.call:
             prof.call = query.calls[0].name
-        # Result-cache plane (exec/rescache.py): consulted only where a
-        # LOCAL epoch vector can witness every relevant write — the
-        # single-node coordinator and remote per-node legs. A clustered
-        # coordinator's answers depend on peer-held shards whose writes
-        # never bump local generations, so it must not cache.
+        # Result-cache plane (exec/rescache.py): consulted where an
+        # epoch vector can witness every relevant write. Locally that is
+        # the single-node coordinator and remote per-node legs; a
+        # CLUSTERED coordinator consults only once the cluster layer has
+        # installed the peer-epoch provider (ISSUE r15 tentpole 3) —
+        # entries then carry the merged (local + peer) vector and peer
+        # writes invalidate via the piggybacked epoch map. Without the
+        # provider, peer-held shards' writes are unwitnessable and the
+        # coordinator must not cache.
         cache = self.rescache
-        if cache is not None and self.mapper is not None and not opt.remote:
+        if (
+            cache is not None
+            and self.mapper is not None
+            and not opt.remote
+            and cache.peer_epochs_provider is None
+        ):
             cache = None
 
         with self.tracer.start_span("executor.Execute") as span:

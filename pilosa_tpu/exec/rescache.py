@@ -41,12 +41,20 @@ Memory is governed by a strict ledger under an LRU bound (mirroring the
 live entries at all times, and inserts evict coldest-first until the
 budget holds. /debug/rescache dumps the ledger coldest-first.
 
-Scope: the cache consults at the COORDINATOR only on a single node
-(executor.mapper is None), and on remote per-node legs (opt.remote),
-where every covered view is local and the local journal explains every
-write. A clustered coordinator's full-answer cache is deliberately NOT
-consulted: a write entering via a peer never bumps the coordinator's
-local generations, so no local epoch vector can witness it.
+Scope: the cache consults at a single-node COORDINATOR and on remote
+per-node legs (opt.remote), where every covered view is local and the
+local journal explains every write. Since ISSUE r15 a CLUSTERED
+coordinator consults too, once the cluster layer installs
+`peer_epochs_provider`: fan-out entries carry the merged (local +
+peer) epoch vector — the peer part is each covering node's
+last-piggybacked view epochs (X-Pilosa-View-Epochs on internal RPC
+responses, folded by cluster/cluster.py) — and revalidation compares
+it against the live map, so a peer write the coordinator has heard
+about makes the entry unservable. Writes routed THROUGH the
+coordinator (replica writes, imports) piggyback synchronously; writes
+entering via other nodes are bounded by the failure detector's
+~1 s /status probes (the documented freshness window,
+docs/administration.md "Result caching").
 
 Concurrency: one leaf lock guards the map + ledger; epoch resolution
 and revalidation (which take view journal locks) happen OUTSIDE it.
@@ -93,14 +101,19 @@ class _Token:
     hit or a retained commit, `entry` links to the cache entry so the
     serialization layer can read/attach pre-encoded wire bytes."""
 
-    __slots__ = ("key", "index", "fields_sig", "views_sig", "hit", "value",
-                 "stale_by", "entry", "_shard_set", "_pql")
+    __slots__ = ("key", "index", "fields_sig", "views_sig", "peers_sig",
+                 "hit", "value", "stale_by", "entry", "_shard_set",
+                 "_shards_t", "_pql")
 
-    def __init__(self, key, index, fields_sig, views_sig):
+    def __init__(self, key, index, fields_sig, views_sig, peers_sig=None):
         self.key = key
         self.index = index
         self.fields_sig = fields_sig
         self.views_sig = views_sig
+        # Peer epoch vector (ISSUE r15 tentpole 3): the covering peers'
+        # last-piggybacked view epochs at begin() time, None on a
+        # single node, () when the shard set is covered locally.
+        self.peers_sig = peers_sig
         self.hit = False
         self.value = None
         self.stale_by = 0
@@ -114,19 +127,22 @@ _MAX_WIRE_VARIANTS = 4
 
 
 class _Entry:
-    __slots__ = ("key", "index", "pql", "shard_set", "value", "nbytes",
-                 "fields_sig", "views_sig", "hits", "inserted_mono", "wire")
+    __slots__ = ("key", "index", "pql", "shard_set", "shards_t", "value",
+                 "nbytes", "fields_sig", "views_sig", "peers_sig", "hits",
+                 "inserted_mono", "wire")
 
-    def __init__(self, key, index, pql, shard_set, value, nbytes,
-                 fields_sig, views_sig):
+    def __init__(self, key, index, pql, shard_set, shards_t, value, nbytes,
+                 fields_sig, views_sig, peers_sig=None):
         self.key = key
         self.index = index
         self.pql = pql
         self.shard_set = shard_set
+        self.shards_t = shards_t  # interned tuple (provider memo key)
         self.value = value
         self.nbytes = nbytes
         self.fields_sig = fields_sig
         self.views_sig = views_sig
+        self.peers_sig = peers_sig
         self.hits = 0
         self.inserted_mono = time.monotonic()
         # Pre-encoded response fragments keyed by encoding flags
@@ -209,6 +225,16 @@ class ResultCache:
         self.holder = holder
         self.max_bytes = int(max_bytes)
         self.max_staleness = int(max_staleness)
+        # Peer-epoch provider (ISSUE r15 tentpole 3), installed by
+        # Cluster.attach: (index, field_names, shards_tuple) -> a tuple
+        # signature of every covering peer's last-piggybacked view
+        # epochs, () when the shard set is locally covered, or None when
+        # some covering peer's state is unknown (uncacheable). When set,
+        # a CLUSTERED coordinator may consult this cache: its entries
+        # carry the merged (local + peer) epoch vector, and revalidation
+        # compares the peer part against the live map — a peer write
+        # piggybacked since then makes the entry unservable.
+        self.peer_epochs_provider = None
         # Leaf lock: guards _entries/_resident/_salt and NOTHING else is
         # acquired while holding it except the stats registry lock
         # (gauge writes stay inside so two interleaved commits can't
@@ -349,6 +375,20 @@ class ResultCache:
                 views_sig.append((f.name, vname, v, v.generation))
         return tuple(fields_sig), tuple(views_sig)
 
+    def _peer_vector(self, index: str, fields_sig, shards_t, remote: bool):
+        """(ok, peers_sig): the covering peers' epoch signature for this
+        key, or (False, None) = uncacheable. None provider (single node)
+        and remote legs (local coverage by construction) carry no peer
+        vector."""
+        if self.peer_epochs_provider is None or remote:
+            return True, None
+        sig = self.peer_epochs_provider(
+            index, [fs[0] for fs in fields_sig], shards_t
+        )
+        if sig is None:
+            return False, None
+        return True, sig
+
     def _revalidate(self, entry: _Entry) -> tuple[bool, int]:
         """(addressable, generations_behind) for a stored entry against
         the LIVE schema: identity + structure must match exactly; a data
@@ -378,6 +418,22 @@ class ResultCache:
             if entry.shard_set.isdisjoint(dirty):
                 continue  # writes landed outside the covered shards
             behind = max(behind, cur - gen)
+        if entry.peers_sig is not None:
+            # Clustered-coordinator entry: the peer part of the vector
+            # must match the CURRENT per-peer epoch map exactly — a
+            # peer write piggybacked since this entry was recorded (or
+            # ownership moving to a peer we haven't heard from) makes
+            # it unservable. Never stale-servable: no generation-count
+            # bound is derivable across nodes.
+            provider = self.peer_epochs_provider
+            if provider is None:
+                return False, -1
+            cur_sig = provider(
+                entry.index, [fs[0] for fs in entry.fields_sig],
+                entry.shards_t,
+            )
+            if cur_sig != entry.peers_sig:
+                return False, -1
         return True, behind
 
     # -- the serving API ----------------------------------------------------
@@ -438,14 +494,23 @@ class ResultCache:
         # Miss path: NOW pay the coverage walk, pre-execution — the
         # vector must be snapshotted before any data is read so a write
         # racing the execution ages the entry out early, never late.
+        # The peer vector snapshots the same way: the coordinator's map
+        # may lag the peer's true state, in which case the entry is
+        # tagged with the OLDER epochs and the fan-out's own piggyback
+        # advances the map past it — the entry ages out one fan-out
+        # early, never late.
         sig = self._epoch_vector(index, call)
         if sig is None:
             return None
-        token = _Token(key, index, sig[0], sig[1])
+        ok, peers_sig = self._peer_vector(index, sig[0], shards_t, remote)
+        if not ok:
+            return None  # a covering peer's epochs are unknown (yet)
+        token = _Token(key, index, sig[0], sig[1], peers_sig)
         with self._lock:
             self.misses += 1
         global_stats.with_tags(f"index:{index}").count("rescache_misses_total")
         token._shard_set = shard_set  # noqa: SLF001 — token-internal carry
+        token._shards_t = shards_t  # noqa: SLF001
         token._pql = pql  # noqa: SLF001
         return token
 
@@ -479,8 +544,8 @@ class ResultCache:
             return
         entry = _Entry(
             token.key, token.index, token._pql,
-            token._shard_set, value, nbytes,
-            token.fields_sig, token.views_sig,
+            token._shard_set, token._shards_t, value, nbytes,
+            token.fields_sig, token.views_sig, token.peers_sig,
         )
         evicted = 0
         with self._lock:

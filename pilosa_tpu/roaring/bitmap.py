@@ -1105,16 +1105,20 @@ class Bitmap:
 
     # -- import (bulk union/clear from serialized roaring) ----------------
 
-    def import_roaring_bits(self, data: bytes, clear: bool = False, log: bool = True) -> int:
+    def import_roaring_bits(self, data: bytes, clear: bool = False, log: bool = True, parsed: Optional["Bitmap"] = None) -> int:
         """Union (or clear) a serialized roaring bitmap into self in one op.
 
         reference roaring/roaring.go:1511 ImportRoaringBits; logged as a
         single AddRoaring/RemoveRoaring op (reference fragment.go:2255).
-        Returns the number of bits changed.
+        Returns the number of bits changed. `parsed` lets a caller that
+        already deserialized `data` (fragment.import_roaring reads the
+        container keys for epoch stamping) skip the second parse; it
+        must be the deserialization of `data` — the WAL still logs the
+        raw bytes.
         """
         from pilosa_tpu.roaring.codec import deserialize
 
-        other = deserialize(data)
+        other = parsed if parsed is not None else deserialize(data)
         changed = 0
         for key, b in other._cs.items():
             a = self._cs.get(key)

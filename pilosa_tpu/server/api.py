@@ -56,6 +56,25 @@ class API:
         self.holder = holder
         self.executor = executor if executor is not None else Executor(holder)
         self.cluster = cluster  # wired by pilosa_tpu/cluster
+        # Memo for the encoded X-Pilosa-View-Epochs header: a bounded
+        # tuple of (index, generation watermark at build, encoded
+        # payload) entries so a coordinator serving remote legs for
+        # several indexes doesn't thrash one slot. Rebuilt only when
+        # ANY view/field minted since — between writes every remote leg
+        # reuses the bytes instead of re-walking the schema +
+        # re-encoding per request. An immutable tuple published by
+        # plain assignment (the documented GIL-atomic swap idiom), so
+        # concurrent query threads need no lock.
+        self._epoch_header_memo: tuple = ()
+        # Same memo for /status's ALL-index indexEpochs report (the
+        # failure detector probes every peer ~1/s: between mints the
+        # probe plane reuses the walk instead of re-paying it per probe
+        # per peer). The memoized subtree is shared across responses —
+        # consumers read it, never mutate. A schema object created
+        # without a mint (bare field, no view yet) shows up one mint
+        # late; that only delays a peer's cacheability (unknown field =
+        # uncacheable), never serves stale.
+        self._epoch_status_memo: tuple = (-1, None)
         # Set by the HTTP server once the listener is bound.
         self.local_host = "localhost"
         self.local_port = 10101
@@ -824,7 +843,98 @@ class API:
             rz = self.cluster.resizer.follower_status()
             if rz:
                 out["resize"] = rz
+        if self.cluster is not None:
+            # View-epoch piggyback on the probe plane (ISSUE r15
+            # tentpole 3): the failure detector polls /status every
+            # ~interval second, so every peer's epoch map advances even
+            # for indexes no fan-out has touched — this is what bounds
+            # the clustered result cache's staleness window for writes
+            # that never route through the coordinator. Memoized on the
+            # generation watermark (read BEFORE the walk, same protocol
+            # as view_epochs_header) so idle probes don't re-walk the
+            # schema.
+            from pilosa_tpu.core.view import BOOT_ID, generation_watermark
+
+            wm = generation_watermark()
+            got_wm, got_indexes = self._epoch_status_memo
+            if got_wm != wm or got_indexes is None:
+                got_indexes = self.view_epochs_payload()["indexes"]
+                if generation_watermark() == wm:
+                    # Same torn-walk discipline as view_epochs_header:
+                    # a walk a mint landed inside ships once, unmemoized.
+                    self._epoch_status_memo = (wm, got_indexes)
+            out["indexEpochs"] = got_indexes
+            out["indexEpochsBoot"] = BOOT_ID
         return out
+
+    def view_epochs_header(self, index: str) -> str:
+        """Encoded X-Pilosa-View-Epochs value for one index, memoized on
+        the process-wide generation watermark: the watermark is read
+        BEFORE the walk and re-checked AFTER, so a memo hit proves
+        nothing minted since the stored payload was assembled (no
+        staleness, the piggyback's synchronous write-invalidation
+        contract holds). A walk the re-check catches a mint inside may
+        be TORN (one view's generation read pre-mint, another's post) —
+        it still ships (the very mint that tore it will raise the
+        watermark and the next report supersedes), but it must never be
+        memoized: a torn payload under a settled watermark would serve
+        the stale generation until the next mint anywhere."""
+        from pilosa_tpu.core.view import generation_watermark
+
+        wm = generation_watermark()
+        memo = self._epoch_header_memo
+        for got_index, got_wm, got_enc in memo:
+            if got_index == index and got_wm == wm:
+                return got_enc
+        enc = json.dumps(
+            self.view_epochs_payload(index), separators=(",", ":")
+        )
+        if generation_watermark() != wm:
+            return enc  # possibly torn: usable once, never memoized
+        # Keep other indexes' entries that are still current (a mint
+        # anywhere obsoletes every entry), newest first, bounded.
+        self._epoch_header_memo = ((index, wm, enc),) + tuple(
+            e for e in memo if e[0] != index and e[1] == wm
+        )[:7]
+        return enc
+
+    def view_epochs_payload(self, index: Optional[str] = None) -> dict:
+        """This node's view-epoch report ({"node", "indexes": {index:
+        {field: {"structure": int, "views": {view: generation}}}}}) for
+        one index or all — the X-Pilosa-View-Epochs piggyback body and
+        the /status indexEpochs field. Generations come from the
+        wall-seeded process counter (core/view.py), so values are
+        unique across restarts and peers compare them by equality."""
+        names = [index] if index is not None else list(self.holder.indexes)
+        indexes: dict = {}
+        for iname in names:
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            fields: dict = {}
+            for fname in list(idx.fields):
+                f = idx.field(fname)
+                if f is None:
+                    continue
+                fields[fname] = {
+                    "structure": f.structure_version,
+                    "views": {
+                        vname: v.generation
+                        for vname, v in sorted(list(f.views.items()))
+                    },
+                }
+            indexes[iname] = fields
+        from pilosa_tpu.core.view import BOOT_ID
+
+        return {
+            "node": self.cluster.node_id if self.cluster is not None else "local",
+            # Incarnation token: lets the fold guard tell "this node
+            # restarted" (accept the fresh report even if its max
+            # generation is lower — a post-clock-step reboot mints
+            # below the previous life) from "this report is older".
+            "boot": BOOT_ID,
+            "indexes": indexes,
+        }
 
     def info(self) -> dict:
         import os

@@ -124,6 +124,13 @@ class Config:
     # Aggregate migration fetch bandwidth cap in bytes/s (0 = uncapped)
     # so a resize cannot saturate the links the serving path shares.
     migration_bandwidth: int = 0
+    # -- replica consistency plane (ISSUE r15) -----------------------------
+    # Bound on the read-repair probe queue (cluster/consistency.py): a
+    # hedge race's two answers enqueue one background checksum diff;
+    # past this depth probes are dropped (read_repair_dropped_total —
+    # the periodic anti-entropy sweep backstops them) so a divergence
+    # storm can never buffer unboundedly. 0 disables the monitor.
+    read_repair_queue: int = 128
     # In-flight /query admission cap (server/http.py): past this many
     # concurrently executing queries, new ones are shed with 429 +
     # Retry-After + code=overloaded (http_requests_shed_total) instead
@@ -277,6 +284,7 @@ class Config:
             "resize-lease": self.resize_lease,
             "migration-concurrency": self.migration_concurrency,
             "migration-bandwidth": self.migration_bandwidth,
+            "read-repair-queue": self.read_repair_queue,
             "slo": [dict(o) for o in self.slo],
         }
 
@@ -329,6 +337,7 @@ class Config:
             "resize-lease": "resize_lease",
             "migration-concurrency": "migration_concurrency",
             "migration-bandwidth": "migration_bandwidth",
+            "read-repair-queue": "read_repair_queue",
         }
         for k, attr in simple.items():
             if k in data:
@@ -392,6 +401,7 @@ class Config:
             pre + "RESIZE_LEASE": ("resize_lease", float),
             pre + "MIGRATION_CONCURRENCY": ("migration_concurrency", int),
             pre + "MIGRATION_BANDWIDTH": ("migration_bandwidth", int),
+            pre + "READ_REPAIR_QUEUE": ("read_repair_queue", int),
             pre + "SLO": (
                 "slo",
                 lambda v: Config._normalize_slo(json.loads(v)) if v else [],
@@ -443,6 +453,7 @@ class Config:
             f"resize-lease = {c.resize_lease}\n"
             f"migration-concurrency = {c.migration_concurrency}\n"
             f"migration-bandwidth = {c.migration_bandwidth}\n"
+            f"read-repair-queue = {c.read_repair_queue}\n"
             + "".join(
                 "\n[[slo]]\n"
                 # json.dumps: a tagged metric spelling like

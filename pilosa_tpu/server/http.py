@@ -943,7 +943,7 @@ class _Handler(BaseHTTPRequestHandler):
                 with prof.phase("resp_write"):
                     self._reply(
                         data, content_type="application/x-protobuf",
-                        headers=self._cache_marker(prof),
+                        headers=self._query_headers(prof, index, remote),
                     )
                 return
             # Zero-copy serving path (ISSUE r14): the API layer hands
@@ -957,7 +957,45 @@ class _Handler(BaseHTTPRequestHandler):
             # — a queueing signal, not serialization cost (the raw send
             # is ~1 µs; docs/observability.md phase table).
             with prof.phase("resp_write"):
-                self._reply_bytes(data, headers=self._cache_marker(prof))
+                self._reply_bytes(
+                    data, headers=self._query_headers(prof, index, remote)
+                )
+
+    def _query_headers(self, prof, index, remote) -> Optional[dict]:
+        """Cache marker + (on remote legs) the view-epoch piggyback: a
+        peer-issued request's response carries this node's POST-execution
+        epochs for the queried index (X-Pilosa-View-Epochs), which is
+        how a coordinator's per-peer epoch map advances — a replica
+        write routed here invalidates the coordinator's cached fan-outs
+        synchronously with its own response (ISSUE r15 tentpole 3).
+        Headers stay off non-remote responses: external clients never
+        pay the report bytes."""
+        headers = self._cache_marker(prof)
+        piggyback = self._epoch_piggyback_headers(index, remote)
+        if piggyback:
+            headers = dict(headers) if headers else {}
+            headers.update(piggyback)
+        return headers
+
+    def _epoch_piggyback_headers(self, index, remote) -> Optional[dict]:
+        """The view-epoch piggyback for any peer-issued WRITE or QUERY
+        response (imports included: the freshness contract says writes
+        routed through the coordinator invalidate its cached fan-outs
+        synchronously with their own response, and an import that
+        didn't carry its post-write epochs would leave the coordinator
+        serving pre-import answers until the next ~1 s probe fold).
+        None on non-remote responses: external clients never pay the
+        report bytes."""
+        if not remote:
+            return None
+        try:
+            # Memoized on the generation watermark: between writes the
+            # encoded report is reused, not re-walked per request.
+            encoded = self.api.view_epochs_header(index)
+        # lint: allow-except-exception(epoch piggyback is best-effort: its absence only delays cache invalidation to the next probe fold; the query answer itself must still ship)
+        except Exception:  # noqa: BLE001 — piggyback is an optimization
+            return None
+        return {"X-Pilosa-View-Epochs": encoded}
 
     @staticmethod
     def _cache_marker(prof) -> Optional[dict]:
@@ -1079,7 +1117,10 @@ class _Handler(BaseHTTPRequestHandler):
                     column_keys=payload.get("columnKeys"),
                     timestamps=payload.get("timestamps"), clear=clear, remote=remote,
                 )
-        self._reply({"success": True})
+        self._reply(
+            {"success": True},
+            headers=self._epoch_piggyback_headers(index, remote),
+        )
 
     @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>\d+)")
     def handle_post_import_roaring(self, index, field, shard):
@@ -1112,7 +1153,10 @@ class _Handler(BaseHTTPRequestHandler):
             clear = bool(payload.get("clear", False))
         remote = self.query.get("remote") == "true"
         self.api.import_roaring(index, field, int(shard), views, clear=clear, remote=remote)
-        self._reply({"success": True})
+        self._reply(
+            {"success": True},
+            headers=self._epoch_piggyback_headers(index, remote),
+        )
 
     @route("GET", r"/export")
     def handle_get_export(self):
@@ -1657,7 +1701,14 @@ class _Handler(BaseHTTPRequestHandler):
         if frag is None:
             self._error("fragment not found", status=404)
             return
-        blocks = [{"id": b, "checksum": str(c)} for b, c in frag.checksum_blocks()]
+        # (checksum, epoch) pairs since ISSUE r15: epoch 0 = unknown
+        # (the receiver unions), and tombstoned blocks ship as
+        # checksum 0 with their clear's epoch so block-wide deletes
+        # propagate. Stringified like the checksum (64-bit-safe JSON).
+        blocks = [
+            {"id": b, "checksum": str(c), "epoch": str(e)}
+            for b, c, e in frag.block_sums_epochs()
+        ]
         self._reply({"blocks": blocks})
 
     @route("GET", r"/internal/fragment/block/data")
@@ -1674,7 +1725,52 @@ class _Handler(BaseHTTPRequestHandler):
         if frag is None:
             self._error("fragment not found", status=404)
             return
-        self._reply(frag.block_data(block), content_type="application/octet-stream")
+        data, epoch = frag.block_data_epoch(block)
+        # The epoch rides WITH the data (one lock acquisition on the
+        # serving side): the syncer stamps the adopted block with the
+        # epoch of exactly these bytes, not its earlier snapshot's.
+        self._reply(
+            data, content_type="application/octet-stream",
+            headers={"X-Pilosa-Block-Epoch": str(epoch)},
+        )
+
+    @route("POST", r"/internal/fragment/repair")
+    def handle_post_fragment_repair(self):
+        """Targeted epoch-directed repair of one local fragment (the
+        read-repair plane's fan-out, ISSUE r15 tentpole 2): this node
+        pulls the named blocks from its live replicas, higher epoch
+        wins, union where epochs are unknown. Body: {index, field,
+        view, shard, blocks: [...]} — an empty blocks list repairs the
+        whole fragment."""
+        if self.api.cluster is None:
+            self._error("not clustered", status=400)
+            return
+        body = self._json_body()
+        from pilosa_tpu.cluster.sync import HolderSyncer
+
+        repaired = HolderSyncer(self.api.cluster).sync_fragment_targeted(
+            str(body.get("index", "")),
+            str(body.get("field", "")),
+            str(body.get("view", "standard")),
+            int(body.get("shard", 0)),
+            blocks=[int(b) for b in body.get("blocks", [])],
+        )
+        self._reply({"repaired": repaired})
+
+    @route("GET", r"/debug/consistency")
+    def handle_debug_consistency(self):
+        """Replica-divergence ledger (ISSUE r15 tentpole 2), ordered by
+        staleness — unrepaired divergences first, oldest first. {enabled:
+        false} when no divergence monitor is wired."""
+        mon = getattr(self.api.cluster, "divergence", None) if (
+            self.api.cluster is not None
+        ) else None
+        if mon is None:
+            self._reply(
+                {"enabled": False, "pendingProbes": 0, "entries": []}
+            )
+            return
+        self._reply(mon.debug_dump())
 
     @route("GET", r"/internal/field/state")
     def handle_get_field_state(self):

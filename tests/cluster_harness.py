@@ -189,6 +189,65 @@ class FaultProxy:
         self._thread.join(timeout=5)
 
 
+class SymmetricPartition:
+    """Both directions between two TestCluster nodes blackholed with ONE
+    call (ISSUE r15 satellite): a FaultProxy per direction plus
+    RewriteClients installed on both nodes, so the chaos harness and the
+    bench's partition_heal leg share one primitive. partition() flips
+    both proxies to blackhole, heal() restores pass-through, close()
+    tears both proxies down leak-proof (FaultProxy.close joins the
+    accept loops and shuts every piped socket)."""
+
+    def __init__(self, tc: "TestCluster", i: int = 0, j: int = 1,
+                 timeout: float = 0.5):
+        self.proxies = []
+        self._restore = []
+        for src, dst in ((tc[i], tc[j]), (tc[j], tc[i])):
+            target = dst.node.uri
+            proxy = FaultProxy(target.host, target.port)
+            rc = RewriteClient(
+                {f"{target.host}:{target.port}": f"127.0.0.1:{proxy.port}"},
+                timeout=timeout,
+            )
+            self._restore.append(
+                (src.cluster, src.cluster.client,
+                 src.cluster.broadcaster.client)
+            )
+            src.cluster.client = rc
+            src.cluster.broadcaster.client = rc
+            # Piggyback folds keep working through the proxy: the
+            # rewrite is at the dial hook, identity untouched.
+            rc.on_peer_epochs = src.cluster.fold_peer_epochs
+            self.proxies.append(proxy)
+
+    def partition(self) -> None:
+        for p in self.proxies:
+            p.mode = "blackhole"
+
+    def heal(self) -> None:
+        for p in self.proxies:
+            p.mode = "pass"
+
+    def close(self) -> None:
+        # Restore the clients we replaced BEFORE tearing the proxies
+        # down: cross-node RPCs after the `with` block (post-heal
+        # convergence waits, later fan-outs) must not dial dead proxy
+        # ports — that reads as connection-refused far from its cause
+        # and trips breakers.
+        for cluster, client, bclient in self._restore:
+            cluster.client = client
+            cluster.broadcaster.client = bclient
+        self._restore = []
+        for p in self.proxies:
+            p.close()
+
+    def __enter__(self) -> "SymmetricPartition":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class RewriteClient(InternalClient):
     """InternalClient that dials selected peers through a FaultProxy:
     rewrites is the {'host:port': 'host:proxyport'} connection map. Node

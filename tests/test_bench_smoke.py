@@ -37,6 +37,10 @@ SMOKE_ENV = {
     "BENCH_INGEST_READERS": "2",
     "BENCH_INGEST_BATCH": "32",
     "BENCH_INGEST_SHARDS": "2",
+    # Tiny partition-heal drill (r15): two shards exercise every epoch
+    # resolution arm; convergence is the contract, not a rate.
+    "BENCH_PARTITION_SHARDS": "2",
+    "BENCH_PARTITION_TIMEOUT": "30",
     # Tiny rolling-restart drill (r9): subprocess-cluster machinery
     # smoke; the leg self-skips (keys still present) where subprocess
     # networking is restricted.
@@ -104,6 +108,15 @@ def test_bench_smoke(tmp_path):
     assert blob["ingest_read_qps_under_load"] > 0
     assert "ingest_read_p99_delta_ms" in blob
     assert "ingest_version_walks" in blob
+    # The r15 partition-heal keys the driver's acceptance reads: the
+    # partition was real, the cluster reconverged, zero resurrections,
+    # and directed repairs were recorded for BOTH heal directions.
+    assert blob["partition_heal_proven_blackholed"] is True
+    assert blob["partition_heal_converged"] is True
+    assert blob["partition_heal_convergence_s"] is not None
+    assert blob["partition_heal_resurrected_bits"] == 0
+    dr = blob["partition_heal_directed_repairs"]
+    assert dr.get("remote_wins", 0) > 0 and dr.get("local_wins", 0) > 0, dr
     # The r9 rolling-restart keys: present even when the environment
     # forces a skip; when the drill ran, every restart reconverged.
     for key in ("rolling_restart_skipped", "rolling_restart_windows",
@@ -136,7 +149,7 @@ def test_bench_smoke(tmp_path):
     for leg in ("build", "cold_build", "tpu_batch", "single_query",
                 "minmax_churn", "http", "qps@1", "qps@4",
                 "concurrency_sweep", "zipf@1", "zipf@4", "zipf_cache",
-                "ingest_under_load", "rolling_restart",
+                "partition_heal", "ingest_under_load", "rolling_restart",
                 "mesh@1", "mesh@2", "mesh_scaling"):
         assert leg in blob["legs_done"], blob["legs_done"]
     # The partial artifact also landed complete on disk.

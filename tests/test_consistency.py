@@ -1,0 +1,506 @@
+"""Replica consistency plane tests (ISSUE r15): per-block epoch
+stamping + sidecar persistence, directed-replace semantics, read-path
+divergence detection (hedge-race observation, bounded queue, targeted
+repair), the /debug/consistency ledger, the SymmetricPartition chaos
+primitive, and the anti-entropy-vs-resize skip."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cluster.consistency import DivergenceMonitor
+from pilosa_tpu.core.fragment import EPOCHS_EXT, Fragment
+from pilosa_tpu.utils.stats import global_stats
+from tests.cluster_harness import SymmetricPartition, TestCluster
+
+VIEW_STANDARD = "standard"
+
+
+def _counter(name: str) -> float:
+    snap = global_stats.snapshot()["counters"]
+    return sum(v for k, v in snap.items() if k.startswith(name))
+
+
+def _frag(cn, index, field, shard):
+    idx = cn.holder.index(index)
+    f = idx.field(field) if idx else None
+    v = f.view(VIEW_STANDARD) if f else None
+    return v.fragment(shard) if v else None
+
+
+def _await(cond, timeout=10.0, every=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    raise TimeoutError(f"{what} never held within {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# Per-block epochs: stamping, tombstones, persistence
+# ---------------------------------------------------------------------------
+
+
+class TestBlockEpochs:
+    def test_every_mutation_stamps_its_block(self):
+        f = Fragment(None, "i", "f", "standard", 0)
+        f.set_bit(3, 7)
+        e1 = f.block_epoch(0)
+        assert e1 > 0
+        f.set_bit(250, 7)  # block 2
+        assert f.block_epoch(2) > e1  # per-fragment monotone
+        f.clear_bit(3, 7)
+        assert f.block_epoch(0) > e1  # clears stamp too (tombstones)
+
+    def test_tombstone_reported_on_wire_payload(self):
+        f = Fragment(None, "i", "f", "standard", 0)
+        f.set_bit(1, 5)
+        f.clear_bit(1, 5)
+        blocks = f.block_sums_epochs()
+        assert blocks == [(0, 0, f.block_epoch(0))]
+        assert f.checksum_blocks() == []  # the legacy view skips empties
+
+    def test_epochs_survive_clean_restart(self, tmp_path):
+        path = str(tmp_path / "frag")
+        f = Fragment(path, "i", "f", "standard", 0).open()
+        f.set_bit(1, 5)
+        e = f.block_epoch(0)
+        f.close()
+        g = Fragment(path, "i", "f", "standard", 0).open()
+        assert g.block_epoch(0) == e
+        # The reopened fragment's next mint lands strictly after.
+        g.set_bit(1, 6)
+        assert g.block_epoch(0) > e
+        g.close()
+
+    def test_stale_sidecar_degrades_to_unknown(self, tmp_path):
+        """WAL bytes appended after the last sidecar write (the crash
+        shape: no clean close) make the sidecar unadoptable — those
+        blocks report epoch 0 and repair degrades to union, never a
+        misdirected wipe."""
+        path = str(tmp_path / "frag")
+        f = Fragment(path, "i", "f", "standard", 0).open()
+        f.set_bit(1, 5)
+        f.close()  # sidecar written, size-stamped
+        g = Fragment(path, "i", "f", "standard", 0).open()
+        g.set_bit(1, 6)  # WAL grows past the sidecar's stamp
+        # Simulated crash: drop the handle without close() (no sidecar
+        # rewrite), then reopen.
+        g._file.release()
+        h = Fragment(path, "i", "f", "standard", 0).open()
+        assert h.row_count(1) == 2  # WAL replayed fine
+        assert h.block_epoch(0) == 0  # epochs honestly unknown
+        h.close()
+
+    def test_replace_block_floors_the_mint_clock(self):
+        """HLC receive rule: after adopting a peer's (possibly
+        future-skewed) epoch, the next LOCAL mint must land strictly
+        after it — otherwise a skewed-back clock stamps a genuine new
+        write below the epoch the block already carries and the peer's
+        OLDER block wins directed repair (review finding)."""
+        a = Fragment(None, "i", "f", "standard", 0)
+        b = Fragment(None, "i", "f", "standard", 0)
+        b.set_bit(1, 7)
+        # Simulate B's wall clock running far ahead of A's.
+        future = b.block_epoch(0) + 10**12
+        a.replace_block(0, b.block_data(0), future)
+        assert a.block_epoch(0) == future
+        a.set_bit(1, 9)  # a genuinely NEWER local write
+        assert a.block_epoch(0) > future
+
+    def test_replace_block_skips_on_stale_expected_epoch(self):
+        """The snapshot-to-replace race: a client write landing between
+        the sync pass's epoch snapshot and the directed replace mints a
+        newer local epoch the decision never saw — replacing anyway
+        would wipe the acked write and re-date the block OLDER (review
+        finding). A mismatched expected_local_epoch skips untouched."""
+        a = Fragment(None, "i", "f", "standard", 0)
+        b = Fragment(None, "i", "f", "standard", 0)
+        a.set_bit(1, 5)
+        snapshot_epoch = a.block_epoch(0)
+        b.set_bit(1, 7)
+        a.set_bit(1, 9)  # the racing client write, after the snapshot
+        racing_epoch = a.block_epoch(0)
+        assert a.replace_block(
+            0, b.block_data(0), b.block_epoch(0),
+            expected_local_epoch=snapshot_epoch,
+        ) is None
+        assert sorted(a.row(1).columns().tolist()) == [5, 9]  # untouched
+        assert a.block_epoch(0) == racing_epoch
+        # A matching expectation still replaces.
+        assert a.replace_block(
+            0, b.block_data(0), b.block_epoch(0),
+            expected_local_epoch=racing_epoch,
+        ) is not None
+        assert a.row(1).columns().tolist() == [7]
+
+    def test_replace_block_tombstone_purges_rank_cache(self):
+        """A row wholly cleared by tombstone repair must leave the TopN
+        rank cache too: rebuilding only the rows present AFTER the
+        directed copy misses it (review finding) — the stale entry
+        would resurrect the row in TopN answers."""
+        a = Fragment(None, "i", "f", "standard", 0)
+        b = Fragment(None, "i", "f", "standard", 0)
+        for frag in (a, b):
+            frag.set_bit(1, 5)
+            frag.set_bit(1, 9)
+        b.clear_bit(1, 5)
+        b.clear_bit(1, 9)  # block 0 tombstoned on b
+        a.replace_block(0, b.block_data(0), b.block_epoch(0))
+        assert a.row_count(1) == 0
+        assert all(p.id != 1 for p in a.top(n=10))
+
+    def test_replace_block_adopts_peer_state_and_epoch(self):
+        a = Fragment(None, "i", "f", "standard", 0)
+        b = Fragment(None, "i", "f", "standard", 0)
+        a.set_bit(1, 5)
+        a.set_bit(1, 9)
+        b.set_bit(1, 7)
+        peer_epoch = b.block_epoch(0)
+        added, removed = a.replace_block(0, b.block_data(0), peer_epoch)
+        assert (added, removed) == (1, 2)
+        assert a.row(1).columns().tolist() == [7]
+        assert a.block_epoch(0) == peer_epoch
+        # Byte-convergence: both sides now report identical pairs.
+        assert a.block_sums_epochs() == b.block_sums_epochs()
+
+    def test_bulk_import_stamps_only_touched_blocks(self):
+        """An import into one block must NOT re-date the others: a
+        re-stamped stale block would WIN directed repair over a peer's
+        genuinely newer copy — silent write loss of exactly the class
+        the epoch plane exists to prevent (review finding on the
+        argless _mutated() bulk paths)."""
+        import numpy as np
+
+        f = Fragment(None, "i", "f", "standard", 0)
+        f.set_bit(210, 7)  # block 2
+        e_block2 = f.block_epoch(2)
+        # Bulk positions import into block 5 only.
+        f.bulk_import(
+            np.array([500, 501], dtype=np.uint64),
+            np.array([3, 4], dtype=np.uint64),
+        )
+        assert f.block_epoch(5) > e_block2
+        assert f.block_epoch(2) == e_block2  # untouched block keeps its date
+        # BSI value writes stamp only the plane blocks (block 0).
+        f.import_value(
+            np.array([9], dtype=np.uint64),
+            np.array([42], dtype=np.int64),
+            bit_depth=8,
+        )
+        assert f.block_epoch(0) > 0
+        assert f.block_epoch(2) == e_block2
+        # Roaring blob import: rows derived from the blob's containers.
+        from pilosa_tpu.roaring import Bitmap, serialize
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        blob = serialize(Bitmap(np.array(
+            [700 * SHARD_WIDTH + 11], dtype=np.uint64
+        )))
+        f.import_roaring(blob)  # row 700 -> block 7
+        assert f.block_epoch(7) > 0
+        assert f.block_epoch(2) == e_block2
+
+    def test_noop_reimport_never_redates_blocks(self):
+        """An idempotent re-import that moves ZERO bits must not mint:
+        a re-dated unchanged block would WIN directed repair over a
+        replica's genuinely newer block — silent loss for an import
+        that changed nothing (review finding)."""
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap, serialize
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        f = Fragment(None, "i", "f", "standard", 0)
+        f.bulk_import(
+            np.array([3], dtype=np.uint64), np.array([7], dtype=np.uint64)
+        )
+        e = f.block_epoch(0)
+        f.bulk_import(  # client retry of the same data
+            np.array([3], dtype=np.uint64), np.array([7], dtype=np.uint64)
+        )
+        assert f.block_epoch(0) == e
+        blob = serialize(Bitmap(np.array(
+            [3 * SHARD_WIDTH + 7], dtype=np.uint64
+        )))
+        f.import_roaring(blob)  # every bit already present
+        assert f.block_epoch(0) == e
+
+    def test_migration_copy_lands_epoch_unknown(self):
+        """A resize-migrated fragment is a COPY of data that already
+        exists elsewhere: minting fresh epochs for it would out-date
+        genuinely newer blocks on surviving replicas, and directed
+        repair would wipe them with the stale copy (review finding).
+        epoch_unknown imports land at epoch 0 = union-only."""
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap, serialize
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        src = Fragment(None, "i", "f", "standard", 0)
+        src.set_bit(3, 7)
+        blob = serialize(Bitmap(np.array(
+            [3 * SHARD_WIDTH + 7], dtype=np.uint64
+        )))
+        dst = Fragment(None, "i", "f", "standard", 0)
+        dst.import_roaring(blob, epoch_unknown=True)
+        assert dst.row_count(3) == 1  # data landed
+        assert dst.block_epoch(0) == 0  # honestly unknown, union-only
+        assert src.block_epoch(0) > 0  # the real write did mint
+
+    def test_deleted_fragment_removes_epoch_sidecar(self, tmp_path):
+        from pilosa_tpu.core.view import View
+
+        v = View(str(tmp_path / "v"), "i", "f", "standard")
+        v.open()
+        frag = v.create_fragment_if_not_exists(0)
+        frag.set_bit(1, 5)
+        frag.close()
+        import os
+
+        assert os.path.exists(frag.path + EPOCHS_EXT)
+        v.delete_fragment(0)
+        assert not os.path.exists(frag.path + EPOCHS_EXT)
+
+
+# ---------------------------------------------------------------------------
+# Divergence monitor: queue semantics + targeted repair
+# ---------------------------------------------------------------------------
+
+
+class TestDivergenceMonitor:
+    def test_bounded_queue_drops_and_counts(self):
+        with TestCluster(1) as c:
+            mon = DivergenceMonitor(c[0].cluster, max_queue=2)
+            # NOT started: observes pile up so the bound is observable.
+            drop0 = _counter("read_repair_dropped_total")
+            enq0 = _counter("read_repair_enqueued_total")
+            # A probe already pending dedups silently (re-diffing a hot
+            # hedged pair back to back buys nothing): not enqueued, not
+            # a drop.
+            for _ in range(3):
+                mon.observe("i", [0], "node0", "node1")
+            assert _counter("read_repair_enqueued_total") - enq0 == 1
+            assert _counter("read_repair_dropped_total") - drop0 == 0
+            # Distinct probes fill the bound; overflow counts as drops.
+            for shard in (1, 2, 3, 4):
+                mon.observe("i", [shard], "node0", "node1")
+            assert _counter("read_repair_enqueued_total") - enq0 == 2
+            assert _counter("read_repair_dropped_total") - drop0 == 3
+
+    def test_probe_repairs_divergent_replicas(self):
+        """An observed replica pair with differing blocks is counted,
+        ledgered, and healed by targeted epoch-directed repair on both
+        nodes — without any full anti-entropy pass."""
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1)")
+            c.await_shard_convergence("i")
+            # The partition shape: a clear that reached one replica.
+            _frag(c[1], "i", "f", 0).clear_bit(1, 5)
+            div0 = _counter("replica_divergence_blocks_total")
+            mon = DivergenceMonitor(c[0].cluster, max_queue=8).start()
+            try:
+                mon.observe("i", [0], "node0", "node1")
+                _await(
+                    lambda: _frag(c[0], "i", "f", 0).row_count(1) == 0,
+                    what="read repair convergence",
+                )
+                assert _counter("replica_divergence_blocks_total") > div0
+                dump = mon.debug_dump()
+                assert dump["entries"], dump
+                assert dump["entries"][0]["index"] == "i"
+                # The healed pair converged to the clear (higher epoch).
+                assert _frag(c[1], "i", "f", 0).row_count(1) == 0
+            finally:
+                mon.stop()
+
+    def test_debug_consistency_endpoint(self):
+        with TestCluster(2, replica_n=2) as c:
+            uri = str(c[0].node.uri)
+            with urllib.request.urlopen(uri + "/debug/consistency", timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["enabled"] is False  # no monitor wired
+            mon = DivergenceMonitor(c[0].cluster, max_queue=4)
+            try:
+                with urllib.request.urlopen(
+                    uri + "/debug/consistency", timeout=5
+                ) as r:
+                    body = json.loads(r.read())
+                assert body["enabled"] is True
+                assert body["entries"] == []
+                assert body["maxQueue"] == 4
+            finally:
+                mon.stop()
+
+    @pytest.mark.chaos
+    def test_hedge_race_feeds_the_monitor(self):
+        """The serving-path hook: a slow-but-healthy replica makes the
+        hedge fire, BOTH replicas answer, and the losing response's
+        arrival enqueues a divergence probe — which then finds and
+        repairs the seeded divergence."""
+        from tests.cluster_harness import FaultProxy, RewriteClient
+
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            # Hedging applies only to REMOTE legs: pick a shard whose
+            # PRIMARY owner is node1, so node0's fan-out dispatches the
+            # slow remote primary and hedges to its own local replica.
+            topo = c[0].cluster.topology
+            shard = next(
+                s for s in range(16)
+                if topo.shard_nodes("i", s)[0].id == "node1"
+            )
+            col = shard * SHARD_WIDTH + 5
+            c.query(0, "i", f"Set({col}, f=1)")
+            c.await_shard_convergence("i")
+            _frag(c[1], "i", "f", shard).clear_bit(1, col)
+            target = c[1].node.uri
+            proxy = FaultProxy(target.host, target.port)
+            proxy.mode = "latency"
+            proxy.latency_s = 0.3
+            rc = RewriteClient(
+                {f"{target.host}:{target.port}": f"127.0.0.1:{proxy.port}"},
+                timeout=5.0,
+            )
+            c[0].cluster.client = rc
+            c[0].cluster.broadcaster.client = rc
+            c[0].cluster.hedge_delay = 0.05
+            mon = DivergenceMonitor(c[0].cluster, max_queue=8).start()
+            enq0 = _counter("read_repair_enqueued_total")
+            try:
+                # Fan out from node0: node1's primary leg stalls behind
+                # the proxy, the hedge answers locally, the straggler's
+                # late answer is the second replica of the pair.
+                res = c[0].api.query("i", "Count(Row(f=1))")
+                assert res["results"][0] in (0, 1)  # divergent replicas
+                _await(
+                    lambda: _counter("read_repair_enqueued_total") > enq0,
+                    what="hedge-race divergence observation",
+                )
+                _await(
+                    lambda: (
+                        _frag(c[0], "i", "f", shard).row_count(1)
+                        == _frag(c[1], "i", "f", shard).row_count(1)
+                        == 0
+                    ),
+                    what="read-repair convergence to the clear",
+                )
+            finally:
+                mon.stop()
+                proxy.close()
+
+
+# ---------------------------------------------------------------------------
+# SymmetricPartition primitive (chaos)
+# ---------------------------------------------------------------------------
+
+
+class TestSymmetricPartition:
+    @pytest.mark.chaos
+    def test_partition_blackholes_both_directions_heal_restores(self):
+        from pilosa_tpu.cluster.client import ClientError
+
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1)")
+            c.await_shard_convergence("i")
+            with SymmetricPartition(c, 0, 1, timeout=0.4) as part:
+                part.partition()
+                for src, dst in ((c[0], c[1]), (c[1], c[0])):
+                    with pytest.raises(ClientError):
+                        src.cluster.client.status(dst.node)
+                part.heal()
+                for src, dst in ((c[0], c[1]), (c[1], c[0])):
+                    assert src.cluster.client.status(dst.node)["nodes"]
+
+
+# ---------------------------------------------------------------------------
+# Anti-entropy vs resize: mid-migration shards are skipped
+# ---------------------------------------------------------------------------
+
+
+class TestAntiEntropySkipsMigration:
+    def test_migrating_shard_skipped_and_counted(self):
+        from pilosa_tpu.cluster.sync import HolderSyncer
+
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1)")
+            c.await_shard_convergence("i")
+            # Diverge so an unskipped pass WOULD repair.
+            _frag(c[1], "i", "f", 0).clear_bit(1, 5)
+            rz = c[0].cluster.resizer
+            with rz._migrating_lock:
+                rz._migrating.add(("i", 0))
+            skip0 = _counter("anti_entropy_skipped_total")
+            try:
+                HolderSyncer(c[0].cluster).sync_holder()
+                assert _counter("anti_entropy_skipped_total") > skip0
+                # The mid-move shard was left alone.
+                assert _frag(c[0], "i", "f", 0).row_count(1) == 1
+            finally:
+                with rz._migrating_lock:
+                    rz._migrating.discard(("i", 0))
+            # Window over: the next pass heals it (clear wins).
+            HolderSyncer(c[0].cluster).sync_holder()
+            assert _frag(c[0], "i", "f", 0).row_count(1) == 0
+
+    def test_targeted_repair_skips_migrating_shard(self):
+        from pilosa_tpu.cluster.sync import HolderSyncer
+
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1)")
+            c.await_shard_convergence("i")
+            _frag(c[1], "i", "f", 0).clear_bit(1, 5)
+            rz = c[0].cluster.resizer
+            with rz._migrating_lock:
+                rz._migrating.add(("i", 0))
+            assert (
+                HolderSyncer(c[0].cluster).sync_fragment_targeted(
+                    "i", "f", "standard", 0
+                )
+                == 0
+            )
+            assert _frag(c[0], "i", "f", 0).row_count(1) == 1
+
+    def test_targeted_repair_skips_unowned_shard(self):
+        """A read-repair RPC can land minutes after the hedge
+        observation (bounded queue x per-probe budget); if a resize
+        moved the shard off this node meanwhile, repairing would
+        recreate and repopulate a fragment cleanup already removed
+        (review finding) — the targeted path needs the daemon pass's
+        ownership guard."""
+        from pilosa_tpu.cluster.sync import HolderSyncer
+
+        with TestCluster(2, replica_n=1) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            # replica_n=1: every shard has exactly one owner — pick a
+            # shard node0 does NOT own and aim the repair at node0.
+            topo = c[0].cluster.topology
+            shard = next(
+                s for s in range(8)
+                if topo.shard_nodes("i", s)[0].id != "node0"
+            )
+            before = _counter("anti_entropy_skipped_total")
+            assert (
+                HolderSyncer(c[0].cluster).sync_fragment_targeted(
+                    "i", "f", "standard", shard
+                )
+                == 0
+            )
+            assert _counter("anti_entropy_skipped_total") == before + 1

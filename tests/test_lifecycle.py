@@ -997,22 +997,19 @@ class TestAntiEntropyObservability:
 
 
 # ---------------------------------------------------------------------------
-# Union-repair limitation pin (ISSUE r9 satellite)
+# Epoch-directed repair convergence contract (ISSUE r15 tentpole 1 —
+# the flipped TestUnionRepairLimitation pin: resurrection is FIXED)
 # ---------------------------------------------------------------------------
 
 
-class TestUnionRepairLimitation:
-    def test_cleared_bit_resurrects_via_anti_entropy(self):
-        """RECORDED CONTRACT, not a surprise: anti-entropy merges
-        differing blocks by UNION (_sync_fragment -> merge_block), so a
-        bit cleared on one replica while another still holds it is
-        resurrected by the next repair pass. Clears only converge when
-        they reach every replica at write time (the replicated write
-        path does this); a partitioned replica's missed clear comes
-        back. Fix direction (docs/administration.md "Cluster
-        lifecycle"): journal-epoch-aware repair — ship per-block
-        (checksum, journal epoch) pairs and let the HIGHER epoch win
-        instead of the union, so tombstones propagate."""
+class TestEpochDirectedConvergence:
+    def test_cleared_bit_stays_cleared_after_sync(self):
+        """THE flipped r9 pin: anti-entropy used to union differing
+        blocks, so a clear that reached only one replica was
+        resurrected by the next pass. The sync wire now ships per-block
+        (checksum, epoch) and the HIGHER epoch wins — the clear's fresh
+        stamp beats the stale set, the tombstone propagates, and the
+        cleared bit STAYS cleared on both replicas."""
         with TestCluster(2, replica_n=2) as c:
             c.create_index("i")
             c.create_field("i", "f")
@@ -1024,7 +1021,90 @@ class TestUnionRepairLimitation:
             # replica (as a partition would leave it).
             _frag(c[1], "i", "f", 0).clear_bit(1, 5)
             assert _frag(c[1], "i", "f", 0).row_count(1) == 0
+            directed0 = _counter("anti_entropy_directed_repairs_total")
             c.sync_all()
-            # The union repair resurrects the cleared bit.
-            assert _frag(c[1], "i", "f", 0).row_count(1) == 1
-            assert c.query(1, "i", "Count(Row(f=1))")["results"][0] == 1
+            # No resurrection: the clear's higher epoch won everywhere.
+            assert _frag(c[0], "i", "f", 0).row_count(1) == 0
+            assert _frag(c[1], "i", "f", 0).row_count(1) == 0
+            assert c.query(0, "i", "Count(Row(f=1))")["results"][0] == 0
+            assert c.query(1, "i", "Count(Row(f=1))")["results"][0] == 0
+            assert _counter("anti_entropy_directed_repairs_total") > directed0
+            # Converged on the epoch axis too: both replicas report the
+            # same (checksum, epoch) for the repaired block.
+            assert (
+                _frag(c[0], "i", "f", 0).block_sums_epochs()
+                == _frag(c[1], "i", "f", 0).block_sums_epochs()
+            )
+
+    def test_symmetric_set_and_clear_converge_to_higher_epoch(self):
+        """Set-on-one/clear-on-other for the SAME block: both replicas
+        converge to whichever side wrote last (block-granular
+        last-writer-wins — the documented trade in
+        docs/administration.md), byte-identically."""
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1)")
+            c.await_shard_convergence("i")
+            # Divergent writes to the same block, replica-local: node0
+            # sets a second bit, then node1 clears the seeded one — the
+            # clear is the LAST write, so its epoch is the highest.
+            _frag(c[0], "i", "f", 0).set_bit(1, 9)
+            _frag(c[1], "i", "f", 0).clear_bit(1, 5)
+            c.sync_all()
+            c.sync_all()  # second pass: the loser pulls the winner
+            rows0 = _frag(c[0], "i", "f", 0).row(1).columns().tolist()
+            rows1 = _frag(c[1], "i", "f", 0).row(1).columns().tolist()
+            assert rows0 == rows1 == []  # the clear's block won wholesale
+            assert (
+                _frag(c[0], "i", "f", 0).block_sums_epochs()
+                == _frag(c[1], "i", "f", 0).block_sums_epochs()
+            )
+
+    def test_epochless_peer_degrades_to_union_never_wipes(self):
+        """Mixed-version safety pin (ISSUE r15 acceptance): a replica
+        whose blocks carry no epochs (pre-upgrade data, crash-dropped
+        sidecar) must be repaired by UNION — a directed wipe of data
+        nobody can date would be silent loss."""
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1)")
+            c.await_shard_convergence("i")
+            # node1 diverges (extra local bit), then loses its epoch
+            # plane entirely — the pre-upgrade replica shape.
+            _frag(c[1], "i", "f", 0).set_bit(1, 9)
+            _frag(c[1], "i", "f", 0)._block_epochs.clear()
+            # node0 writes LATER (higher epoch on its side): a directed
+            # resolution would wipe node1's undated bit 9.
+            _frag(c[0], "i", "f", 0).set_bit(1, 7)
+            union0 = _counter("anti_entropy_blocks_repaired_total")
+            c.sync_all()
+            c.sync_all()
+            # Union, not wipe: every bit from both sides survives.
+            for cn in (c[0], c[1]):
+                cols = _frag(cn, "i", "f", 0).row(1).columns().tolist()
+                assert cols == [5, 7, 9], cols
+            assert _counter("anti_entropy_blocks_repaired_total") > union0
+
+    def test_tombstoned_block_propagates(self):
+        """A block-wide clear (every bit gone) still ships on the sync
+        wire as a (checksum 0, epoch) tombstone — the replica holding
+        the old bits adopts the empty block instead of never hearing
+        about it."""
+        with TestCluster(2, replica_n=2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query(0, "i", "Set(5, f=1) Set(6, f=1)")
+            c.await_shard_convergence("i")
+            f1 = _frag(c[1], "i", "f", 0)
+            f1.clear_bit(1, 5)
+            f1.clear_bit(1, 6)
+            assert f1.row_count(1) == 0
+            # The tombstone is visible on the wire payload.
+            assert any(
+                s == 0 and e > 0 for _b, s, e in f1.block_sums_epochs()
+            )
+            c.sync_all()
+            assert _frag(c[0], "i", "f", 0).row_count(1) == 0
+            assert c.query(0, "i", "Count(Row(f=1))")["results"][0] == 0

@@ -109,6 +109,18 @@ class TestClusterTraces:
             with urllib.request.urlopen(req, timeout=10) as resp:
                 out = json.loads(resp.read())
             assert out["results"][0] == 6
+            # Spans enter the ring at finish(), and the remote node's
+            # http span finishes AFTER its reply bytes reached the
+            # coordinator — an in-process client can read the ring a
+            # GIL slice before that finalization lands. quiesce() on
+            # BOTH nodes is the deterministic barrier (ISSUE r13; this
+            # used to be an ad-hoc poll loop on the span ring). It must
+            # come BEFORE picking "the newest query span": until the
+            # coordinator's handler finalizes, the newest
+            # http.handle_post_query in the ring is a _seed write's —
+            # whose trace never fanned out.
+            assert c[1].server.quiesce(timeout=5.0)
+            assert c[0].server.quiesce(timeout=5.0)
             # The serving span of THIS query: newest http query span.
             qspans = [
                 s
@@ -117,14 +129,6 @@ class TestClusterTraces:
             ]
             assert qspans
             trace_id = qspans[-1]["traceID"]
-            # Spans enter the ring at finish(), and the remote node's
-            # http span finishes AFTER its reply bytes reached the
-            # coordinator — an in-process client can read the ring a
-            # GIL slice before that finalization lands. quiesce() on
-            # BOTH nodes is the deterministic barrier (ISSUE r13; this
-            # used to be an ad-hoc poll loop on the span ring).
-            assert c[1].server.quiesce(timeout=5.0)
-            assert c[0].server.quiesce(timeout=5.0)
             spans = global_tracer.spans_for(trace_id)
             nodes = {
                 s["tags"].get("node") for s in spans

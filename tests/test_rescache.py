@@ -196,9 +196,12 @@ class TestAddressability:
         row = ex.execute("i", q)[0]
         assert row.attrs == {"color": "blue"}
 
-    def test_clustered_coordinator_never_consults(self, holder):
-        # A wired mapper means answers depend on peer-held shards whose
-        # writes no local generation witnesses: the cache must stay out.
+    def test_clustered_coordinator_without_provider_never_consults(self, holder):
+        # A wired mapper means answers depend on peer-held shards. With
+        # no peer-epoch provider installed (ISSUE r15 tentpole 3) those
+        # writes are unwitnessable and the cache must stay out — the
+        # pre-r15 contract, still the safety rail for direct Executor
+        # wiring that bypasses Cluster.attach.
         ex = cached_executor(holder)
         ex.mapper = lambda index, shards, c, map_fn, reduce_fn, opt: (
             sum(map_fn(s) for s in shards)
@@ -286,6 +289,170 @@ class TestClusterPropagation:
                 assert d["hits"] == b["hits"], "bypass leg hit a cache"
                 assert d["inserts"] == b["inserts"]
                 assert d["bypass"] >= b["bypass"]
+
+
+class TestClusteredCoordinatorCache:
+    """ISSUE r15 tentpole 3: with the peer-epoch provider wired
+    (Cluster.attach), a CLUSTERED coordinator serves fan-out answers
+    from the result cache — keyed on the merged (local + peer) epoch
+    vector — and a peer write inside the covered shard set makes the
+    entry unservable on the next fan-out."""
+
+    @staticmethod
+    def _wire(c):
+        for cn in c.nodes:
+            cn.executor.rescache = ResultCache(cn.holder, max_bytes=1 << 20)
+            # Re-attach: installs the peer-epoch provider on the cache
+            # (the CLI wiring order does this in one pass).
+            cn.cluster.attach(cn.executor, cn.api)
+        return c[0].executor.rescache
+
+    @staticmethod
+    def _peer_shard(c, index):
+        """A shard owned by node1 only: writes to it never touch
+        node0's local views, so ONLY the peer epoch vector witnesses
+        them."""
+        topo = c[0].cluster.topology
+        for s in range(6):
+            if topo.shard_nodes(index, s)[0].id == "node1":
+                return s
+        raise AssertionError("no node1-owned shard in range")
+
+    def test_fanout_hit_marker_and_peer_write_invalidation(self):
+        import urllib.request
+
+        from cluster_harness import TestCluster
+        from pilosa_tpu.shardwidth import SHARD_WIDTH as SW
+
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            for shard in range(6):
+                c.query(0, "i", f"Set({shard * SW + 1}, f=0)")
+            c.await_shard_convergence("i")
+            rc = self._wire(c)
+            uri = str(c[0].node.uri)
+
+            def post(headers=None):
+                req = urllib.request.Request(
+                    uri + "/index/i/query", data=b"Count(Row(f=0))",
+                    method="POST", headers=headers or {},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return (
+                        resp.read(),
+                        resp.headers.get("X-Pilosa-Cache"),
+                    )
+
+            # The seeding replica writes already piggybacked node1's
+            # epochs onto node0's map, so the first fan-out is a real
+            # MISS (commit), the second a HIT — served without fanning
+            # out, marker on the response (ISSUE r15 acceptance).
+            body1, marker1 = post()
+            body2, marker2 = post()
+            assert marker2 == "hit", (marker1, marker2)
+            assert rc.debug_dump()["hits"] >= 1
+            # Byte-identity differential: the cached body must equal a
+            # cache-less (bypassed end-to-end) recompute of the same
+            # state, byte for byte.
+            body_fresh, marker_b = post({"X-Pilosa-Cache": "bypass"})
+            assert marker_b == "bypass"
+            assert body2 == body_fresh == body1
+
+            # A peer write INSIDE the covered shard set: routed through
+            # the coordinator, the peer's response piggybacks its new
+            # epochs, and the entry becomes unservable on the next
+            # fan-out — which recomputes the fresh answer.
+            s = self._peer_shard(c, "i")
+            c.query(0, "i", f"Set({s * SW + 2}, f=0)")
+            body3, marker3 = post()
+            assert marker3 == "miss", marker3
+            assert json.loads(body3)["results"] == [7]
+            # ...and the repopulated entry serves again, still
+            # byte-identical to a fresh recompute across the churn.
+            body4, marker4 = post()
+            assert marker4 == "hit"
+            body_fresh2, _ = post({"X-Pilosa-Cache": "bypass"})
+            assert body4 == body_fresh2 == body3
+
+    def test_unknown_peer_state_is_uncacheable(self):
+        from cluster_harness import TestCluster
+        from pilosa_tpu.shardwidth import SHARD_WIDTH as SW
+
+        with TestCluster(2) as c:
+            c.create_index("i")
+            c.create_field("i", "f")
+            for shard in range(6):
+                c.query(0, "i", f"Set({shard * SW + 1}, f=0)")
+            c.await_shard_convergence("i")
+            rc = self._wire(c)
+            # Drop everything the coordinator has heard: with a covering
+            # peer's epochs unknown, fan-out answers must not cache (the
+            # fan-out's own piggyback repopulates the map, so the NEXT
+            # answer becomes cacheable — never a wrong serve meanwhile).
+            with c[0].cluster._peer_epochs_lock:
+                c[0].cluster._peer_epochs.clear()
+            assert c[0].api.query("i", "Count(Row(f=0))")["results"] == [6]
+            assert rc.debug_dump()["misses"] == 0  # uncacheable, not a miss
+            assert c[0].api.query("i", "Count(Row(f=0))")["results"] == [6]
+            assert rc.debug_dump()["misses"] == 1  # map repopulated: miss
+            assert c[0].api.query("i", "Count(Row(f=0))")["results"] == [6]
+            assert rc.debug_dump()["hits"] == 1
+
+    def test_out_of_order_fold_never_regresses(self):
+        """A slow leg's response (carrying an OLD epoch report) must not
+        fold back over a newer one already recorded — that would
+        re-validate a cache entry a synchronous write invalidation had
+        already killed (review finding). Reports order by their newest
+        generation, all minted from one per-process counter."""
+        from cluster_harness import TestCluster
+
+        old = {"f": {"structure": 1, "views": {"standard": 100}}}
+        new = {"f": {"structure": 1, "views": {"standard": 200}}}
+        with TestCluster(1) as c:
+            cl = c[0].cluster
+            cl.fold_peer_epochs(
+                {"node": "peerX", "boot": 7, "indexes": {"i": new}}
+            )
+            cl.fold_peer_epochs(
+                {"node": "peerX", "boot": 7, "indexes": {"i": old}}
+            )
+            with cl._peer_epochs_lock:
+                assert cl._peer_epochs["peerX"]["i"] == (7, 200, new)
+            # Equal-max (no intervening mint) and newer reports fold.
+            newer = {"f": {"structure": 1, "views": {"standard": 300}}}
+            cl.fold_peer_epochs(
+                {"node": "peerX", "boot": 7, "indexes": {"i": newer}}
+            )
+            with cl._peer_epochs_lock:
+                assert cl._peer_epochs["peerX"]["i"] == (7, 300, newer)
+            # A TORN report (lock-free walk on the peer: view b read
+            # pre-mint while view a read post-mint, max still high)
+            # must not regress an individual stored generation — the
+            # merge is per-view monotone, not per-report.
+            full = {"f": {"structure": 1,
+                          "views": {"a": 400, "b": 350}}}
+            torn = {"f": {"structure": 1,
+                          "views": {"a": 500, "b": 340}}}
+            cl.fold_peer_epochs(
+                {"node": "peerY", "boot": 7, "indexes": {"i": full}}
+            )
+            cl.fold_peer_epochs(
+                {"node": "peerY", "boot": 7, "indexes": {"i": torn}}
+            )
+            with cl._peer_epochs_lock:
+                got = cl._peer_epochs["peerY"]["i"]
+            assert got[2]["f"]["views"] == {"a": 500, "b": 350}
+            # A RESTARTED peer (new boot token) folds wholesale even
+            # when its post-clock-step counter mints below the previous
+            # life — the merge guard is per-incarnation, never across
+            # reboots (and a reboot's fresh truth drops ghost entries).
+            reborn = {"f": {"structure": 1, "views": {"standard": 50}}}
+            cl.fold_peer_epochs(
+                {"node": "peerX", "boot": 8, "indexes": {"i": reborn}}
+            )
+            with cl._peer_epochs_lock:
+                assert cl._peer_epochs["peerX"]["i"] == (8, 50, reborn)
 
 
 class TestSizeAccounting:
@@ -438,6 +605,31 @@ class TestHTTPSurface:
         out = (resp.getheader("X-Pilosa-Cache"), json.loads(resp.read()))
         conn.close()
         return out
+
+    def test_import_response_carries_epoch_piggyback(self, server):
+        """Imports are writes: a peer-issued import's response must
+        carry the post-write epochs, or a coordinator-routed import
+        would leave the coordinator serving cached pre-import fan-outs
+        until the next ~1 s probe fold (review finding; the documented
+        contract says writes invalidate synchronously with their own
+        response)."""
+        srv, _ = server
+        body = json.dumps({"rowIDs": [1], "columnIDs": [2]})
+        h = {"Content-Type": "application/json"}
+        conn = http.client.HTTPConnection("localhost", srv.port)
+        conn.request("POST", "/index/i/field/f/import?remote=true", body, h)
+        resp = conn.getresponse()
+        resp.read()
+        hdr = resp.getheader("X-Pilosa-View-Epochs")
+        conn.close()
+        assert hdr and json.loads(hdr)["indexes"]["i"]["f"]["views"]
+        # External imports never pay the report bytes.
+        conn = http.client.HTTPConnection("localhost", srv.port)
+        conn.request("POST", "/index/i/field/f/import", body, h)
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.getheader("X-Pilosa-View-Epochs") is None
+        conn.close()
 
     def test_marker_and_bypass_header(self, server):
         srv, _ = server

@@ -155,6 +155,7 @@ ALLOWED_TAG_KEYS = {
     "state",   # cluster state enum
     "to",      # state-transition target enum
     "won",     # hedge winner (hedge/primary)
+    "direction",  # directed-repair resolution (remote_wins/local_wins)
     "reason",  # bounded failure-reason enum (device fallback, import shed)
     "outcome", # recovery outcome enum (replayed/truncated/corrupt)
     "le",      # histogram bucket bound (static BUCKET_BOUNDS)
