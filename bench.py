@@ -456,6 +456,12 @@ LEG_COUNTER_FAMILIES = (
     "batch_legs_total",
     "batch_coalesced_total",
     "device_launches_total",
+    # Introspection plane (ISSUE 16): a nonzero recompile delta inside a
+    # steady-state leg is the bucket-padding regression signal; the
+    # snapshot-stall counter is the server-side figure the ingest leg
+    # reads instead of deriving it from the rewrite histogram.
+    "device_recompiles_total",
+    "snapshot_stall_seconds_total",
     "http_requests_shed_total",
     "peer_rpc_errors_total",
     "peer_rpc_retries_total",
@@ -1337,11 +1343,16 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
     }
 
 
-def bench_group_by(holder, be) -> tuple[float, float]:
+def bench_group_by(holder, be) -> tuple[float, float, dict]:
     """3-field GroupBy at the full shape: ONE device program builds the
     [Rh, Rf, Rg] group-count tensor. Cold includes the one-time h-stack
     pack + program compile; warm is the steady-state dispatch (a write
-    would re-trigger only the sweep)."""
+    would re-trigger only the sweep). The warm pass runs under EXPLAIN
+    (ISSUE 16): its executed-plan tree — per-launch program keys,
+    shapes, bytes — ships in the BENCH JSON as the seed data the
+    GroupBy tiling work (ROADMAP item 2) starts from."""
+    from pilosa_tpu.utils.qprofile import ExplainPlan, profile_scope
+
     ex = Executor(holder, backend=be)
     t0 = time.perf_counter()
     res = ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
@@ -1353,9 +1364,11 @@ def bench_group_by(holder, be) -> tuple[float, float]:
     be._agg_cache.clear()
     be._groupn_cache.clear()
     t0 = time.perf_counter()
-    ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
+    with profile_scope(index="bench", query="groupby_3field") as prof:
+        prof.explain = ExplainPlan()
+        ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
     warm = time.perf_counter() - t0
-    return cold, warm
+    return cold, warm, prof.explain.to_dict()
 
 
 def bench_minmax_churn(holder, be) -> tuple[float, float, float, dict]:
@@ -1816,15 +1829,30 @@ def bench_ingest_under_load() -> dict:
         def _cdelta(prefix: str) -> int:
             return _batch_counter_delta(counters_b0, prefix)
 
-        # Snapshot stall attribution: seconds the background rewrites
-        # spent (histogram _sum delta) — the stall the ingest path no
-        # longer pays inline.
-        snap_s = 0.0
+        # Snapshot stall attribution (ISSUE 16 satellite): read the
+        # server's own counter — the LOCKED-phase seconds of every
+        # rewrite, i.e. the reader-visible stall — like every other
+        # family, instead of deriving a figure from the whole-rewrite
+        # histogram (which also counts the unlocked serialize).
+        snap = global_stats.snapshot()["counters"]
+        snap_s = sum(
+            v - counters_b0.get(k, 0.0) for k, v in snap.items()
+            if k.startswith("snapshot_stall_seconds_total")
+        )
+        # Lock-stall attribution (ISSUE 16): per-site contended-wait
+        # seconds over the churn window, from the lock_wait_seconds
+        # histogram sums — the named sources the read-p99 delta under
+        # load decomposes into.
+        lock_wait: dict = {}
         for name, ent in global_stats.histogram_snapshot().items():
-            if not name.startswith("fragment_snapshot_seconds"):
+            if not name.startswith("lock_wait_seconds"):
                 continue
             base = hist_b0.get(name)
-            snap_s += ent["sum"] - (base["sum"] if base else 0.0)
+            d = ent["sum"] - (base["sum"] if base else 0.0)
+            if d > 0:
+                m = re.search(r'site="([^"]+)"', name)
+                site = m.group(1) if m else name
+                lock_wait[site] = round(lock_wait.get(site, 0.0) + d, 6)
         rows_per_s = sum(rows_acked) / elapsed if elapsed > 0 else 0.0
         p99_ro = (ro_ms or {}).get("p99_ms")
         p99_churn = (churn_ms or {}).get("p99_ms")
@@ -1843,6 +1871,7 @@ def bench_ingest_under_load() -> dict:
             "ingest_import_sheds": _cdelta("import_shed_total"),
             "ingest_snapshots": _cdelta("fragment_snapshots_total"),
             "ingest_snapshot_stall_seconds": round(snap_s, 3),
+            "ingest_lock_wait_seconds": lock_wait,
             "ingest_version_walks": churn_walks,
             "ingest_shards": INGEST_SHARDS,
             "ingest_writers": INGEST_WRITERS,
@@ -2739,11 +2768,12 @@ def main():
     # pack + upload + tri-program compile — measured after churn it
     # also absorbed a full f-stack rebuild (hundreds of dirtied shards)
     # and read as 3x worse than a real cold start.
-    groupby_cold_s, groupby_warm_s = bench_group_by(h, be)
+    groupby_cold_s, groupby_warm_s, groupby_explain = bench_group_by(h, be)
     checkpoint(
         "groupby",
         groupby_3field_cold_s=round(groupby_cold_s, 2),
         groupby_3field_warm_ms=round(groupby_warm_s * 1e3, 1),
+        groupby_explain=groupby_explain,
     )
     mm_hist_base = global_stats.histogram_snapshot()
     mm_ro, mm_churn, mm_wrate, mm_walks = bench_minmax_churn(h, be)

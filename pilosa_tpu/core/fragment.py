@@ -33,6 +33,7 @@ from pilosa_tpu.roaring.codec import (
     deserialize,
 )
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+from pilosa_tpu.utils.locks import InstrumentedLock, InstrumentedRLock
 from pilosa_tpu.utils.logger import StandardLogger
 
 # Maximum op-log length before a snapshot rewrite (reference fragment.go:84).
@@ -152,7 +153,7 @@ class _WalFile:
     def __init__(self, path: str):
         self.path = path
         self._fh = None
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("wal_append")
         self.budget_stamp = 0  # lock-free LRU stamp (syswrap.file_touched)
 
     def write(self, data: bytes) -> int:
@@ -236,7 +237,7 @@ class Fragment:
         self.cache = new_cache(cache_type, cache_size)
         self.cache_type = cache_type
         self.max_row_id = 0
-        self.lock = threading.RLock()
+        self.lock = InstrumentedRLock("fragment")
         self._file = None
         # Off-hot-path snapshotting (ISSUE r8 tentpole 2): one in-flight
         # background rewrite at a time; close() joins it. The mutex
@@ -245,7 +246,7 @@ class Fragment:
         # file); order is always _snapshot_mutex -> self.lock.
         self._snapshotting = False
         self._snapshot_thread: Optional[threading.Thread] = None
-        self._snapshot_mutex = threading.Lock()
+        self._snapshot_mutex = InstrumentedLock("snapshot_mutex")
         # op_n already reported into the process-wide WAL_BACKLOG.
         self._backlog_reported = 0
         self._closed = False
@@ -595,6 +596,7 @@ class Fragment:
     def _snapshot_locked(self, t0, global_stats) -> None:
         import time as _time
 
+        t_l1 = _time.perf_counter()
         with self.lock:
             if self._closed:
                 # A rewrite that lost the start race with close() (or
@@ -609,11 +611,18 @@ class Fragment:
                 # lint: allow-shared-state(every storage mutation holds Fragment.lock; lock-free readers pin the reference once and read per the PR 8 snapshot contract)
                 self.storage.op_n = 0
                 self._report_backlog()
+                global_stats.count(
+                    "snapshot_stall_seconds_total",
+                    _time.perf_counter() - t_l1,
+                )
                 return
             clone = self.storage.clone()
             clone.flags = self.storage.flags
             op_n_at_clone = self.storage.op_n
             wal_base = os.path.getsize(self.path)
+            global_stats.count(
+                "snapshot_stall_seconds_total", _time.perf_counter() - t_l1
+            )
         # -- phase 2: O(storage) work with NO fragment lock held --------
         pre = dict(clone._cs)  # pre-optimize containers (shared w/ live)
         clone.optimize()
@@ -622,6 +631,7 @@ class Fragment:
             f.write(serialize(clone))
             f.flush()
             os.fsync(f.fileno())
+        t_l3 = _time.perf_counter()
         with self.lock:
             if self._closed:
                 # close() landed during the unlocked serialize: abandon
@@ -630,6 +640,10 @@ class Fragment:
                     os.remove(tmp)
                 except OSError:
                     pass
+                global_stats.count(
+                    "snapshot_stall_seconds_total",
+                    _time.perf_counter() - t_l3,
+                )
                 return
             tail = b""
             size_now = os.path.getsize(self.path)
@@ -674,6 +688,9 @@ class Fragment:
                 old = pre.get(k)
                 if oc is not old and live_cs.get(k) is old:
                     live_cs[k] = oc
+            global_stats.count(
+                "snapshot_stall_seconds_total", _time.perf_counter() - t_l3
+            )
         global_stats.count("fragment_snapshots_total")
         global_stats.timing(
             "fragment_snapshot_seconds", _time.perf_counter() - t0

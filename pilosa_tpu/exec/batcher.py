@@ -75,10 +75,12 @@ what makes the serving path reach the batched-kernel throughput.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Optional
 
+from pilosa_tpu.utils.locks import InstrumentedLock
 from pilosa_tpu.utils.qprofile import current_profile
 from pilosa_tpu.utils.stats import global_stats
 
@@ -91,7 +93,8 @@ LEG_KINDS = ("count", "row", "bsi_sum", "bsi_min", "bsi_max", "topn")
 class _Leg:
     """One enqueued shard-leg: a typed descriptor plus its rendezvous."""
 
-    __slots__ = ("kind", "index", "shards", "payload", "event", "result", "error")
+    __slots__ = ("kind", "index", "shards", "payload", "event", "result",
+                 "error", "explain", "explain_rec")
 
     def __init__(self, kind: str, index: str, shards, payload):
         self.kind = kind
@@ -101,6 +104,14 @@ class _Leg:
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        # EXPLAIN (ISSUE 16): the submitter's plan leg-sink, captured at
+        # construction ON THE SUBMITTING THREAD so the leader can
+        # attribute this leg's group record into the right plan. None
+        # when the submitter carries no plan (the common case) — the
+        # batching plane then allocates nothing.
+        ex = getattr(current_profile(), "explain", None)
+        self.explain = ex.leg_sink() if ex is not None else None
+        self.explain_rec: Optional[dict] = None
 
 
 class ShardLegBatcher:
@@ -116,10 +127,14 @@ class ShardLegBatcher:
     def __init__(self, backend, window: float = 0.0):
         self.backend = backend
         self.window = window
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("batcher_drain")
         self._pending: list[_Leg] = []
         self._leader_active = False
         self.stats = global_stats
+        # EXPLAIN group ids: process-unique per batcher, so two legs of
+        # one query showing the same id PROVES they shared a drain
+        # group (itertools.count: GIL-atomic, no lock).
+        self._group_ids = itertools.count(1)
 
     # -- public submit API (one method per leg kind) -----------------------
 
@@ -272,6 +287,22 @@ class ShardLegBatcher:
         # legs, not seconds — the shared bucket set covers 1..100 with
         # 5 buckets/decade; the mean from _sum/_count is exact).
         st.timing("batch_occupancy", float(len(legs)))
+        if any(leg.explain is not None for leg in legs):
+            occ = len(legs)
+            gid = next(self._group_ids)
+            bucket = 1 if occ <= 1 else 1 << (occ - 1).bit_length()
+            for leg in legs:
+                if leg.explain is None:
+                    continue
+                rec = {
+                    "group": gid,
+                    "kind": kind,
+                    "occupancy": occ,
+                    "occupancyBucket": bucket,
+                    "shards": len(leg.shards),
+                }
+                leg.explain.append(rec)
+                leg.explain_rec = rec
 
     # -- count legs ---------------------------------------------------------
 
@@ -334,6 +365,13 @@ class ShardLegBatcher:
             by_payload.setdefault((field_name, id(filt) if filt is not None else None), []).append(leg)
         for (field_name, _fid), members in by_payload.items():
             filt = members[0].payload[1]
+            for leg in members:
+                if leg.explain_rec is not None:
+                    # Slot-dedupe outcome: `shared` means this leg rode
+                    # another identical leg's backend call.
+                    leg.explain_rec["dedupe"] = (
+                        "shared" if len(members) > 1 else "unique"
+                    )
             try:
                 if kind == "topn":
                     # n=0: the full ranked vector — submitters trim in

@@ -35,7 +35,7 @@ from pilosa_tpu.pql import Call, Condition, Query, parse_string
 from pilosa_tpu.pql.ast import is_reserved_arg
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.utils.deadline import check_deadline
-from pilosa_tpu.utils.qprofile import profile_scope
+from pilosa_tpu.utils.qprofile import cache_state, current_profile, profile_scope
 from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.utils.tracing import global_tracer
 
@@ -202,6 +202,14 @@ class Executor:
                     with self.tracer.start_span("executor.executeCountBatch"):
                         inner = [b.children[0] for b in batch]
                         sh = self._shards(index, shards)
+                        ex = getattr(prof, "explain", None)
+                        node = None
+                        if ex is not None:
+                            node = ex.begin_call("Count")
+                            node["fused"] = run
+                            node["shards"] = len(sh)
+                            node["devices"] = self._explain_devices()
+                            node["cache"] = cache_verdicts = [None] * run
                         # Cache consult BEFORE legs go to the batcher:
                         # hits never launch; the remaining misses still
                         # coalesce into one device dispatch.
@@ -224,7 +232,24 @@ class Executor:
                                     if t.hit:
                                         prof.incr("cache_hits")
                                         out[k] = int(t.value)
+                                        if node is not None:
+                                            cache_verdicts[k] = {
+                                                "verdict": "hit",
+                                                "staleBy": getattr(
+                                                    t, "stale_by", 0
+                                                ),
+                                            }
                         miss = [k for k in range(run) if out[k] is None]
+                        if node is not None:
+                            for k in miss:
+                                if cache_verdicts[k] is None:
+                                    cache_verdicts[k] = {"verdict": "miss"}
+                            node["route"] = (
+                                "rescache" if not miss else (
+                                    "batcher" if self.batcher is not None
+                                    else "count_batch"
+                                )
+                            )
                         if miss:
                             miss_inner = [inner[k] for k in miss]
                             if self.batcher is not None:
@@ -247,6 +272,8 @@ class Executor:
                 call = calls[i]
                 check_deadline("plan")
                 stats.count(f"query_{call.name}_total")
+                ex = getattr(prof, "explain", None)
+                node = ex.begin_call(call.name) if ex is not None else None
                 # Remote (peer-issued) requests arrive pre-translated and
                 # are returned raw; translation happens only at the
                 # coordinator (reference executor.go:121-127).
@@ -269,22 +296,41 @@ class Executor:
                         prof.incr("cache_lookups")
                         if token.hit:
                             prof.incr("cache_hits")
+                            if node is not None:
+                                node["route"] = "rescache"
+                                node["cache"] = {
+                                    "verdict": "hit",
+                                    "staleBy": getattr(
+                                        token, "stale_by", 0
+                                    ),
+                                }
                             results.append(token.value)
                             if opt.wire_sink is not None:
                                 opt.wire_sink.append(token)
                             i += 1
                             continue
+                        if node is not None:
+                            node["cache"] = {"verdict": "miss"}
                     else:
                         # Fresh-computed answer the cache never held
                         # (uncacheable call/coverage): the response
                         # marker must not claim a pure cache serve.
                         prof.incr("cache_uncached")
+                        if node is not None:
+                            node["cache"] = {"verdict": "uncacheable"}
                 elif cache is not None and call.name in cache.CACHEABLE:
                     cache.count_bypass(index)
                     prof.incr("cache_bypass")
+                    if node is not None:
+                        node["cache"] = {"verdict": "bypass"}
                 check_deadline("device_dispatch")
                 with self.tracer.start_span(f"executor.execute{call.name}"):
                     result = self.execute_call(index, call, shards, opt)
+                if node is not None:
+                    node["route"] = "execute"
+                    node["devices"] = self._explain_devices()
+                    if prof.shards is not None:
+                        node["shards"] = prof.shards
                 if not opt.remote:
                     check_deadline("key_translate")
                     with prof.phase("key_translate"):
@@ -313,6 +359,16 @@ class Executor:
                 self._p99_context(index),
             )
         return results
+
+    def _explain_devices(self) -> dict:
+        """Device placement for an EXPLAIN call node: mesh fan-out (and
+        so single-device vs sharded execution) plus backend class."""
+        mesh = getattr(self.backend, "mesh", None)
+        return {
+            "n": mesh.n if mesh is not None else 1,
+            "mesh": mesh is not None,
+            "backend": type(self.backend).__name__,
+        }
 
     def _p99_context(self, index: str) -> str:
         """' p99=12.3ms' for the slow-query log: the index's interpolated
@@ -495,10 +551,15 @@ class Executor:
 
     def _shards(self, index: str, shards: Optional[list[int]]) -> list[int]:
         if shards is not None:
+            current_profile().shards = len(shards)
             return shards
         idx = self.holder.index(index)
         out = idx.available_shards_list()  # cached + read-only
-        return out if out else [0]
+        out = out if out else [0]
+        # Route context for the /debug/queries ring + slow-query log
+        # (ISSUE 16 satellite): every resolution path stamps the count.
+        current_profile().shards = len(out)
+        return out
 
     # ------------------------------------------------------------------
     # mapReduce (reference executor.go:2460; local form)

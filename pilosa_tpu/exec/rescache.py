@@ -71,6 +71,7 @@ from collections import OrderedDict
 from typing import Any, Optional
 
 from pilosa_tpu.pql.ast import Call, canonical_key
+from pilosa_tpu.utils.locks import InstrumentedLock
 from pilosa_tpu.utils.stats import global_stats
 
 #: Calls whose final answers the cache may hold. Everything else —
@@ -240,7 +241,7 @@ class ResultCache:
         # (gauge writes stay inside so two interleaved commits can't
         # publish out of order — the begin_query precedent). Epoch
         # resolution/revalidation take view journal locks OUTSIDE it.
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("rescache")
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._resident = 0
         # Per-index addressability salt: bumped by invalidate_index()

@@ -95,6 +95,7 @@ from pilosa_tpu.ops.sparse import (
 from pilosa_tpu.pql.ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.roaring import Bitmap
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.locks import InstrumentedRLock
 from pilosa_tpu.utils.qprofile import current_profile
 from pilosa_tpu.utils.stats import global_stats
 
@@ -195,7 +196,7 @@ class _StackedBlocks:
         # Queries are served concurrently (ThreadingHTTPServer); the LRU
         # touch/evict mutate on reads, so all access goes under one lock
         # (ADVICE r2: dict-changed-size races surfaced as 500s).
-        self._lock = threading.RLock()
+        self._lock = InstrumentedRLock("hbm_ledger")
         # Per-key build latch: concurrent misses for the same stack must
         # not pack+upload it twice (duplicate HBM residency could blow the
         # byte budget); losers wait for the winner's entry.
@@ -1118,6 +1119,223 @@ def _pred_bits(value: int, depth: int) -> np.ndarray:
     return np.array([(value >> i) & 1 for i in range(depth)], dtype=np.uint32)
 
 
+def _shape_sig(tree) -> tuple:
+    """Hashable nested (dtype, shape) signature of a launch argument
+    tree — the thing jit retraces on, so (kind, build key, shape sig)
+    names exactly ONE compiled executable."""
+    out = []
+    for a in tree:
+        if isinstance(a, (tuple, list)):
+            out.append(_shape_sig(a))
+        else:
+            shape = getattr(a, "shape", None)
+            if shape is None:
+                out.append(type(a).__name__)
+            else:
+                out.append((str(getattr(a, "dtype", "?")), tuple(shape)))
+    return tuple(out)
+
+
+def _tree_nbytes(tree) -> int:
+    """Total array bytes in a (possibly nested) argument/output tree —
+    EXPLAIN's bytes-shipped/returned figure. Only walked under the
+    explain flag; the counted hot path never calls this."""
+    if isinstance(tree, (tuple, list)):
+        return sum(_tree_nbytes(a) for a in tree)
+    return int(getattr(tree, "nbytes", 0) or 0)
+
+
+def _sig_occupancy(shape_sig) -> Optional[int]:
+    """Largest leading dim among rank-1 leaves of a shape signature —
+    the [Q] slot-bucket of batched programs (None when the program has
+    no per-slot operands)."""
+    best = None
+    for leaf in shape_sig:
+        if isinstance(leaf, tuple) and leaf and isinstance(leaf[0], tuple):
+            inner = _sig_occupancy(leaf)
+            if inner is not None:
+                best = inner if best is None else max(best, inner)
+        elif (
+            isinstance(leaf, tuple) and len(leaf) == 2
+            and isinstance(leaf[1], tuple) and len(leaf[1]) == 1
+        ):
+            n = int(leaf[1][0])
+            best = n if best is None else max(best, n)
+    return best
+
+
+class _ProgramEntry:
+    """Ledger row for one compiled executable (see _ProgramLedger)."""
+
+    __slots__ = (
+        "kind", "program", "bucket", "shapes", "compiles",
+        "compile_seconds", "launches", "device_seconds",
+        "last_launch", "last_wall",
+    )
+
+    def __init__(self, kind: str, program: str, bucket, shapes: str):
+        self.kind = kind
+        self.program = program
+        self.bucket = bucket
+        self.shapes = shapes
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.launches = 0
+        self.device_seconds = 0.0
+        self.last_launch = 0.0   # perf_counter origin, for idle age
+        self.last_wall = 0.0     # epoch stamp, for operator display
+
+
+class _ProgramLedger:
+    """Device-program ledger (ISSUE 16 tentpole 2): every compiled
+    executable the backend ever launched, keyed by its (kind, build
+    key, argument shape signature). Registration happens at the
+    _counted_launch chokepoint, so the ledger sees the same stream the
+    device_launches_total counter does.
+
+    A compile observed for a signature ALREADY in the ledger is a
+    recompile — the jit cache forgot an executable it had (bucket
+    padding regressed, a cache was cleared, a shape leaked past its
+    bucket) — and increments `device_recompiles_total{kind}`. Compile
+    walls feed `device_compile_seconds{kind}`; the entry count is the
+    `device_programs_live` gauge. Served coldest-first at
+    GET /debug/programs, mirroring /debug/hbm.
+
+    Device time: each launch parks (signature, dispatch t0) on the
+    dispatching thread; the block_ready() wrapper around
+    jax.block_until_ready closes every parked launch of that thread
+    into its entry's cumulative post-sync device seconds."""
+
+    _PENDING_CAP = 64
+
+    def __init__(self, stats):
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, _ProgramEntry] = {}
+        self._stats = stats
+        self._local = threading.local()
+
+    # -- registration ------------------------------------------------------
+
+    def record_launch(self, kind: str, key, args, wall: float,
+                      compiled: bool, t_dispatch: float) -> tuple:
+        shape_sig = _shape_sig(args)
+        sig = (kind, key, shape_sig)
+        live = None
+        with self._lock:
+            e = self._entries.get(sig)
+            if e is None:
+                e = self._entries[sig] = _ProgramEntry(
+                    kind,
+                    repr(key)[:120] if key is not None else kind,
+                    _sig_occupancy(shape_sig),
+                    repr(shape_sig)[:200],
+                )
+            e.launches += 1
+            e.last_launch = time.perf_counter()
+            # Epoch stamp by contract: /debug/programs serves lastLaunch
+            # as a wall time operators correlate with logs.
+            e.last_wall = time.time()  # lint: allow-monotonic-time(operator-facing epoch display stamp)
+            recompile = False
+            if compiled:
+                e.compiles += 1
+                e.compile_seconds += wall
+                recompile = e.compiles > 1
+                live = len(self._entries)
+        if compiled:
+            st = self._stats.with_tags(f"kind:{kind}")
+            st.timing("device_compile_seconds", wall)
+            if recompile:
+                st.count("device_recompiles_total")
+            self._stats.gauge("device_programs_live", live)
+        pend = getattr(self._local, "pending", None)
+        if pend is None:
+            pend = self._local.pending = []
+        if len(pend) < self._PENDING_CAP:
+            pend.append((sig, t_dispatch))
+        return sig
+
+    def record_compile(self, kind: str, key, shapes, seconds: float) -> None:
+        """AOT-compiled programs (.lower().compile() — groupn_pershard)
+        measure their compile at build time; no launch-time cache-size
+        delta exists for them."""
+        shape_sig = _shape_sig(shapes) if isinstance(
+            shapes, (tuple, list)
+        ) else (shapes,)
+        sig = (kind, key, shape_sig)
+        with self._lock:
+            e = self._entries.get(sig)
+            if e is None:
+                e = self._entries[sig] = _ProgramEntry(
+                    kind,
+                    repr(key)[:120] if key is not None else kind,
+                    _sig_occupancy(shape_sig),
+                    repr(shape_sig)[:200],
+                )
+            e.compiles += 1
+            e.compile_seconds += seconds
+            recompile = e.compiles > 1
+            live = len(self._entries)
+        st = self._stats.with_tags(f"kind:{kind}")
+        st.timing("device_compile_seconds", seconds)
+        if recompile:
+            st.count("device_recompiles_total")
+        self._stats.gauge("device_programs_live", live)
+
+    # -- device-time accrual ----------------------------------------------
+
+    def block_ready(self, x):
+        """jax.block_until_ready + close this thread's parked launches
+        into their entries' cumulative device seconds."""
+        jax.block_until_ready(x)
+        pend = getattr(self._local, "pending", None)
+        if pend:
+            now = time.perf_counter()
+            with self._lock:
+                for sig, t0 in pend:
+                    e = self._entries.get(sig)
+                    if e is not None:
+                        e.device_seconds += now - t0
+            del pend[:]
+        return x
+
+    # -- export ------------------------------------------------------------
+
+    def ledger(self) -> list[dict]:
+        """Ledger rows, coldest-first (longest since last launch),
+        mirroring /debug/hbm's eviction-order listing."""
+        now = time.perf_counter()
+        with self._lock:
+            entries = list(self._entries.values())
+        entries.sort(key=lambda e: e.last_launch)
+        return [
+            {
+                "kind": e.kind,
+                "program": e.program,
+                "bucket": e.bucket,
+                "shapes": e.shapes,
+                "compiles": e.compiles,
+                "compileSeconds": round(e.compile_seconds, 6),
+                "launches": e.launches,
+                "deviceSeconds": round(e.device_seconds, 6),
+                "lastLaunch": e.last_wall or None,
+                "idleSeconds": (
+                    round(now - e.last_launch, 3) if e.last_launch else None
+                ),
+            }
+            for e in entries
+        ]
+
+    def counts(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+        return {
+            "programs": len(entries),
+            "compiles": sum(e.compiles for e in entries),
+            "recompiles": sum(max(0, e.compiles - 1) for e in entries),
+            "launches": sum(e.launches for e in entries),
+        }
+
+
 class TPUBackend:
     """Drop-in replacement for CPUBackend with device execution.
 
@@ -1142,6 +1360,10 @@ class TPUBackend:
         )
         self._fns: dict = {}
         self._fns_lock = threading.RLock()
+        # Device-program ledger behind GET /debug/programs (ISSUE 16):
+        # fed by _counted_launch, so it covers exactly the launch stream
+        # device_launches_total counts.
+        self.programs = _ProgramLedger(self.stats)
         # Host-resident pair-stats cache: (index, fa, fb, shards) ->
         # (fblock, gblock, flat stats). Block identity is the freshness
         # token (see _pair_batch_dispatch); one entry per field pair, so
@@ -1246,6 +1468,11 @@ class TPUBackend:
         prof = current_profile()
         prof.incr(f"version_walk_{kind}")
         prof.incr(f"version_walk_{kind}_shards", n_shards)
+        ex = getattr(prof, "explain", None)
+        if ex is not None:
+            ex._node().setdefault("freshness", []).append(
+                {"walk": kind, "tier": tier, "shards": n_shards}
+            )
 
     def _confirm_vers(self, field_obj, shards_t, recorded,
                       view_name=VIEW_STANDARD, tier="other"):
@@ -1505,18 +1732,52 @@ class TPUBackend:
     def _psum(self, x):
         return jax.lax.psum(x, self.mesh.axis) if self.mesh is not None else x
 
-    def _counted_launch(self, kind: str, fn):
+    def _counted_launch(self, kind: str, fn, key=None):
         """Wrap a compiled program so every execution counts as
         `device_launches_total{kind=…}` — the chokepoint every query
         program passes through, so batching wins are SLO-visible as a
         falling launch rate against a steady batch_legs_total (ISSUE r11:
         `query_phase_seconds{phase=device_dispatch}` collapses to a
-        per-BATCH cost; this counter is the denominator that proves it)."""
+        per-BATCH cost; this counter is the denominator that proves it).
+
+        ISSUE 16: the same chokepoint feeds the device-program ledger.
+        A jit executable exposes its trace-cache size; a cache growth
+        across one call means THIS call paid a trace+compile, and the
+        call's wall time is the measured compile cost (the first run's
+        device execution rides along — the operator-relevant figure is
+        'how long did this launch stall on XLA', which is exactly that).
+        EXPLAIN launch records are written here too, only when the
+        active profile carries a plan (zero allocation otherwise)."""
         stats = self.stats.with_tags(f"kind:{kind}")
+        ledger = self.programs
+        cache_size = getattr(fn, "_cache_size", None)
+        mesh_n = self.mesh.n if self.mesh is not None else 1
 
         def counted(*args):
             stats.count("device_launches_total")
-            return fn(*args)
+            before = cache_size() if cache_size is not None else None
+            t0 = time.perf_counter()
+            out = fn(*args)
+            wall = time.perf_counter() - t0
+            compiled = (
+                before is not None and cache_size() > before
+            )
+            sig = ledger.record_launch(kind, key, args, wall, compiled, t0)
+            prof = current_profile()
+            ex = getattr(prof, "explain", None)
+            if ex is not None:
+                ex.add_launch({
+                    "kind": kind,
+                    "program": sig[0] if key is None else repr(key)[:120],
+                    "shapes": repr(sig[2])[:200],
+                    "occupancy": _sig_occupancy(sig[2]),
+                    "compiled": compiled,
+                    "dispatchMs": round(wall * 1e3, 3),
+                    "bytesShipped": _tree_nbytes(args),
+                    "bytesReturned": _tree_nbytes(out),
+                    "devices": mesh_n,
+                })
+            return out
 
         return counted
 
@@ -1721,7 +1982,7 @@ class TPUBackend:
         else:
             raise ValueError(kind)
 
-        fn = self._counted_launch(kind, fn)
+        fn = self._counted_launch(kind, fn, key=key)
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -1814,7 +2075,7 @@ class TPUBackend:
             # trip and host_reduce is pure host-side work (ISSUE r14:
             # the phase table's post-collapse contract,
             # docs/observability.md).
-            jax.block_until_ready(slab)
+            self.programs.block_ready(slab)
         with prof.phase("host_reduce"):
             # Whole-slab vectorized materialization: one readback, one
             # unpackbits+flatnonzero pass, shard bases added vectorized
@@ -1853,7 +2114,7 @@ class TPUBackend:
             # (and the relay RTT floor), host_reduce only the host-side
             # arithmetic — the phase table's post-collapse contract
             # (ISSUE r14, docs/observability.md).
-            jax.block_until_ready(partials)
+            self.programs.block_ready(partials)
         # Host sum in Python ints: exact for any shard count.
         with prof.phase("host_reduce"):
             return int(np.asarray(partials, dtype=np.uint64).sum())
@@ -2063,7 +2324,7 @@ class TPUBackend:
                     check_vma=False,
                 )
             )
-        fn = self._counted_launch("pair_stats", fn)
+        fn = self._counted_launch("pair_stats", fn, key=key)
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -2526,6 +2787,7 @@ class TPUBackend:
                     check_vma=False,
                 )
             )
+        fn = self._counted_launch("groupby", fn, key=key)
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -2574,6 +2836,7 @@ class TPUBackend:
                     check_vma=False,
                 )
             )
+        fn = self._counted_launch("nary", fn, key=key)
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -2998,6 +3261,9 @@ class TPUBackend:
         def flat(fb, gb, *extras):
             return nary_stats_pershard(fb, gb, extras, interpret=interpret)
 
+        # AOT compile happens HERE, not at first launch — measure it at
+        # the build (there is no launch-time jit-cache delta to observe).
+        t_compile = time.perf_counter()
         if self.mesh is None:
             fn = (
                 jax.jit(flat)
@@ -3029,6 +3295,11 @@ class TPUBackend:
                 ])
                 .compile()
             )
+        self.programs.record_compile(
+            "groupn_pershard", key, shapes,
+            time.perf_counter() - t_compile,
+        )
+        fn = self._counted_launch("groupn_pershard", fn, key=key)
         with self._fns_lock:
             fn = self._fns.setdefault(key, fn)
         return fn
@@ -3414,7 +3685,7 @@ class TPUBackend:
                 # host_reduce below is pure host arithmetic (ISSUE r14).
                 # Dispatches are already enqueued, so blocking here does
                 # not undo the callers' batch pipelining.
-                jax.block_until_ready([out for _, out, _ in pending])
+                self.programs.block_ready([out for _, out, _ in pending])
             with prof_r.phase("host_reduce"):
                 for idxs, out, slot_of in pending:
                     arr = np.asarray(out, dtype=np.uint64)
@@ -3556,7 +3827,7 @@ class TPUBackend:
                                 else out[:, pos_dev, :]
                             )
                         g.append(out)
-                    jax.block_until_ready(g)
+                    self.programs.block_ready(g)
                     gathered.append(g)
             with prof_r.phase("host_reduce"):
                 row_pos = list(range(len(positions))) if sub else positions

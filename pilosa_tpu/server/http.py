@@ -19,7 +19,11 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import __version__
 from pilosa_tpu.utils import fastjson
-from pilosa_tpu.utils.qprofile import profile_scope
+from pilosa_tpu.utils.qprofile import (
+    ExplainPlan,
+    cache_state,
+    profile_scope,
+)
 from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.server.api import API, APIError
 from pilosa_tpu.server.wire import (
@@ -923,10 +927,20 @@ class _Handler(BaseHTTPRequestHandler):
         # the breakdown covers the whole serving path through response
         # serialization; the executor reuses this profile (nested
         # profile_scope) and adds its phases to the same record.
+        # ISSUE 16: per-query EXPLAIN opt-in. The plan allocates ONLY
+        # here — with the flag off every deep-layer hook is a single
+        # `getattr(prof, "explain", None)` check and the serving path
+        # is byte-identical to a non-explain request.
+        explain = (
+            self.query.get("explain") == "1"
+            or bool((self.headers.get("X-Pilosa-Explain") or "").strip())
+        )
         with profile_scope(
             index=index, query=query if isinstance(query, str) else ""
         ) as prof:
             prof.remote = remote
+            if explain:
+                prof.explain = ExplainPlan()
             if accept == "application/x-protobuf":
                 try:
                     data = self.api.query_proto(index, query, **kw)
@@ -951,6 +965,18 @@ class _Handler(BaseHTTPRequestHandler):
             # fragment encoding; cache hits splice pre-encoded wire
             # bytes), and the reply is one header+body sendall.
             data = self.api.query_bytes(index, query, **kw)
+            if prof.explain is not None and data.endswith(b"}\n"):
+                # Splice the executed plan into the complete body bytes
+                # (the non-explain path never touches the bytes, so the
+                # test_fastjson byte-identity pin is undisturbed). The
+                # protobuf path above skips body attachment — its wire
+                # schema is fixed — but the plan still lands in the
+                # /debug/queries ring entry.
+                with prof.phase("serialize"):
+                    payload = json.dumps(
+                        prof.explain.to_dict(), separators=(",", ":")
+                    ).encode("utf-8")
+                    data = data[:-2] + b',"explain":' + payload + b"}\n"
             # resp_write, not serialize: the body is already encoded
             # (query_bytes' serialize phase), and this write's wall time
             # is dominated by the GIL/scheduler handoff around the send
@@ -1005,21 +1031,8 @@ class _Handler(BaseHTTPRequestHandler):
         the rest fresh), `miss` when lookups happened but none hit, and
         `bypass` when the request asked past the cache. Absent entirely
         when no cache is wired or nothing was even looked up."""
-        c = getattr(prof, "counters", None) or {}
-        if c.get("cache_bypass"):
-            return {"X-Pilosa-Cache": "bypass"}
-        lookups = c.get("cache_lookups", 0)
-        if not lookups:
-            return None
-        hits = c.get("cache_hits", 0)
-        uncached = c.get("cache_uncached", 0)
-        if hits and hits == lookups and not uncached:
-            state = "hit"
-        elif hits:
-            state = "partial"
-        else:
-            state = "miss"
-        return {"X-Pilosa-Cache": state}
+        state = cache_state(getattr(prof, "counters", None))
+        return {"X-Pilosa-Cache": state} if state else None
 
     #: On a shed, bodies up to this size are drained to keep the
     #: keep-alive connection framed; larger ones are NOT read (reading
@@ -1625,6 +1638,42 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         self._reply(rc.debug_dump())
+
+    @route("GET", r"/debug/programs")
+    def handle_debug_programs(self):
+        """The device-program ledger (ISSUE 16): every compiled
+        executable with its (kind, build key, shape signature), compile
+        cost, launch count, and cumulative post-sync device seconds —
+        sorted coldest-first, mirroring /debug/hbm. A nonzero
+        `recompiles` total here is the paging signal bucket-padding
+        regressions show up as."""
+        backend = getattr(self.api.executor, "backend", None)
+        programs = getattr(backend, "programs", None)
+        if programs is None or not hasattr(programs, "ledger"):
+            self._reply(
+                {"programs": 0, "compiles": 0, "recompiles": 0,
+                 "launches": 0, "entries": []}
+            )
+            return
+        out = programs.counts()
+        out["entries"] = programs.ledger()
+        self._reply(out)
+
+    @route("GET", r"/debug/stalls")
+    def handle_debug_stalls(self):
+        """The lock-stall ledger (utils/locks.py): the worst recent
+        contended waits across the named hot sites, worst-first, plus
+        per-site aggregates. Entries carry the waiter's trace id when a
+        trace was active — resolve it at /debug/traces/<id>."""
+        from pilosa_tpu.utils.locks import global_stall_ledger
+
+        n = int(self.query.get("n", "50"))
+        self._reply(
+            {
+                "worst": global_stall_ledger.worst(n),
+                "sites": global_stall_ledger.sites(),
+            }
+        )
 
     # -- internal routes (reference http/handler.go:307-318) ---------------
 

@@ -58,6 +58,73 @@ _qid_counter = itertools.count(1)
 _local = threading.local()
 
 
+def cache_state(counters: Optional[dict]) -> Optional[str]:
+    """Result-cache verdict for one profile's counters: `hit` when
+    EVERY answer came from the result cache, `partial` when some did,
+    `miss` when lookups happened but none hit, `bypass` when the
+    request asked past the cache, None when nothing was even looked
+    up. Shared by the X-Pilosa-Cache response header, the
+    /debug/queries ring entry, and the EXPLAIN plan."""
+    c = counters or {}
+    if c.get("cache_bypass"):
+        return "bypass"
+    lookups = c.get("cache_lookups", 0)
+    if not lookups:
+        return None
+    hits = c.get("cache_hits", 0)
+    uncached = c.get("cache_uncached", 0)
+    if hits and hits == lookups and not uncached:
+        return "hit"
+    if hits:
+        return "partial"
+    return "miss"
+
+
+class ExplainPlan:
+    """Executed-plan record for ONE query (ISSUE 16 tentpole 1):
+    per-call route + cache verdict, per-leg batcher records, per-launch
+    program records. Allocated ONLY when the request asked for it
+    (?explain=1 / X-Pilosa-Explain) — with the flag off, the profile's
+    `explain` slot stays None and every deep-layer hook is a single
+    `getattr(prof, "explain", None) is not None` check; no plan node is
+    ever constructed (tests/test_explain.py pins this).
+
+    Threading: the plan belongs to the request thread, but a batcher
+    LEADER thread appends leg/launch records into a follower's plan via
+    the sink captured at submit time — list.append is GIL-atomic, and
+    the follower only reads after its leg event is set (the same
+    happens-before edge the result itself rides)."""
+
+    __slots__ = ("calls", "_cur")
+
+    def __init__(self):
+        self.calls: list = []
+        self._cur: Optional[dict] = None
+
+    def begin_call(self, name: str) -> dict:
+        node: dict = {"call": name}
+        self.calls.append(node)
+        self._cur = node
+        return node
+
+    def _node(self) -> dict:
+        return self._cur if self._cur is not None else self.begin_call("")
+
+    def note(self, key: str, value) -> None:
+        self._node()[key] = value
+
+    def leg_sink(self) -> list:
+        """The list batcher leg records append to — captured at submit
+        time so the leader can attribute into the follower's plan."""
+        return self._node().setdefault("legs", [])
+
+    def add_launch(self, rec: dict) -> None:
+        self._node().setdefault("launches", []).append(rec)
+
+    def to_dict(self) -> dict:
+        return {"calls": self.calls}
+
+
 class _PhaseTimer:
     __slots__ = ("profile", "name", "t0")
 
@@ -80,6 +147,7 @@ class QueryProfile:
     __slots__ = (
         "qid", "index", "query", "call", "started_at", "_t0",
         "phases", "counters", "error", "duration", "remote",
+        "explain", "shards",
     )
 
     def __init__(self, index: str = "", query: str = "", call: str = ""):
@@ -102,6 +170,12 @@ class QueryProfile:
         self.counters: dict[str, int] = {}
         self.error: Optional[str] = None
         self.duration: Optional[float] = None
+        # ISSUE 16: executed-plan record, allocated only under the
+        # explain flag; resolved shard count, recorded by the executor
+        # for every request so the ring/slow-query log can name the
+        # route without explain.
+        self.explain: Optional[ExplainPlan] = None
+        self.shards: Optional[int] = None
 
     def phase(self, name: str) -> _PhaseTimer:
         return _PhaseTimer(self, name)
@@ -166,6 +240,16 @@ class QueryProfile:
             ),
             "counters": counters,
         }
+        # Route context (ISSUE 16 satellite): resolved shard count +
+        # cache verdict survive into the ring for EVERY request, so a
+        # slow-query entry names its route without needing explain.
+        if self.shards is not None:
+            out["shards"] = self.shards
+        cache = cache_state(counters)
+        if cache is not None:
+            out["cache"] = cache
+        if self.explain is not None:
+            out["explain"] = self.explain.to_dict()
         if self.error is not None:
             out["error"] = self.error
         return out
@@ -186,6 +270,8 @@ class NopProfile:
     phases: dict = {}
     counters: dict = {}
     call = ""
+    explain = None
+    shards = None
 
     def phase(self, name: str):
         return self._PHASE

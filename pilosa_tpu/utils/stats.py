@@ -139,6 +139,19 @@ def set_exemplar_provider(fn: Callable[[], Optional[str]]) -> None:
     _exemplar_provider = fn
 
 
+def exemplar_trace_id() -> Optional[str]:
+    """The current thread's active trace id via the registered provider,
+    or None. Public so non-histogram surfaces (the lock-stall ledger)
+    can stamp records with the same resolvable id exemplars carry."""
+    if _exemplar_provider is None:
+        return None
+    try:
+        return _exemplar_provider()
+    # lint: allow-except-exception(exemplar provider is best-effort; a tracer bug must not fail a stall record)
+    except Exception:  # noqa: BLE001 — exemplars are best-effort
+        return None
+
+
 class _Histogram:
     """One timing series: per-bucket counts + exact sum/count, plus the
     most recent traced observation per bucket (the exemplar)."""

@@ -108,6 +108,13 @@ def test_bench_smoke(tmp_path):
     assert blob["ingest_read_qps_under_load"] > 0
     assert "ingest_read_p99_delta_ms" in blob
     assert "ingest_version_walks" in blob
+    # The ISSUE 16 introspection keys: the ingest leg attributes its
+    # read-p99 delta to named stall sources (server-side snapshot-stall
+    # counter + per-site lock waits), and the groupby leg ships the
+    # EXPLAIN tree of the 3-field sweep as ROADMAP-item-2 seed data.
+    assert "ingest_snapshot_stall_seconds" in blob
+    assert isinstance(blob["ingest_lock_wait_seconds"], dict)
+    assert "calls" in blob["groupby_explain"], blob["groupby_explain"]
     # The r15 partition-heal keys the driver's acceptance reads: the
     # partition was real, the cluster reconverged, zero resurrections,
     # and directed repairs were recorded for BOTH heal directions.

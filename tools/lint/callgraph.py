@@ -59,6 +59,14 @@ LOCK_CTORS = {
     "threading.Lock": "Lock",
     "threading.RLock": "RLock",
     "threading.Condition": "Condition",
+    # utils/locks.py stall-attributed wrappers: same exclusion semantics
+    # as the bare locks, so the discipline/race analyses keep covering
+    # the instrumented sites (fragment, WAL append, snapshot mutex,
+    # batcher drain, rescache, HBM ledger).
+    "InstrumentedLock": "Lock",
+    "InstrumentedRLock": "RLock",
+    "locks.InstrumentedLock": "Lock",
+    "locks.InstrumentedRLock": "RLock",
 }
 
 

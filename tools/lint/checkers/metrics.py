@@ -42,6 +42,7 @@ METRIC_SUFFIXES = (
     "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
     "_shards", "_evictions", "_rederives", "_state",
     "_occupancy", "_queries", "_ops", "_entries",
+    "_programs", "_live",
 )
 
 _CALL_RE = re.compile(
@@ -159,6 +160,8 @@ ALLOWED_TAG_KEYS = {
     "reason",  # bounded failure-reason enum (device fallback, import shed)
     "outcome", # recovery outcome enum (replayed/truncated/corrupt)
     "le",      # histogram bucket bound (static BUCKET_BOUNDS)
+    "site",    # instrumented-lock site name (utils/locks call sites)
+    "program", # device-program ledger kind (program kinds are finite)
 }
 
 #: Variable names that smell like raw request content. A tag VALUE
