@@ -179,6 +179,19 @@ ROLLING_CONVERGE_TIMEOUT = float(
     os.environ.get("BENCH_ROLLING_CONVERGE_TIMEOUT", "45")
 )
 
+# GroupBy cardinality sweep (ISSUE 17): nominal extra-row products the
+# leg spans (~10^2 → ~10^5 by default) on a small dedicated index —
+# cardinality scaling is the contract, not shard bandwidth.
+CARD_LEVELS = [
+    int(k)
+    for k in os.environ.get("BENCH_CARD_LEVELS", "128,4096,102400").split(",")
+]
+CARD_SHARDS = int(os.environ.get("BENCH_CARD_SHARDS", "2"))
+# Live rows per extra field: the pruned/live split every level shares.
+# 12 makes the two-field levels' live product (144) span multiple
+# 64-slot tiles, so launches-vs-tiles scaling is visible in the leg.
+CARD_LIVE_ROWS = int(os.environ.get("BENCH_CARD_LIVE_ROWS", "12"))
+
 WORDS = SHARD_WIDTH // 32
 
 PARTIAL_PATH = os.environ.get(
@@ -500,6 +513,11 @@ LEG_COUNTER_FAMILIES = (
     # insert/eviction attribution — a window's hit rate is
     # rescache_hits / (hits + misses) from these deltas.
     "rescache_",
+    # Tiled GroupBy plane (ISSUE 17): per-leg tile/pruning attribution —
+    # tiles ≈ live_combinations / slot bucket is the launch-count claim
+    # the cardinality leg embeds and the smoke test asserts.
+    "groupby_tiles_total",
+    "groupby_pruned_groups_total",
     # Serving-path payload accounting (ISSUE r14): body bytes written
     # per leg — with the window length this is the leg's
     # payload_bytes_per_s serving-throughput figure.
@@ -1343,32 +1361,151 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
     }
 
 
-def bench_group_by(holder, be) -> tuple[float, float, dict]:
-    """3-field GroupBy at the full shape: ONE device program builds the
-    [Rh, Rf, Rg] group-count tensor. Cold includes the one-time h-stack
-    pack + program compile; warm is the steady-state dispatch (a write
-    would re-trigger only the sweep). The warm pass runs under EXPLAIN
-    (ISSUE 16): its executed-plan tree — per-launch program keys,
-    shapes, bytes — ships in the BENCH JSON as the seed data the
-    GroupBy tiling work (ROADMAP item 2) starts from."""
+def bench_group_by(holder, be) -> tuple[float, float, float, dict]:
+    """3-field GroupBy at the full shape through the tiled engine
+    (ISSUE 17): popcount pruning drops empty extra rows, the survivors
+    sweep as slot-bucketed tiles. Three figures: cold includes the
+    one-time h-stack pack + tile-program compile; sweep forces a full
+    re-dispatch (tensor caches dropped) — the number the tiling
+    collapse is measured by; warm is the steady-state served path
+    (maintained tensor epoch hit — the same warm semantics as every
+    other leg). The sweep pass runs under EXPLAIN (ISSUE 16): per-tile
+    launches, occupancy, and the groupbyTiles pruning summary ship in
+    the BENCH JSON."""
     from pilosa_tpu.utils.qprofile import ExplainPlan, profile_scope
 
     ex = Executor(holder, backend=be)
+    q = "GroupBy(Rows(f), Rows(g), Rows(h))"
     t0 = time.perf_counter()
-    res = ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
+    res = ex.execute("bench", q)
     cold = time.perf_counter() - t0
     assert res and len(res[0]) > 0
-    # Warm = re-dispatch with resident stacks + compiled programs; drop
+    # Sweep = re-dispatch with resident stacks + compiled programs; drop
     # the tensor caches (summed + maintained per-shard) so this measures
-    # the sweep, not a dict hit.
+    # the tiled sweep, not a dict hit.
     be._agg_cache.clear()
     be._groupn_cache.clear()
     t0 = time.perf_counter()
     with profile_scope(index="bench", query="groupby_3field") as prof:
         prof.explain = ExplainPlan()
-        ex.execute("bench", "GroupBy(Rows(f), Rows(g), Rows(h))")
+        ex.execute("bench", q)
+    sweep = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    assert ex.execute("bench", q) == res
     warm = time.perf_counter() - t0
-    return cold, warm, prof.explain.to_dict()
+    return cold, sweep, warm, prof.explain.to_dict()
+
+
+def bench_groupby_cardinality(holder, be) -> dict:
+    """GroupBy cardinality sweep (ISSUE 17 satellite): nominal group
+    product K spans CARD_LEVELS (~10^2 → ~10^5) on a dedicated small
+    index while the LIVE product stays tiny (CARD_LIVE_ROWS per extra
+    field) — the pruning + tiling claim is that launches track
+    live_combinations / slot_bucket, not K, and that the slot-bucketed
+    program set never recompiles across cardinality changes. Per level:
+    cold (sweep) and warm (served) ms, per-kind launch deltas, tile and
+    pruned-group counters, and the expected tile count; plus the final
+    level's warm EXPLAIN tree and the whole leg's recompile delta
+    (asserted == 0 by tests/test_bench_smoke.py)."""
+    from pilosa_tpu.exec.tpu import MAX_GROUP_TILE_SLOTS, _slot_bucket
+    from pilosa_tpu.utils.qprofile import ExplainPlan, profile_scope
+
+    idx = holder.create_index("bcard")
+    rng = np.random.Generator(np.random.SFC64(19))
+
+    def fill(field, row_ids, per_row=256):
+        for shard in range(CARD_SHARDS):
+            for row in row_ids:
+                cols = rng.integers(
+                    shard * SHARD_WIDTH, (shard + 1) * SHARD_WIDTH,
+                    per_row, dtype=np.uint64,
+                )
+                field.import_bits(
+                    np.full(cols.size, row, dtype=np.uint64), cols
+                )
+    for fname in ("f", "g"):
+        fill(idx.create_field(fname), range(8))
+
+    def live_ids(height):
+        # Spread rows across the id space, pinning the nominal height
+        # via the last id (row height-1 MUST carry bits or the fetched
+        # stack shrinks and the level's k_nominal lies).
+        if height <= CARD_LIVE_ROWS:
+            return list(range(height))
+        step = max(1, (height - 1) // (CARD_LIVE_ROWS - 1))
+        ids = [i * step for i in range(CARD_LIVE_ROWS - 1)]
+        return sorted({*ids, height - 1})
+
+    ex = Executor(holder, backend=be)
+    snap_all0 = global_stats.snapshot()["counters"]
+    points = []
+    explain = None
+    for li, k_nom in enumerate(CARD_LEVELS):
+        # One extra field of height K for small K, two of height √K
+        # past 512 — the 2-field split is where the odometer product
+        # outgrows any one field's row space.
+        if k_nom <= 512:
+            heights = [k_nom]
+        else:
+            side = int(round(k_nom ** 0.5))
+            heights = [side, side]
+        extras = []
+        for t, height in enumerate(heights):
+            fld = idx.create_field(f"c{li}_{t}")
+            fill(fld, live_ids(height), per_row=128)
+            extras.append(f"c{li}_{t}")
+        k_nominal = 1
+        k_live = 1
+        for height in heights:
+            k_nominal *= height
+            k_live *= len(live_ids(height))
+        q = "GroupBy(Rows(f), Rows(g), {})".format(
+            ", ".join(f"Rows({e})" for e in extras)
+        )
+        snap0 = global_stats.snapshot()["counters"]
+        t0 = time.perf_counter()
+        res = ex.execute("bcard", q)
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        assert res, q
+        t0 = time.perf_counter()
+        with profile_scope(index="bcard", query=f"groupby_card_{k_nom}") as prof:
+            prof.explain = ExplainPlan()
+            assert ex.execute("bcard", q) == res
+        warm_ms = (time.perf_counter() - t0) * 1e3
+        explain = prof.explain.to_dict()
+        snap1 = global_stats.snapshot()["counters"]
+
+        def delta(prefix):
+            return {
+                k: round(snap1.get(k, 0) - snap0.get(k, 0))
+                for k in snap1
+                if k.startswith(prefix) and snap1[k] > snap0.get(k, 0)
+            }
+        t_slots = _slot_bucket(min(k_live, MAX_GROUP_TILE_SLOTS))
+        points.append({
+            "k_nominal": k_nominal,
+            "k_live": k_live,
+            "cold_ms": round(cold_ms, 1),
+            "warm_ms": round(warm_ms, 2),
+            "launches": delta("device_launches_total"),
+            "tiles": sum(delta("groupby_tiles_total").values()),
+            "tiles_expected": (k_live + t_slots - 1) // t_slots,
+            "pruned_groups": sum(
+                delta("groupby_pruned_groups_total").values()
+            ),
+            "pruned_expected": k_nominal - k_live,
+        })
+    snap_all1 = global_stats.snapshot()["counters"]
+    recompiles = round(sum(
+        snap_all1.get(k, 0) - snap_all0.get(k, 0)
+        for k in snap_all1
+        if k.startswith("device_recompiles_total")
+    ))
+    return {
+        "groupby_cardinality_points": points,
+        "groupby_cardinality_recompiles": recompiles,
+        "groupby_cardinality_explain": explain,
+    }
 
 
 def bench_minmax_churn(holder, be) -> tuple[float, float, float, dict]:
@@ -2768,13 +2905,17 @@ def main():
     # pack + upload + tri-program compile — measured after churn it
     # also absorbed a full f-stack rebuild (hundreds of dirtied shards)
     # and read as 3x worse than a real cold start.
-    groupby_cold_s, groupby_warm_s, groupby_explain = bench_group_by(h, be)
+    (
+        groupby_cold_s, groupby_sweep_s, groupby_warm_s, groupby_explain,
+    ) = bench_group_by(h, be)
     checkpoint(
         "groupby",
         groupby_3field_cold_s=round(groupby_cold_s, 2),
+        groupby_3field_sweep_ms=round(groupby_sweep_s * 1e3, 1),
         groupby_3field_warm_ms=round(groupby_warm_s * 1e3, 1),
         groupby_explain=groupby_explain,
     )
+    checkpoint("groupby_cardinality", **bench_groupby_cardinality(h, be))
     mm_hist_base = global_stats.histogram_snapshot()
     mm_ro, mm_churn, mm_wrate, mm_walks = bench_minmax_churn(h, be)
     checkpoint(

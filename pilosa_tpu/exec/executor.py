@@ -1036,7 +1036,13 @@ class Executor:
         if (self.mapper is None or opt.remote) and hasattr(self.backend, "group_by"):
             with self.tracer.start_span("executor.executeGroupByDevice"):
                 results = self.backend.group_by(
-                    index, c, filter_call, child_rows, self._shards(index, shards)
+                    index, c, filter_call, child_rows,
+                    self._shards(index, shards),
+                    # Enumeration may stop after cap nonzero groups: the
+                    # executor's window is a prefix of odometer order,
+                    # applied below (local) or by the coordinator
+                    # (remote partials are capped-but-untrimmed).
+                    cap=cap if has_lim else None,
                 )
             if results is not None:
                 if opt.remote:

@@ -546,6 +546,122 @@ def masked_lane_counts(slab, active):
     return per * active
 
 
+# ---------------------------------------------------------------------------
+# Tiled GroupBy slot programs (ISSUE 17): the N-field group tensor cut
+# into fixed-shape slot arrays. Where nary_stats bakes the row
+# combination into the grid (K is a COMPILED dimension, so every
+# cardinality change is a recompile and the whole product tensor ships
+# in one piece), these take the combination as a traced int32[T, E]
+# operand: one compiled signature per (stack shapes, slot bucket)
+# serves ANY row combination, so the scheduler in exec/tpu.py can prune
+# empty rows, cut the live product into tiles, and launch each tile
+# through the same program with zero recompiles. Fused-XLA formulation
+# (precedent: pair_stats_xla; on v5e the fused pair sweep measured
+# 2.73 ms vs 1.65 ms Pallas — an acceptable trade for a traced-operand
+# program, and on CPU hosts it avoids interpret-mode Pallas entirely,
+# which walks the (K, S, W) grid in Python).
+# ---------------------------------------------------------------------------
+
+#: Shard-axis chunk for the tile programs' inner reduction scan. The
+#: [SB, Rf, Rg, WT] popcount broadcast must stay small enough for the
+#: backend's vector units to fuse well: measured on the 1-core CPU host
+#: at the bench shape, SB=6 sweeps in 2.8 s where SB=12 falls off a
+#: vectorization cliff to 37 s. Shard counts that don't divide evenly
+#: finish with one static remainder chunk.
+GROUP_TILE_SHARD_CHUNK = 6
+
+
+def _tile_chunk_counts(fm, g_stack, pershard: bool):
+    """Shard-chunked AND+popcount reduction of one slot's masked f
+    against g: [Rf, Rg] totals, or [S, Rf, Rg] per-shard. The reduction
+    keeps vector-shaped outputs at every step (sum the word axis first,
+    then shards) — a joint multi-axis reduce lowers catastrophically on
+    XLA CPU."""
+    s, rf, w = fm.shape
+    rg = g_stack.shape[1]
+    sb = min(s, GROUP_TILE_SHARD_CHUNK)
+
+    def pc_block(fc, gc):
+        pc = jax.lax.population_count(
+            fc[:, :, None, :] & gc[:, None, :, :]
+        ).astype(jnp.int32)
+        return jnp.sum(pc, axis=3)  # [sb, Rf, Rg]
+
+    n_chunks = s // sb
+    if pershard:
+        def chunk(carry, i):
+            fc = jax.lax.dynamic_slice_in_dim(fm, i * sb, sb, 0)
+            gc = jax.lax.dynamic_slice_in_dim(g_stack, i * sb, sb, 0)
+            return carry, pc_block(fc, gc)
+
+        _, per = jax.lax.scan(chunk, None, jnp.arange(n_chunks))
+        per = per.reshape(n_chunks * sb, rf, rg)
+        if s % sb:
+            per = jnp.concatenate(
+                [per, pc_block(fm[n_chunks * sb :], g_stack[n_chunks * sb :])]
+            )
+        return per
+
+    def chunk(acc, i):
+        fc = jax.lax.dynamic_slice_in_dim(fm, i * sb, sb, 0)
+        gc = jax.lax.dynamic_slice_in_dim(g_stack, i * sb, sb, 0)
+        return acc + jnp.sum(pc_block(fc, gc), axis=0), None
+
+    acc, _ = jax.lax.scan(chunk, jnp.zeros((rf, rg), jnp.int32), jnp.arange(n_chunks))
+    if s % sb:
+        acc = acc + jnp.sum(
+            pc_block(fm[n_chunks * sb :], g_stack[n_chunks * sb :]), axis=0
+        )
+    return acc
+
+
+def _group_tile(f_stack, g_stack, extras, rows_idx, active, filt, pershard):
+    """Shared body of the tile programs: lax.scan over the slot axis, so
+    T appears only as a scan length (one compiled signature per slot
+    bucket) and every slot re-reads the stacks exactly once — the same
+    HBM traffic discipline as nary_stats's k axis."""
+
+    def slot(carry, xs):
+        idx, act = xs
+        m = None
+        for t, h in enumerate(extras):
+            row = jax.lax.dynamic_index_in_dim(h, idx[t], axis=1, keepdims=False)
+            m = row if m is None else (m & row)  # [S, W]
+        if filt is not None:
+            m = m & filt
+        # Padded slots replay slot 0's rows; the lane mask zeroes their
+        # slab so they contribute exactly 0 to every cell.
+        m = mask_lane_slab(m, act)
+        fm = f_stack & m[:, None, :]
+        return carry, _tile_chunk_counts(fm, g_stack, pershard)
+
+    _, out = jax.lax.scan(slot, None, (rows_idx, active))
+    return out
+
+
+def group_tile_stats(f_stack, g_stack, extras, rows_idx, active, filt=None):
+    """One tile of the N-field group tensor, slot-indexed:
+
+    (uint32[S, Rf, W], uint32[S, Rg, W], (uint32[S, Rh1, W], ...),
+    int32[T, E], uint32[T] [, uint32[S, W]]) -> int32[T, Rf, Rg] with
+    out[q, a, b] = popcount(F_a & G_b & H1_{rows_idx[q,0]} & ... [& filt])
+    for active[q] == 1, exactly 0 for padded slots.
+
+    Must agree bit-for-bit with nary_stats on the matching k slots
+    (differentially tested in tests/test_groupby_tiles.py). Accumulator
+    bound: same MAX_PAIR_SHARDS int32 argument as pair_stats."""
+    return _group_tile(f_stack, g_stack, extras, rows_idx, active, filt, False)
+
+
+def group_tile_stats_pershard(f_stack, g_stack, extras, rows_idx, active):
+    """group_tile_stats WITHOUT the shard reduction:
+    -> int32[T, S, Rf, Rg]. Unfiltered by design — the per-shard table
+    absorbs write churn for the UNFILTERED group tensor only (same
+    contract as nary_stats_pershard, which this replaces on the
+    single-shot dispatch path)."""
+    return _group_tile(f_stack, g_stack, extras, rows_idx, active, None, True)
+
+
 def pair_stats_xla(f_stack, g_stack):
     """Fused-XLA reference formulation of pair_stats (same results; used
     as the differential oracle for the Pallas kernel and as the fallback

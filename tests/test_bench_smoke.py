@@ -53,6 +53,11 @@ SMOKE_ENV = {
     "BENCH_MESH_DEVICES": "1,2",
     "BENCH_MESH_SHARDS": "8",
     "BENCH_MESH_SECONDS": "0.3",
+    # Tiny GroupBy cardinality sweep (ISSUE 17): two levels exercise
+    # the prune + tile machinery and the recompile pin, not a curve.
+    "BENCH_CARD_LEVELS": "8,64",
+    "BENCH_CARD_SHARDS": "2",
+    "BENCH_CARD_LIVE_ROWS": "4",
 }
 
 
@@ -115,6 +120,20 @@ def test_bench_smoke(tmp_path):
     assert "ingest_snapshot_stall_seconds" in blob
     assert isinstance(blob["ingest_lock_wait_seconds"], dict)
     assert "calls" in blob["groupby_explain"], blob["groupby_explain"]
+    # The ISSUE 17 tiled-GroupBy keys: the forced-sweep figure rides
+    # next to the served warm figure, and the cardinality leg proves
+    # launches track live_combinations/slots with zero recompiles.
+    assert blob["groupby_3field_sweep_ms"] > 0
+    assert blob["groupby_3field_warm_ms"] > 0
+    pts = blob["groupby_cardinality_points"]
+    assert [p["k_nominal"] for p in pts] == [8, 64]
+    for p in pts:
+        assert p["k_live"] <= p["k_nominal"]
+        assert p["tiles"] == p["tiles_expected"], p
+        assert p["pruned_groups"] == p["pruned_expected"], p
+        assert sum(p["launches"].values()) > 0, p
+    assert blob["groupby_cardinality_recompiles"] == 0
+    assert "calls" in blob["groupby_cardinality_explain"]
     # The r15 partition-heal keys the driver's acceptance reads: the
     # partition was real, the cluster reconverged, zero resurrections,
     # and directed repairs were recorded for BOTH heal directions.
@@ -157,7 +176,8 @@ def test_bench_smoke(tmp_path):
                 "minmax_churn", "http", "qps@1", "qps@4",
                 "concurrency_sweep", "zipf@1", "zipf@4", "zipf_cache",
                 "partition_heal", "ingest_under_load", "rolling_restart",
-                "mesh@1", "mesh@2", "mesh_scaling"):
+                "mesh@1", "mesh@2", "mesh_scaling", "groupby",
+                "groupby_cardinality"):
         assert leg in blob["legs_done"], blob["legs_done"]
     # The partial artifact also landed complete on disk.
     disk = json.loads(open(env["BENCH_PARTIAL_PATH"]).read())

@@ -1,0 +1,272 @@
+"""Tiled GroupBy engine tests (ISSUE 17): popcount pruning, slot-bucketed
+tile launches, odometer-order streaming, and filtered-tensor caching must
+stay byte-identical to the host per-shard iterator across the argument
+matrix — on BOTH the maintained per-shard path and the generic tiled
+sweep (forced by shrinking the per-shard byte budget to zero)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.tpu import MAX_GROUP_TILE_SLOTS, TPUBackend, _slot_bucket
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.stats import global_stats
+
+
+def counter_sum(prefix: str) -> float:
+    snap = global_stats.snapshot()
+    return sum(v for k, v in snap["counters"].items() if k.startswith(prefix))
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data")).open()
+    yield h
+    h.close()
+
+
+# Sparse-gap extra fields: nominal height exceeds the live row set, so
+# pruning is load-bearing, not vacuous. c spans 8 nominal rows with 3
+# live (1, 2, 4, 5, 6 globally empty); d spans 6 with 2 live.
+LIVE = {"c": (0, 3, 7), "d": (2, 5)}
+PIN_COL = 2 * SHARD_WIDTH - 9  # deterministic column for the column= arm
+
+
+def build(holder, rng):
+    idx = holder.create_index("i")
+    for fname, nrows in (("a", 3), ("b", 2)):
+        idx.create_field(fname)
+        for row in range(1, nrows + 1):
+            cols = np.unique(
+                rng.integers(0, 2 * SHARD_WIDTH, 1500, dtype=np.uint64)
+            )
+            idx.field(fname).import_bits(
+                np.full(cols.size, row, dtype=np.uint64), cols
+            )
+    for fname, rows in LIVE.items():
+        idx.create_field(fname)
+        for row in rows:
+            cols = np.unique(
+                rng.integers(0, 2 * SHARD_WIDTH, 900, dtype=np.uint64)
+            )
+            idx.field(fname).import_bits(
+                np.full(cols.size, row, dtype=np.uint64), cols
+            )
+    idx.field("c").set_bit(0, PIN_COL)
+    idx.field("c").set_bit(3, PIN_COL)
+    return idx
+
+
+def build_wide(idx, rng, nrows=70):
+    """Fully-live 70-row extra field: the live product exceeds one
+    64-slot bucket, so enumeration crosses a tile boundary."""
+    idx.create_field("e")
+    for row in range(nrows):
+        cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 40, dtype=np.uint64))
+        idx.field("e").import_bits(
+            np.full(cols.size, row, dtype=np.uint64), cols
+        )
+
+
+QUERIES = [
+    "GroupBy(Rows(a), Rows(b), Rows(c))",
+    "GroupBy(Rows(a), Rows(b), Rows(d))",
+    "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d))",
+    "GroupBy(Rows(a), Rows(b), Rows(c), limit=4)",
+    "GroupBy(Rows(a), Rows(b), Rows(c), limit=3, offset=2)",
+    "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d), limit=5, offset=1)",
+    "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d), limit=100, offset=3)",
+    "GroupBy(Rows(a, previous=1), Rows(b), Rows(c))",
+    "GroupBy(Rows(a), Rows(b), Rows(c, previous=3))",
+    "GroupBy(Rows(a), Rows(b), Rows(c, limit=2))",
+    f"GroupBy(Rows(a), Rows(b), Rows(c, column={PIN_COL}))",
+    "GroupBy(Rows(a), Rows(b), Rows(c), filter=Row(a=1))",
+    "GroupBy(Rows(a), Rows(b), Rows(c), filter=Union(Row(a=1), Row(b=2)))",
+    "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d), filter=Row(c=3))",
+    "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d), filter=Row(d=5), limit=4)",
+]
+
+WIDE_QUERIES = [
+    "GroupBy(Rows(a), Rows(b), Rows(e))",
+    "GroupBy(Rows(a), Rows(b), Rows(e), limit=7, offset=250)",
+    "GroupBy(Rows(a), Rows(b), Rows(e), filter=Row(a=2))",
+]
+
+
+def _differential(holder, be):
+    host = Executor(holder)
+    dev = Executor(holder, backend=be)
+    for q in QUERIES + WIDE_QUERIES:
+        assert dev.execute("i", q) == host.execute("i", q), q
+
+
+class TestTiledDifferential:
+    def test_maintained_path(self, holder, rng):
+        """Default routing: n>=3 unfiltered rides the maintained
+        per-shard tensor (tiled pershard kernel underneath)."""
+        idx = build(holder, rng)
+        build_wide(idx, rng)
+        _differential(holder, TPUBackend(holder))
+
+    def test_generic_tiled_path(self, holder, rng):
+        """Byte budget 1 bails the maintained per-shard tensor before
+        its prewarm, so every n>=3 query takes the generic prune+tile
+        sweep — the same matrix must still match the host."""
+        idx = build(holder, rng)
+        build_wide(idx, rng)
+        be = TPUBackend(holder)
+        be.MAX_PAIR_PERSHARD_BYTES = 1
+        _differential(holder, be)
+
+    def test_wide_field_spans_tiles(self, holder, rng):
+        """70 live combinations > one 64-slot bucket: the sweep cuts 2
+        tiles and enumeration stays exact across the boundary."""
+        idx = build(holder, rng)
+        build_wide(idx, rng)
+        be = TPUBackend(holder)
+        be.MAX_PAIR_PERSHARD_BYTES = 1
+        t0 = counter_sum("groupby_tiles_total")
+        host = Executor(holder)
+        dev = Executor(holder, backend=be)
+        q = "GroupBy(Rows(a), Rows(b), Rows(e))"
+        assert dev.execute("i", q) == host.execute("i", q)
+        assert _slot_bucket(min(70, MAX_GROUP_TILE_SLOTS)) == 64
+        assert counter_sum("groupby_tiles_total") - t0 == 2
+
+
+class TestPruning:
+    def test_pruned_and_tile_counters(self, holder, rng):
+        """8x8 nominal extra product (stacks pad row counts to multiples
+        of 8 — pad rows prune like real empties), 3x2 live: 58 combos
+        pruned before any tile is cut, one 8-slot bucket covers the 6."""
+        build(holder, rng)
+        be = TPUBackend(holder)
+        be.MAX_PAIR_PERSHARD_BYTES = 1
+        dev = Executor(holder, backend=be)
+        p0 = counter_sum("groupby_pruned_groups_total")
+        t0 = counter_sum("groupby_tiles_total")
+        dev.execute("i", "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d))")
+        assert counter_sum("groupby_pruned_groups_total") - p0 == 8 * 8 - 3 * 2
+        assert counter_sum("groupby_tiles_total") - t0 == 1  # bucket(6)=8
+        hist = global_stats.histogram_snapshot()
+        assert "groupby_tile_occupancy" in hist
+
+    def test_empty_row_becomes_live_under_churn(self, holder, rng):
+        """A write into a previously-empty row must refresh the prune
+        set: the new groups appear, counts match the host exactly."""
+        idx = build(holder, rng)
+        be = TPUBackend(holder)
+        be.MAX_PAIR_PERSHARD_BYTES = 1
+        host = Executor(holder)
+        dev = Executor(holder, backend=be)
+        q = "GroupBy(Rows(a), Rows(b), Rows(c))"
+        before = dev.execute("i", q)
+        assert before == host.execute("i", q)
+        # Row 4 of c was globally empty (pruned); give it a column that
+        # also lives in a=1 and b=1 so a brand-new group materializes.
+        col = SHARD_WIDTH + 11
+        idx.field("a").set_bit(1, col)
+        idx.field("b").set_bit(1, col)
+        idx.field("c").set_bit(4, col)
+        after = dev.execute("i", q)
+        assert after == host.execute("i", q)
+        assert after != before
+        assert any(g.group[-1].row_id == 4 for g in after[0])
+
+    def test_churn_differential(self, holder, rng):
+        """Point-write churn across grouped fields: every epoch's tiled
+        answer matches the host (stale-tile invalidation)."""
+        idx = build(holder, rng)
+        be = TPUBackend(holder)
+        be.MAX_PAIR_PERSHARD_BYTES = 1
+        host = Executor(holder)
+        dev = Executor(holder, backend=be)
+        qs = ["GroupBy(Rows(a), Rows(b), Rows(c))",
+              "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d), limit=6)"]
+        for k in range(3):
+            idx.field("c").set_bit(LIVE["c"][k % 3], 444_000 + k)
+            idx.field("a").set_bit(1 + k % 3, 555_000 + k)
+            for q in qs:
+                assert dev.execute("i", q) == host.execute("i", q), (k, q)
+
+
+class TestRecompilePin:
+    def test_flat_across_cardinality(self, holder, rng):
+        """Slot buckets + in-kernel masking keep the program set small:
+        sweeping 3/2/6/70-live shapes plus churn re-sweeps must not
+        recompile any signature already in the ledger."""
+        idx = build(holder, rng)
+        build_wide(idx, rng)
+        be = TPUBackend(holder)
+        be.MAX_PAIR_PERSHARD_BYTES = 1
+        dev = Executor(holder, backend=be)
+        r0 = counter_sum("device_recompiles_total")
+        for q in ("GroupBy(Rows(a), Rows(b), Rows(c))",
+                  "GroupBy(Rows(a), Rows(b), Rows(d))",
+                  "GroupBy(Rows(a), Rows(b), Rows(c), Rows(d))",
+                  "GroupBy(Rows(a), Rows(b), Rows(e))"):
+            dev.execute("i", q)
+        idx.field("c").set_bit(3, 666_000)  # churn → re-sweep, same bucket
+        dev.execute("i", "GroupBy(Rows(a), Rows(b), Rows(c))")
+        assert counter_sum("device_recompiles_total") - r0 == 0
+
+
+class TestFilteredCache:
+    Q = "GroupBy(Rows(a), Rows(b), Rows(c), filter=Union(Row(a=1), Row(b=2)))"
+
+    def test_hit_and_churn_invalidation(self, holder, rng):
+        """Filtered n>=3 tensors (previously ckey=None — recomputed
+        every query) now cache on filter fingerprint + epoch vector:
+        repeat queries hit, writes to grouped AND filter-referenced
+        fields invalidate."""
+        idx = build(holder, rng)
+        be = TPUBackend(holder)
+        host = Executor(holder)
+        dev = Executor(holder, backend=be)
+        want = host.execute("i", self.Q)
+        assert dev.execute("i", self.Q) == want
+        h0 = counter_sum("agg_cache_hits_total")
+        assert dev.execute("i", self.Q) == want
+        assert counter_sum("agg_cache_hits_total") - h0 == 1
+        # Write to a GROUPED field: epoch vector moves, cache must miss.
+        idx.field("c").set_bit(3, 777_000)
+        h0 = counter_sum("agg_cache_hits_total")
+        assert dev.execute("i", self.Q) == host.execute("i", self.Q)
+        assert counter_sum("agg_cache_hits_total") - h0 == 0
+        # Re-warm, then write to a FILTER-referenced field (b is only
+        # in the filter tree's Union arm): fingerprint must move too.
+        assert dev.execute("i", self.Q) == host.execute("i", self.Q)
+        idx.field("b").set_bit(2, 777_001)
+        h0 = counter_sum("agg_cache_hits_total")
+        assert dev.execute("i", self.Q) == host.execute("i", self.Q)
+        assert counter_sum("agg_cache_hits_total") - h0 == 0
+
+    def test_filter_only_field_invalidates(self, holder, rng):
+        """Filter references a field NOT in the grouped set: writes to
+        it alone must move the fingerprint. Pins the Row(d=5) spelling,
+        where the field is the arg KEY (Call.field_arg semantics), not
+        a field= arg."""
+        idx = build(holder, rng)
+        be = TPUBackend(holder)
+        host = Executor(holder)
+        dev = Executor(holder, backend=be)
+        q = "GroupBy(Rows(a), Rows(b), Rows(c), filter=Row(d=5))"
+        assert dev.execute("i", q) == host.execute("i", q)
+        h0 = counter_sum("agg_cache_hits_total")
+        assert dev.execute("i", q) == host.execute("i", q)
+        assert counter_sum("agg_cache_hits_total") - h0 == 1
+        idx.field("d").set_bit(5, SHARD_WIDTH + 77)
+        h0 = counter_sum("agg_cache_hits_total")
+        assert dev.execute("i", q) == host.execute("i", q)
+        assert counter_sum("agg_cache_hits_total") - h0 == 0
+
+    def test_ledger_charge(self, holder, rng):
+        """Cached groupby payloads are charged to the agg_cache_bytes
+        gauge (LRU ledger satellite)."""
+        build(holder, rng)
+        be = TPUBackend(holder)
+        dev = Executor(holder, backend=be)
+        dev.execute("i", self.Q)
+        snap = global_stats.snapshot()["gauges"]
+        assert snap.get("agg_cache_bytes", 0) > 0

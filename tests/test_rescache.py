@@ -243,6 +243,60 @@ class TestAddressability:
         assert d["bypass"] == 2 and d["inserts"] == 0 and d["hits"] == 0
 
 
+class TestGroupByCaching:
+    """Terminal GroupBy rides the result cache end to end (ISSUE 17):
+    epoch-addressed hits, wire-bytes reuse across requests, and write
+    invalidation on every grouped field."""
+
+    Q = "GroupBy(Rows(f), Rows(g), Rows(h))"
+
+    def _add_h(self, holder):
+        # The fixture's f/g bits are sparse-random (empty triple
+        # intersections); plant overlapping columns across all three
+        # fields so the GroupBy answer is nonempty.
+        idx = holder.index("i")
+        hf = idx.create_field("h")
+        for shard in range(3):
+            cols = np.arange(120, dtype=np.uint64) + shard * SHARD_WIDTH
+            for fld, nrows in ((idx.field("f"), 4), (idx.field("g"), 4),
+                               (hf, 3)):
+                rows = (np.arange(120) % nrows).astype(np.uint64)
+                fld.import_bits(rows, cols)
+
+    def test_hit_wire_bytes_and_invalidation(self, holder):
+        self._add_h(holder)
+        ex = cached_executor(holder)
+        first = ex.execute("i", self.Q)
+        assert len(first[0]) > 0
+        assert ex.execute("i", self.Q) == first
+        d = ex.rescache.debug_dump()
+        assert d["hits"] == 1 and d["misses"] == 1 and d["inserts"] == 1
+        # Wire plane: the encoded fragment memoizes on the entry and
+        # replays on the next hit (the server splice path's contract).
+        flags = ("json", False)
+        tok = ex.rescache.begin("i", one(self.Q), [0, 1, 2])
+        assert tok is not None and tok.hit
+        assert ex.rescache.wire_for(tok, flags) is None
+        ex.rescache.attach_wire(tok, flags, b'{"x":1}')
+        tok2 = ex.rescache.begin("i", one(self.Q), [0, 1, 2])
+        assert tok2.hit and ex.rescache.wire_for(tok2, flags) == b'{"x":1}'
+        # A write to ANY grouped field stops addressing the entry.
+        holder.index("i").field("h").set_bit(1, 2 * SHARD_WIDTH + 3)
+        misses0 = d["misses"]
+        after = ex.execute("i", self.Q)
+        assert ex.rescache.debug_dump()["misses"] == misses0 + 1
+        assert after == Executor(holder).execute("i", self.Q)
+
+    def test_filtered_groupby_caches(self, holder):
+        self._add_h(holder)
+        ex = cached_executor(holder)
+        q = "GroupBy(Rows(f), Rows(g), Rows(h), filter=Row(f=1))"
+        first = ex.execute("i", q)
+        assert ex.execute("i", q) == first
+        d = ex.rescache.debug_dump()
+        assert d["hits"] == 1 and d["misses"] == 1
+
+
 class TestClusterPropagation:
     def test_bypass_rides_remote_legs(self):
         """X-Pilosa-Cache: bypass must cross the node boundary: peers

@@ -458,6 +458,33 @@ class TestJournalCompleteFreshness:
         finally:
             holder.close()
 
+    def test_groupn_redispatch_confirm_journal_backed(self):
+        """A forced groupn re-dispatch (tensor caches dropped) pays only
+        the 3 unavoidable cold pre-vers full walks — the post-fetch
+        confirm rides the journal (ISSUE 17 satellite: the r13 groupby
+        leg showed 12 full walks = 2 executes x (3 pre + 3 confirm))."""
+        tpu = self._tpu()
+        holder = Holder(None).open()
+        try:
+            self._build(holder, fields=("f", "g", "h"))
+            be = tpu.TPUBackend(holder)
+            ex = Executor(holder, backend=be)
+            q = "GroupBy(Rows(f), Rows(g), Rows(h))"
+            ex.execute("i", q)  # warm: compile + first dispatch
+            be._groupn_cache.clear()
+            be._agg_cache.clear()
+            w0 = self._walks("groupn")
+            ex.execute("i", q)
+            w1 = self._walks("groupn")
+            assert w1["full"][0] - w0["full"][0] == 3
+            assert w1["full"][1] - w0["full"][1] == 3 * self.N_SHARDS
+            # The confirm side: journal walks with ZERO locked shard
+            # reads (nothing dirtied between snapshot and fetch).
+            assert w1["journal"][0] - w0["journal"][0] == 3
+            assert w1["journal"][1] - w0["journal"][1] == 0
+        finally:
+            holder.close()
+
     def test_epoch_versions_differential_vs_live(self):
         """Journal-derived versions must equal the full locked walk in
         every regime: journal-covered epochs, evicted windows, and
