@@ -534,6 +534,12 @@ LEG_COUNTER_FAMILIES = (
     # direction/skip counters) plus the read-path divergence plane.
     "replica_divergence_blocks_total",
     "read_repair_",
+    # Workload-characterization families (ISSUE 18): how many block
+    # references the SHARDS estimator admitted this leg (its curve's
+    # evidence base) and how many NEW query shapes the leg minted (a
+    # steady-state leg should mint ~0 after warmup).
+    "reuse_distance_samples_total",
+    "workload_shapes_total",
 )
 
 
@@ -569,7 +575,7 @@ def leg_metrics_delta(before: dict) -> tuple[dict, dict]:
         k: v
         for k, v in snap["gauges"].items()
         if k.startswith(("hbm_resident_bytes", "hbm_evictions_total",
-                         "tpu_resident_bytes"))
+                         "hbm_access_heat", "tpu_resident_bytes"))
     }
     return {"counters": deltas, "hbm": hbm}, after
 
@@ -1950,6 +1956,22 @@ def bench_ingest_under_load() -> dict:
             threading.Thread(target=writer, args=(k,), daemon=True)
             for k in range(INGEST_WRITERS)
         ]
+        # Flight-recorder sampling over window B (ISSUE 18): a 1 Hz
+        # ticker during the churn window gives the checkpoint a phase-
+        # by-phase read-collapse attribution — WHICH seconds inside the
+        # window lost qps, and what (snapshot stall, lock-wait site,
+        # shed burst) moved in the same tick — where the aggregate
+        # ingest_read_qps_ratio only says THAT the window lost it.
+        from pilosa_tpu.utils.monitor import global_flight_recorder
+        rec_stop = threading.Event()
+
+        def _recorder() -> None:
+            global_flight_recorder.sample()
+            while not rec_stop.wait(1.0):
+                global_flight_recorder.sample()
+
+        rec_thread = threading.Thread(target=_recorder, daemon=True)
+        rec_thread.start()
         t0 = time.time()
         for t in writers:
             t.start()
@@ -1958,6 +1980,10 @@ def bench_ingest_under_load() -> dict:
         for t in writers:
             t.join(timeout=10)
         elapsed = time.time() - t0
+        rec_stop.set()
+        rec_thread.join(timeout=5)
+        global_flight_recorder.sample()
+        ingest_timeline = global_flight_recorder.timeline(elapsed + 2.0)
         api.max_import_bytes = 0
         if writer_errors:
             raise writer_errors[0]
@@ -2010,6 +2036,7 @@ def bench_ingest_under_load() -> dict:
             "ingest_snapshot_stall_seconds": round(snap_s, 3),
             "ingest_lock_wait_seconds": lock_wait,
             "ingest_version_walks": churn_walks,
+            "ingest_timeline": ingest_timeline,
             "ingest_shards": INGEST_SHARDS,
             "ingest_writers": INGEST_WRITERS,
         }

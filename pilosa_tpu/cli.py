@@ -71,7 +71,8 @@ def cmd_server(args) -> int:
                 if want > 1:
                     mesh = ShardMesh(devices[:want])
             backend = TPUBackend(
-                holder, mesh=mesh, max_bytes=cfg.max_hbm_bytes or None
+                holder, mesh=mesh, max_bytes=cfg.max_hbm_bytes or None,
+                heat_half_life=cfg.heat_half_life or None,
             )
             log.printf(
                 "executor=tpu: device backend enabled (%d device%s)",

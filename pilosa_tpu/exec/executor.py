@@ -32,7 +32,7 @@ from pilosa_tpu.exec.result import (
     merge_group_counts,
 )
 from pilosa_tpu.pql import Call, Condition, Query, parse_string
-from pilosa_tpu.pql.ast import is_reserved_arg
+from pilosa_tpu.pql.ast import is_reserved_arg, shape_key
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.utils.deadline import check_deadline
 from pilosa_tpu.utils.qprofile import cache_state, current_profile, profile_scope
@@ -150,6 +150,15 @@ class Executor:
         translate = self._needs_translation(idx)
         if query.calls and not prof.call:
             prof.call = query.calls[0].name
+        if query.calls and prof.shape is None:
+            # Per-shape cost accounting (ISSUE 18): a structure-only
+            # fingerprint of the request, stamped once per profile so
+            # profile_scope._export can aggregate it into the workload
+            # table. Cap at three calls / 200 chars — batch imports can
+            # carry hundreds of calls and the table keys must stay small.
+            prof.shape = "; ".join(
+                shape_key(c) for c in query.calls[:3]
+            )[:200]
         # Result-cache plane (exec/rescache.py): consulted where an
         # epoch vector can witness every relevant write. Locally that is
         # the single-node coordinator and remote per-node legs; a

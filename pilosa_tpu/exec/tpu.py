@@ -99,7 +99,8 @@ from pilosa_tpu.pql.ast import (
 from pilosa_tpu.roaring import Bitmap
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.utils.locks import InstrumentedRLock
-from pilosa_tpu.utils.qprofile import current_profile
+from pilosa_tpu.utils.qprofile import NOP_PROFILE, current_profile
+from pilosa_tpu.utils.reuse import ReuseDistanceEstimator
 from pilosa_tpu.utils.stats import global_stats
 
 _DEVICE_LOWERED = ("Row", "Range", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift")
@@ -183,11 +184,23 @@ class _StackedBlocks:
     #: device count for no dispatch saving at realistic dirty rates.
     MESH_UPDATE_CHUNK = 1
 
+    #: Default decayed-frequency half-life in seconds (config
+    #: heat-half-life): a block untouched for one half-life keeps half
+    #: its heat — 5 minutes separates the serving hot set from batch
+    #: stragglers without forgetting a diurnal lull.
+    HEAT_HALF_LIFE = 300.0
+
     def __init__(self, device=None, mesh=None, max_bytes: Optional[int] = None,
-                 fallback=None):
+                 fallback=None, heat_half_life: Optional[float] = None):
         self.device = device
         self.mesh = mesh  # ShardMesh or None
         self.max_bytes = max_bytes
+        self.heat_half_life = heat_half_life or self.HEAT_HALF_LIFE
+        # Online miss-ratio-curve input (ISSUE 18): every ledger access
+        # (hit or rebuild) is offered to the SHARDS sampler; admission
+        # is one hash compare, so the block-fetch path stays at its
+        # pre-instrumentation cost when the hash rejects.
+        self.reuse = ReuseDistanceEstimator()
         # Mesh-tier degradation counter (ISSUE r13 satellite: mesh gaps
         # must not be silent): called with (reason, shape, err) whenever
         # a mesh-specific fast path bails to the dense/rebuild behavior.
@@ -639,20 +652,31 @@ class _StackedBlocks:
         Concurrent misses for one key build once (losers wait on the
         winner's latch, then re-check)."""
         while True:
+            hit = None
+            nbytes = 0
             with self._lock:
                 cached = self._entries.get(key)
                 if cached is not None and cached[0] == fingerprint:
-                    # LRU touch.
+                    # LRU touch + heat bump (ISSUE 18: bare arithmetic
+                    # on the ledger entry already in hand — the hot hit
+                    # path allocates nothing new).
                     self._entries[key] = self._entries.pop(key)
                     led = self._ledger.get(key)
                     if led is not None:
-                        led["access_count"] += 1
-                        led["last_access"] = time.monotonic()
-                    return cached[1], cached[2]
-                latch = self._building.get(key)
-                if latch is None:
-                    self._building[key] = threading.Event()
-                    break
+                        self._bump_heat(led)
+                        nbytes = led["bytes"]
+                    hit = (cached[1], cached[2])
+                else:
+                    latch = self._building.get(key)
+                    if latch is None:
+                        self._building[key] = threading.Event()
+                        break
+            if hit is not None:
+                # Reuse-distance sample OUTSIDE the ledger lock: the
+                # sampler rejects in one hash compare; admitted samples
+                # take the estimator's own lock only.
+                self._record_reuse(key, nbytes)
+                return hit
             # Another thread is packing this entry: wait, then re-check —
             # its fingerprint usually matches ours (same live fragments).
             latch.wait()
@@ -665,6 +689,9 @@ class _StackedBlocks:
                 self._entries[key] = (fingerprint, arr, rows_p, vers)
                 self._ledger_upload(key, arr, tiers)
                 self._evict(keep=key)
+            # Misses are references too: without them the reuse stream
+            # would be hits-only and every distance would look resident.
+            self._record_reuse(key, int(np.prod(arr.shape)) * 4)
             return arr, rows_p
         finally:
             with self._lock:
@@ -700,8 +727,26 @@ class _StackedBlocks:
             upload_epoch=self._upload_epoch,
         )
         led["uploads"] += 1
+        self._bump_heat(led)
+
+    def _bump_heat(self, led: dict) -> None:
+        """Decayed-frequency heat bump (caller holds _lock): decay the
+        stored heat by 2^(-idle/half_life) — computed LAZILY from the
+        last-access stamp, so idle entries cost nothing between
+        touches — then add this access. Bare float arithmetic on the
+        ledger entry; no allocation, no extra lookup (ISSUE 18's
+        near-zero-idle-cost contract for the block-fetch path)."""
+        now = time.monotonic()
+        heat = led.get("heat", 0.0)
+        if heat:
+            heat *= 2.0 ** ((led["last_access"] - now) / self.heat_half_life)
+        led["heat"] = heat + 1.0
         led["access_count"] += 1
-        led["last_access"] = time.monotonic()
+        led["last_access"] = now
+
+    def _record_reuse(self, key: tuple, nbytes: int) -> None:
+        if self.reuse.record(key, nbytes):
+            global_stats.count("reuse_distance_samples_total")
 
     def peek(self, index: str, field_name: str,
              view_name: str = VIEW_STANDARD):
@@ -785,6 +830,53 @@ class _StackedBlocks:
                     ent["row"] = key[4]
                 out.append(ent)
         return out
+
+    def heat_snapshot(self, entries: int = -1) -> dict:
+        """Per-entry decayed-frequency heat (decayed to NOW, hottest
+        first) plus the per-tier heat rollup behind the
+        hbm_access_heat{tier} gauges — an entry's heat splits over
+        tiers by its tier-byte fractions, so the tier series answer
+        'is the hot set dense or container-tiered' (the pager's
+        readmission-format question) rather than double-counting.
+        `entries`: -1 = all, 0 = rollup only (the poll-loop gauge path
+        skips building the per-entry dicts), N > 0 = hottest N."""
+        now = time.monotonic()
+        hl = self.heat_half_life
+        tier_heat = {"dense": 0.0, "array": 0.0, "run": 0.0}
+        ents: list[dict] = []
+        with self._lock:
+            for key in self._entries:
+                led = self._ledger.get(key)
+                if led is None:
+                    continue
+                heat = led.get("heat", 0.0) * 2.0 ** (
+                    (led["last_access"] - now) / hl
+                )
+                b = led["bytes"] or 1
+                for t, tb in led["tier_bytes"].items():
+                    tier_heat[t] += heat * (tb / b)
+                if entries == 0:
+                    continue
+                ent = {
+                    "index": key[0],
+                    "field": key[1],
+                    "view": key[2],
+                    "bytes": led["bytes"],
+                    "heat": round(heat, 4),
+                    "accessCount": led["access_count"],
+                    "idleSeconds": round(now - led["last_access"], 3),
+                }
+                if len(key) > 3 and key[3] == "row":
+                    ent["row"] = key[4]
+                ents.append(ent)
+        ents.sort(key=lambda e: e["heat"], reverse=True)
+        if entries > 0:
+            ents = ents[:entries]
+        return {
+            "halfLifeSeconds": hl,
+            "tierHeat": {t: round(v, 4) for t, v in tier_heat.items()},
+            "entries": ents,
+        }
 
     def clear(self) -> None:
         with self._lock:
@@ -1153,8 +1245,10 @@ def _shape_sig(tree) -> tuple:
 
 def _tree_nbytes(tree) -> int:
     """Total array bytes in a (possibly nested) argument/output tree —
-    EXPLAIN's bytes-shipped/returned figure. Only walked under the
-    explain flag; the counted hot path never calls this."""
+    the bytes-shipped/returned figure for EXPLAIN launch records and
+    the per-profile counters feeding /debug/workload (ISSUE 18). Walked
+    only when a profile is active; the unprofiled hot path (remote-leg
+    internals, background rebuilds) never calls this."""
     if isinstance(tree, (tuple, list)):
         return sum(_tree_nbytes(a) for a in tree)
     return int(getattr(tree, "nbytes", 0) or 0)
@@ -1360,7 +1454,8 @@ class TPUBackend:
     programs then run under shard_map with psum over ICI.
     """
 
-    def __init__(self, holder, device=None, mesh=None, max_bytes: Optional[int] = None):
+    def __init__(self, holder, device=None, mesh=None, max_bytes: Optional[int] = None,
+                 heat_half_life: Optional[float] = None):
         self.holder = holder
         self.cpu = CPUBackend(holder)
         self.mesh = mesh if (mesh is not None and mesh.n > 1) else None
@@ -1371,7 +1466,8 @@ class TPUBackend:
         self._fallback_logged: set = set()
         self.logger = None
         self.blocks = _StackedBlocks(
-            device, self.mesh, max_bytes, fallback=self._count_device_fallback
+            device, self.mesh, max_bytes, fallback=self._count_device_fallback,
+            heat_half_life=heat_half_life,
         )
         self._fns: dict = {}
         self._fns_lock = threading.RLock()
@@ -1820,19 +1916,32 @@ class TPUBackend:
             )
             sig = ledger.record_launch(kind, key, args, wall, compiled, t0)
             prof = current_profile()
-            ex = getattr(prof, "explain", None)
-            if ex is not None:
-                ex.add_launch({
-                    "kind": kind,
-                    "program": sig[0] if key is None else repr(key)[:120],
-                    "shapes": repr(sig[2])[:200],
-                    "occupancy": _sig_occupancy(sig[2]),
-                    "compiled": compiled,
-                    "dispatchMs": round(wall * 1e3, 3),
-                    "bytesShipped": _tree_nbytes(args),
-                    "bytesReturned": _tree_nbytes(out),
-                    "devices": mesh_n,
-                })
+            if prof is not NOP_PROFILE:
+                # ISSUE 18 satellite fix: stamp the cheap scalar totals
+                # into EVERY profiled request's counters — before this,
+                # per-launch device-wait only existed inside explain
+                # plans, so /debug/queries ring entries dropped it for
+                # normal traffic and the workload table would have
+                # needed ?explain=1 traffic to accumulate.
+                shipped = _tree_nbytes(args)
+                returned = _tree_nbytes(out)
+                prof.incr("device_launches")
+                prof.incr("device_wait_us", int(wall * 1e6))
+                prof.incr("bytes_shipped", shipped)
+                prof.incr("bytes_returned", returned)
+                ex = prof.explain
+                if ex is not None:
+                    ex.add_launch({
+                        "kind": kind,
+                        "program": sig[0] if key is None else repr(key)[:120],
+                        "shapes": repr(sig[2])[:200],
+                        "occupancy": _sig_occupancy(sig[2]),
+                        "compiled": compiled,
+                        "dispatchMs": round(wall * 1e3, 3),
+                        "bytesShipped": shipped,
+                        "bytesReturned": returned,
+                        "devices": mesh_n,
+                    })
             return out
 
         return counted

@@ -238,6 +238,39 @@ def canonical_key(c: Call) -> str:
     return canonicalize(c).to_string()
 
 
+def shape_key(c: Call) -> str:
+    """Structure-only shape fingerprint for per-shape cost accounting
+    (ISSUE 18, /debug/workload): call names, arg keys, and FIELD names
+    survive; every literal (row ids, condition bounds, string values)
+    collapses to `?`. `Count(Row(f=3))` and `Count(Row(f=99))` are one
+    shape; `Count(Row(g=3))` is another; `Difference(a,b)` never folds
+    with `Difference(b,a)` (children keep order — shape is structure,
+    and Difference's structure is ordered).
+
+    Cardinality contract (the pilint metric-tags rationale for the
+    `shape` tag key): the key population is bounded by the parser's call
+    vocabulary x operator-created field names x arg-key spellings —
+    request CONTENT (the unbounded part) never survives into the key."""
+    parts = [shape_key(ch) for ch in c.children]
+    for k in sorted(c.args):
+        v = c.args[k]
+        if isinstance(v, Call):
+            parts.append(f"{k}={shape_key(v)}")
+        elif isinstance(v, Condition):
+            # The operator is structure (a < scan and a == probe are
+            # different device programs); the bound is a literal.
+            parts.append(f"{k}{v.op}?")
+        elif k in ("field", "_field") and isinstance(v, str):
+            # Field names are schema-bounded structure, not content.
+            parts.append(f"{k}={v}")
+        else:
+            # Non-reserved keys ARE field names (field=rowID spelling):
+            # keep the key, strip the literal. Reserved args keep the
+            # key too — which options a call uses is structural.
+            parts.append(f"{k}=?")
+    return f"{c.name}({', '.join(parts)})"
+
+
 def _fmt_val(v: Any) -> str:
     if v is None:
         return "null"

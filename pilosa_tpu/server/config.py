@@ -165,6 +165,11 @@ class Config:
     # (SURVEY §7 hard part c). 0 = unbounded; over-budget fields serve
     # via row paging instead of whole-stack residency.
     max_hbm_bytes: int = 0
+    # Half-life (seconds) of the HBM block-heat EWMA (ISSUE 18): how
+    # fast an idle block's decayed-access-frequency heat halves. Short
+    # half-lives track phase changes quickly but forget the working set
+    # over a lull; the 5-minute default matches the SLO fast window.
+    heat_half_life: float = 300.0
     # Shard the HBM block stacks over this many devices with a
     # jax.sharding.Mesh (parallel/mesh.py): programs run under
     # shard_map with psum/all_gather merges over ICI, replacing
@@ -271,6 +276,7 @@ class Config:
             "max-import-bytes": self.max_import_bytes,
             "max-pending-wal": self.max_pending_wal,
             "max-hbm-bytes": self.max_hbm_bytes,
+            "heat-half-life": self.heat_half_life,
             "mesh-devices": self.mesh_devices,
             "max-result-cache-bytes": self.max_result_cache_bytes,
             "max-staleness": self.max_staleness,
@@ -325,6 +331,7 @@ class Config:
             "max-import-bytes": "max_import_bytes",
             "max-pending-wal": "max_pending_wal",
             "max-hbm-bytes": "max_hbm_bytes",
+            "heat-half-life": "heat_half_life",
             "mesh-devices": "mesh_devices",
             "max-result-cache-bytes": "max_result_cache_bytes",
             "max-staleness": "max_staleness",
@@ -386,6 +393,7 @@ class Config:
             pre + "MAX_IMPORT_BYTES": ("max_import_bytes", int),
             pre + "MAX_PENDING_WAL": ("max_pending_wal", int),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
+            pre + "HEAT_HALF_LIFE": ("heat_half_life", float),
             pre + "MESH_DEVICES": ("mesh_devices", int),
             pre + "MAX_RESULT_CACHE_BYTES": ("max_result_cache_bytes", int),
             pre + "MAX_STALENESS": ("max_staleness", int),
@@ -441,6 +449,7 @@ class Config:
             f"max-import-bytes = {c.max_import_bytes}\n"
             f"max-pending-wal = {c.max_pending_wal}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
+            f"heat-half-life = {c.heat_half_life}\n"
             f"mesh-devices = {c.mesh_devices}\n"
             f"max-result-cache-bytes = {c.max_result_cache_bytes}\n"
             f"max-staleness = {c.max_staleness}\n"

@@ -1607,23 +1607,89 @@ class _Handler(BaseHTTPRequestHandler):
         """The device HBM ledger: per-entry resident bytes split by
         representation tier (dense / array-container / run-container
         source), upload epoch, access counts — sorted coldest first,
-        i.e. the LRU eviction-candidate order."""
+        i.e. the LRU eviction-candidate order. ?top=N truncates to the
+        N coldest (0 = all, the default — back-compat with pre-r18
+        consumers that expect the full ledger)."""
         backend = getattr(self.api.executor, "backend", None)
         blocks = getattr(backend, "blocks", None)
         if blocks is None or not hasattr(blocks, "ledger"):
             self._reply(
                 {"residentBytes": 0, "tierBytes": {}, "evictions": 0,
-                 "entries": []}
+                 "totalEntries": 0, "entries": []}
             )
             return
+        top = self._int_query("top", 0)
+        entries = blocks.ledger()
+        total = len(entries)
+        if top > 0:
+            entries = entries[:top]
         self._reply(
             {
                 "residentBytes": blocks.resident_bytes(),
                 "tierBytes": blocks.tier_bytes(),
                 "evictions": blocks.evictions,
-                "entries": blocks.ledger(),
+                "totalEntries": total,
+                "entries": entries,
             }
         )
+
+    @route("GET", r"/debug/heat")
+    def handle_debug_heat(self):
+        """Block heat + miss-ratio curve (ISSUE 18): per-entry decayed-
+        frequency heat (hottest first, ?top=N, default 50), the per-tier
+        heat rollup behind hbm_access_heat{tier}, and the SHARDS reuse-
+        distance estimator's predicted hit-rate-vs-HBM-budget curve —
+        'would a bigger (or smaller) HBM budget change my hit rate', as
+        a curve instead of a guess."""
+        backend = getattr(self.api.executor, "backend", None)
+        blocks = getattr(backend, "blocks", None)
+        if blocks is None or not hasattr(blocks, "heat_snapshot"):
+            self._reply(
+                {"halfLifeSeconds": 0, "tierHeat": {}, "entries": [],
+                 "reuse": None}
+            )
+            return
+        top = self._int_query("top", 50)
+        out = blocks.heat_snapshot(entries=top if top > 0 else -1)
+        out["reuse"] = blocks.reuse.snapshot()
+        self._reply(out)
+
+    @route("GET", r"/debug/timeline")
+    def handle_debug_timeline(self):
+        """Interference flight recorder (ISSUE 18): second-by-second
+        deltas of qps, ingest rates, per-site lock waits, snapshot
+        state, device launches, and HBM residency over the trailing
+        ?seconds=N window (default 60), plus pinned incidents (frozen
+        automatically when an SLO objective starts burning). Each
+        scrape takes a sample first, so a server without the monitor
+        poller still accrues a timeline with use."""
+        from pilosa_tpu.utils.monitor import global_flight_recorder
+
+        raw = self.query.get("seconds", "60")
+        try:
+            seconds = min(600.0, max(1.0, float(raw)))
+        except ValueError:
+            raise APIError(f"invalid seconds: {raw!r}") from None
+        global_flight_recorder.sample()
+        self._reply(
+            {
+                "windowS": seconds,
+                "timeline": global_flight_recorder.timeline(seconds),
+                "incidents": global_flight_recorder.incidents(),
+            }
+        )
+
+    @route("GET", r"/debug/workload")
+    def handle_debug_workload(self):
+        """Per-query-shape cost accounting (ISSUE 18): the top-K table
+        of canonical-PQL shape fingerprints by cumulative device-
+        seconds — which query SHAPES are spending the device, with
+        bytes shipped/returned, lock-wait, and cache hit-rate per
+        shape. ?top=N (default 50)."""
+        from pilosa_tpu.utils.qprofile import global_workload_table
+
+        top = self._int_query("top", 50)
+        self._reply(global_workload_table.snapshot(top))
 
     @route("GET", r"/debug/rescache")
     def handle_debug_rescache(self):

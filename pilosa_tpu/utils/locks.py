@@ -38,6 +38,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from pilosa_tpu.utils.qprofile import current_profile
 from pilosa_tpu.utils.stats import exemplar_trace_id, global_stats
 
 
@@ -140,6 +141,10 @@ class InstrumentedLock:
 
     def _observe_wait(self, wait: float) -> None:
         self._stats.timing("lock_wait_seconds", wait)
+        # Per-query lock-wait attribution (ISSUE 18): the waiting thread
+        # IS the request thread, so its profile charges the stall to the
+        # query shape that suffered it (nop sink when no profile).
+        current_profile().incr("lock_wait_us", int(wait * 1e6))
         global_stall_ledger.record(self.site, wait, exemplar_trace_id())
 
     __enter__ = acquire
@@ -204,6 +209,8 @@ class InstrumentedRLock:
 
     def _observe_wait(self, wait: float) -> None:
         self._stats.timing("lock_wait_seconds", wait)
+        # Same per-query attribution as InstrumentedLock (ISSUE 18).
+        current_profile().incr("lock_wait_us", int(wait * 1e6))
         global_stall_ledger.record(self.site, wait, exemplar_trace_id())
 
     __enter__ = acquire

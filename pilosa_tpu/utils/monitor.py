@@ -17,6 +17,7 @@ from __future__ import annotations
 import gc
 import os
 import platform
+import re
 import threading
 import time
 from collections import deque
@@ -78,6 +79,14 @@ def publish_hbm_gauges(blocks, stats=None) -> None:
     if tiers is not None:
         for tier, nbytes in tiers().items():
             s.with_tags(f"tier:{tier}").gauge("hbm_resident_bytes", nbytes)
+    # Decayed-frequency heat per tier (ISSUE 18): same publisher
+    # discipline as residency — poll loop and /metrics scrape share
+    # this block, so the heat gauges can never disagree with the
+    # residency split about which tiers exist.
+    heat = getattr(blocks, "heat_snapshot", None)
+    if heat is not None:
+        for tier, h in heat(entries=0)["tierHeat"].items():
+            s.with_tags(f"tier:{tier}").gauge("hbm_access_heat", h)
 
 
 def _rss_bytes() -> int:
@@ -94,6 +103,151 @@ def _open_fds() -> int:
         return len(os.listdir("/proc/self/fd"))
     except OSError:
         return 0
+
+
+_SITE_RE = re.compile(r'site="([^"]+)"')
+
+
+class FlightRecorder:
+    """Interference flight recorder (ISSUE 18): a bounded 1 s-grain ring
+    of RAW CUMULATIVE samples — counter totals, timing (sum, count)
+    pairs, gauge point reads — from which /debug/timeline derives rates
+    at serve time. Recording raw totals instead of deltas means a
+    missed tick (busy poll thread, paused process) degrades to a wider
+    span, never to a wrong rate.
+
+    Cost contract: one sample is a handful of dict reads under the
+    stats registry lock (counter_totals/timing_totals point reads — NO
+    histogram_snapshot deep copy) and one ring append; idle cost is the
+    same as loaded cost, ~microseconds. The ring rides the monitor
+    poll thread at 1 Hz; bench's ingest leg and /debug/timeline may
+    also call sample() — min_interval dedups concurrent tickers.
+
+    freeze() pins the trailing window into a bounded incidents deque —
+    called by RuntimeMonitor.evaluate_slos on a burn-rate False→True
+    transition, so the timeline AROUND the moment an objective started
+    burning survives ring eviction for the post-mortem."""
+
+    COUNTER_FAMILIES = (
+        "import_bits_total",
+        "import_values_total",
+        "device_launches_total",
+        "snapshot_stall_seconds_total",
+        "fragment_snapshots_total",
+        "http_requests_shed_total",
+    )
+    TIMING_FAMILIES = ("query_seconds", "lock_wait_seconds")
+    GAUGES = ("hbm_resident_bytes", "snapshot_pending", "wal_pending_ops")
+
+    def __init__(self, capacity: int = 600, min_interval: float = 0.5):
+        self.min_interval = min_interval
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._incidents: deque = deque(maxlen=4)
+
+    def sample(self, stats=None) -> bool:
+        """Append one raw sample; returns False when min_interval
+        dedups it. The pre-read gate keeps N concurrent tickers from
+        N-plicating registry reads; the post-read re-check keeps the
+        ring monotonic in time."""
+        now = time.monotonic()
+        with self._lock:
+            if self._ring and now - self._ring[-1]["t"] < self.min_interval:
+                return False
+        s = stats or global_stats
+        rec = {
+            "t": now,
+            "counters": s.counter_totals(*self.COUNTER_FAMILIES),
+            "timings": s.timing_totals(*self.TIMING_FAMILIES),
+            "gauges": {g: s.gauge_value(g) for g in self.GAUGES},
+        }
+        with self._lock:
+            if self._ring and now - self._ring[-1]["t"] < self.min_interval:
+                return False
+            self._ring.append(rec)
+        return True
+
+    def timeline(self, seconds: float = 60.0) -> list[dict]:
+        """Adjacent-sample deltas over the trailing window, oldest
+        first — the serve-time derivative of the raw ring."""
+        now = time.monotonic()
+        with self._lock:
+            recs = [r for r in self._ring if now - r["t"] <= seconds + 1.0]
+        return self._deltas(recs, now)
+
+    @staticmethod
+    def _deltas(recs: list[dict], now: float) -> list[dict]:
+        out = []
+        for prev, cur in zip(recs, recs[1:]):
+            span = max(1e-9, cur["t"] - prev["t"])
+
+            def cdelta(prefix, _p=prev, _c=cur):
+                return sum(
+                    max(0.0, v - _p["counters"].get(k, 0.0))
+                    for k, v in _c["counters"].items()
+                    if k.startswith(prefix)
+                )
+
+            q_n = q_s = 0.0
+            lock_wait: dict[str, float] = {}
+            for name, (tsum, tcount) in cur["timings"].items():
+                psum, pcount = prev["timings"].get(name, (0.0, 0.0))
+                if name.startswith("query_seconds"):
+                    q_n += max(0.0, tcount - pcount)
+                    q_s += max(0.0, tsum - psum)
+                elif name.startswith("lock_wait_seconds"):
+                    d = max(0.0, tsum - psum)
+                    if d > 0.0:
+                        m = _SITE_RE.search(name)
+                        site = m.group(1) if m else "?"
+                        lock_wait[site] = round(
+                            lock_wait.get(site, 0.0) + d, 6
+                        )
+            g = cur["gauges"]
+            out.append({
+                "ageS": round(now - cur["t"], 1),
+                "spanS": round(span, 2),
+                "qps": round(q_n / span, 2),
+                "queryS": round(q_s, 4),
+                "ingestBitsPerS": round(cdelta("import_bits_total") / span, 1),
+                "ingestValsPerS": round(cdelta("import_values_total") / span, 1),
+                "deviceLaunches": int(cdelta("device_launches_total")),
+                "snapshotStallS": round(cdelta("snapshot_stall_seconds_total"), 4),
+                "snapshots": int(cdelta("fragment_snapshots_total")),
+                "shedRequests": int(cdelta("http_requests_shed_total")),
+                "lockWaitS": lock_wait,
+                "hbmResidentBytes": int(g.get("hbm_resident_bytes", 0.0)),
+                "snapshotPending": int(g.get("snapshot_pending", 0.0)),
+                "walPendingOps": int(g.get("wal_pending_ops", 0.0)),
+            })
+        return out
+
+    def freeze(self, reason: str, seconds: float = 120.0) -> dict:
+        """Pin the trailing window as a named incident (bounded deque:
+        the four most recent survive). Takes one fresh sample first so
+        the incident includes the instant of the trigger."""
+        self.sample()
+        incident = {
+            "reason": reason,
+            # Epoch stamp: operators correlate incidents with logs.
+            "at": time.time(),  # lint: allow-monotonic-time(operator-facing epoch display stamp, same contract as StallLedger)
+            "timeline": self.timeline(seconds),
+        }
+        with self._lock:
+            self._incidents.append(incident)
+        return incident
+
+    def incidents(self) -> list[dict]:
+        with self._lock:
+            return list(self._incidents)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._incidents.clear()
+
+
+global_flight_recorder = FlightRecorder()
 
 
 class RuntimeMonitor:
@@ -114,6 +268,11 @@ class RuntimeMonitor:
         # an objective can name are retained (cardinality bound).
         self._hist_snaps: deque = deque()
         self._snap_lock = threading.Lock()
+        # Objectives currently burning (keyed by metric spec) — the
+        # edge detector behind flight-recorder auto-freeze: an incident
+        # is pinned on the False→True transition only, never re-pinned
+        # every evaluation while the burn persists.
+        self._burning: set[str] = set()
         self._seen_indexes: set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -258,6 +417,19 @@ class RuntimeMonitor:
                 (ent["burnRate_fast"] or 0) > 1.0
                 and (ent["burnRate_slow"] or 0) > 1.0
             )
+            # Auto-freeze the flight recorder the moment an objective
+            # STARTS burning (ISSUE 18): the interference timeline
+            # around the transition is exactly the evidence the
+            # post-mortem needs, and it would age out of the ring long
+            # before a human looks.
+            with self._snap_lock:
+                was_burning = metric in self._burning
+                if ent["burning"]:
+                    self._burning.add(metric)
+                else:
+                    self._burning.discard(metric)
+            if ent["burning"] and not was_burning:
+                global_flight_recorder.freeze(f"slo-burn:{metric}")
             # Trace exemplars from over-threshold buckets, newest first:
             # the direct link from "this objective is burning" to
             # /debug/traces/<id> of a query that burned it. Exemplars
@@ -294,7 +466,15 @@ class RuntimeMonitor:
 
     def poll_once(self) -> None:
         s = global_stats
-        self.record_histogram_snapshot()
+        if self.slo:
+            # Evaluating (rather than just snapshotting) is what arms
+            # the burn-transition freeze on servers nobody is scraping:
+            # the recorder must capture the incident even when no
+            # /debug/slo request ever asks. evaluate_slos retains the
+            # histogram snapshot itself.
+            self.evaluate_slos()
+        else:
+            self.record_histogram_snapshot()
         s.gauge("runtime_rss_bytes", _rss_bytes())
         s.gauge("runtime_threads", threading.active_count())
         s.gauge("runtime_open_fds", _open_fds())
@@ -332,9 +512,18 @@ class RuntimeMonitor:
         return self
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+        # Tick at 1 s (bounded by the configured interval) so the
+        # flight-recorder ring gets its 1-second grain; the heavier
+        # gauge poll still runs only every `interval` seconds.
+        tick = min(1.0, self.interval)
+        next_poll = time.monotonic()
+        while not self._stop.wait(tick):
             try:
-                self.poll_once()
+                global_flight_recorder.sample()
+                now = time.monotonic()
+                if now >= next_poll:
+                    next_poll = now + self.interval
+                    self.poll_once()
             # lint: allow-except-exception(poll-loop crash barrier: a gauge bug must never kill the monitor thread)
             except Exception:  # noqa: BLE001 — gauges must never kill the loop
                 pass

@@ -147,7 +147,7 @@ class QueryProfile:
     __slots__ = (
         "qid", "index", "query", "call", "started_at", "_t0",
         "phases", "counters", "error", "duration", "remote",
-        "explain", "shards",
+        "explain", "shards", "shape",
     )
 
     def __init__(self, index: str = "", query: str = "", call: str = ""):
@@ -176,6 +176,10 @@ class QueryProfile:
         # route without explain.
         self.explain: Optional[ExplainPlan] = None
         self.shards: Optional[int] = None
+        # ISSUE 18: canonical-PQL shape fingerprint (pql/ast.shape_key —
+        # structure + field names, literals stripped), stamped by the
+        # executor after parse; the workload table's aggregation key.
+        self.shape: Optional[str] = None
 
     def phase(self, name: str) -> _PhaseTimer:
         return _PhaseTimer(self, name)
@@ -272,6 +276,7 @@ class NopProfile:
     call = ""
     explain = None
     shards = None
+    shape = None
 
     def phase(self, name: str):
         return self._PHASE
@@ -324,6 +329,109 @@ class QueryRing:
 
 
 global_query_ring = QueryRing()
+
+
+class WorkloadTable:
+    """Per-query-shape cost accounting (ISSUE 18 tentpole 3): a bounded
+    top-K table keyed by canonical-PQL shape fingerprint, fed from every
+    completed profile's counters — device-wait, launches, bytes shipped/
+    returned, lock-wait — so GET /debug/workload answers 'which query
+    SHAPES consume the device' with cumulative device-seconds per shape.
+    This is the accounting substrate the ROADMAP item-5 per-tenant
+    quotas will charge against.
+
+    Shapes are structure-only (literals stripped, pql/ast.shape_key), so
+    the key population is bounded by call vocabulary x schema fields —
+    pilint-cardinality-safe by construction. The table itself is ALSO
+    bounded: past `capacity` distinct shapes, the entry with the
+    smallest cumulative device-seconds is evicted (the table exists to
+    rank device consumers; the cheapest consumer is the safest loss)."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._shapes: dict[str, dict] = {}
+        self.evicted = 0
+
+    def observe(self, p: QueryProfile, stats=None) -> None:
+        shape = getattr(p, "shape", None)
+        if not shape or p.duration is None:
+            return
+        c = p.counters
+        with self._lock:
+            ent = self._shapes.get(shape)
+            if ent is None:
+                if len(self._shapes) >= self.capacity:
+                    victim = min(
+                        self._shapes,
+                        key=lambda k: self._shapes[k]["deviceSeconds"],
+                    )
+                    del self._shapes[victim]
+                    self.evicted += 1
+                ent = self._shapes[shape] = {
+                    "queries": 0, "errors": 0, "seconds": 0.0,
+                    "deviceSeconds": 0.0, "launches": 0,
+                    "bytesShipped": 0, "bytesReturned": 0,
+                    "lockWaitSeconds": 0.0, "cacheHits": 0,
+                    "cacheLookups": 0, "maxMs": 0.0,
+                    # One example spelling (already ring-truncated) so
+                    # an operator can read the shape back as PQL.
+                    "example": p.query,
+                }
+                if stats is not None:
+                    # Distinct-shape counter (bench LEG_COUNTER_FAMILIES
+                    # rides counter families, and the table is a gauge-
+                    # shaped thing otherwise).
+                    stats.count("workload_shapes_total")
+            ent["queries"] += 1
+            if p.error is not None:
+                ent["errors"] += 1
+            ent["seconds"] += p.duration
+            ent["deviceSeconds"] += c.get("device_wait_us", 0) / 1e6
+            ent["launches"] += c.get("device_launches", 0)
+            ent["bytesShipped"] += c.get("bytes_shipped", 0)
+            ent["bytesReturned"] += c.get("bytes_returned", 0)
+            ent["lockWaitSeconds"] += c.get("lock_wait_us", 0) / 1e6
+            ent["cacheHits"] += c.get("cache_hits", 0)
+            ent["cacheLookups"] += c.get("cache_lookups", 0)
+            ms = p.duration * 1e3
+            if ms > ent["maxMs"]:
+                ent["maxMs"] = ms
+            # Epoch stamp by contract: operators correlate lastSeen with
+            # logs, same display contract as startedAt above.
+            ent["lastSeen"] = time.time()  # lint: allow-monotonic-time(lastSeen is an operator-facing epoch display stamp)
+
+    def top(self, n: int = 50) -> list[dict]:
+        """Entries by cumulative device-seconds, heaviest first (whole-
+        query seconds break ties: host-only shapes still rank)."""
+        with self._lock:
+            items = [
+                dict(ent, shape=shape) for shape, ent in self._shapes.items()
+            ]
+        items.sort(
+            key=lambda e: (e["deviceSeconds"], e["seconds"]), reverse=True
+        )
+        out = []
+        for ent in items[: n if n > 0 else len(items)]:
+            ent["seconds"] = round(ent["seconds"], 6)
+            ent["deviceSeconds"] = round(ent["deviceSeconds"], 6)
+            ent["lockWaitSeconds"] = round(ent["lockWaitSeconds"], 6)
+            ent["maxMs"] = round(ent["maxMs"], 3)
+            out.append(ent)
+        return out
+
+    def snapshot(self, n: int = 50) -> dict:
+        with self._lock:
+            shapes, evicted = len(self._shapes), self.evicted
+        return {"shapes": shapes, "evicted": evicted, "entries": self.top(n)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._shapes.clear()
+            self.evicted = 0
+
+
+global_workload_table = WorkloadTable()
 
 
 class profile_scope:
@@ -390,3 +498,9 @@ class profile_scope:
             global_stats.with_tags(f"call:{call}", "phase:other").timing(
                 "query_phase_seconds", un
             )
+        # Per-shape cost accounting (ISSUE 18). Remote peer legs DO
+        # feed the table — unlike query_seconds, /debug/workload is a
+        # strictly per-node attribution surface (never cluster-merged),
+        # and a data node serving only coordinator-dispatched legs
+        # would otherwise report an empty table while its device burns.
+        global_workload_table.observe(p, global_stats)

@@ -292,6 +292,39 @@ class StatsClient:
                 out["timings"][name + self._fmt_tags(tags)] = entry
         return out
 
+    def counter_totals(self, *prefixes: str) -> dict[str, float]:
+        """{full series name: current value} for counter families whose
+        name starts with any prefix — a point read for high-frequency
+        samplers (the flight recorder ticks at 1 Hz; a full snapshot()
+        deep-copies and sorts every series on each tick, this copies a
+        handful of floats)."""
+        r = self._root
+        out: dict[str, float] = {}
+        with r._lock:
+            for (name, tags), v in r._counters.items():
+                if name.startswith(prefixes):
+                    out[name + self._fmt_tags(tags)] = v
+        return out
+
+    def timing_totals(self, *prefixes: str) -> dict[str, tuple[float, float]]:
+        """{full series name: (cumulative sum, observation count)} for
+        timing families matching any prefix — the recorder's qps and
+        per-site lock-wait inputs without copying bucket vectors."""
+        r = self._root
+        out: dict[str, tuple[float, float]] = {}
+        with r._lock:
+            for (name, tags), h in r._timings.items():
+                if name.startswith(prefixes):
+                    out[name + self._fmt_tags(tags)] = (h.sum, h.count)
+        return out
+
+    def gauge_value(self, name: str, default: float = 0.0) -> float:
+        """Current value of one gauge series (this client's tag scope),
+        or `default` — the recorder's residency/pending point reads."""
+        r = self._root
+        with r._lock:
+            return r._gauges.get((name, self.tags), default)
+
     def histogram_snapshot(self) -> dict[str, dict]:
         """{series name: {"buckets": per-bucket counts, "sum", "count",
         "exemplars": [{"trace_id","value","time"}...]}} — the raw bucket
@@ -399,6 +432,15 @@ class NopStatsClient:
 
     def histogram_snapshot(self):
         return {}
+
+    def counter_totals(self, *prefixes):
+        return {}
+
+    def timing_totals(self, *prefixes):
+        return {}
+
+    def gauge_value(self, name, default=0.0):
+        return default
 
 
 global_stats = StatsClient()

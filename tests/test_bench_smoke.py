@@ -119,6 +119,13 @@ def test_bench_smoke(tmp_path):
     # EXPLAIN tree of the 3-field sweep as ROADMAP-item-2 seed data.
     assert "ingest_snapshot_stall_seconds" in blob
     assert isinstance(blob["ingest_lock_wait_seconds"], dict)
+    # The ISSUE 18 flight-recorder key: window B ships its second-by-
+    # second interference timeline (a list of delta entries; at the
+    # smoke's 0.5 s window it may legitimately hold < 2 samples, so
+    # only the shape — not a minimum length — is pinned).
+    assert isinstance(blob["ingest_timeline"], list)
+    for ent in blob["ingest_timeline"]:
+        assert "qps" in ent and "lockWaitS" in ent, ent
     assert "calls" in blob["groupby_explain"], blob["groupby_explain"]
     # The ISSUE 17 tiled-GroupBy keys: the forced-sweep figure rides
     # next to the served warm figure, and the cardinality leg proves

@@ -447,7 +447,8 @@ class TestHbmLedger:
         with TestCluster(1) as c:
             out = _get_json(str(c[0].node.uri), "/debug/hbm")
             assert out == {"residentBytes": 0, "tierBytes": {},
-                           "evictions": 0, "entries": []}
+                           "evictions": 0, "entries": [],
+                           "totalEntries": 0}
 
 
 class TestDiagnosticsDevices:

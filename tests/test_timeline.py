@@ -1,0 +1,365 @@
+"""Interference flight recorder + per-shape cost accounting tests
+(ISSUE 18 tentpoles 2-3): delta math over raw cumulative samples, the
+min-interval dedup and idle-cost pins, SLO-burn-triggered incident
+freeze end to end, the workload table's aggregation/eviction, shape_key
+structure collapse, and the satellite fix that non-explain ring entries
+carry per-launch device-wait."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.tpu import TPUBackend
+from pilosa_tpu.pql import parse_string
+from pilosa_tpu.pql.ast import shape_key
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import Server
+from pilosa_tpu.utils.monitor import (
+    FlightRecorder,
+    RuntimeMonitor,
+    global_flight_recorder,
+)
+from pilosa_tpu.utils.qprofile import (
+    QueryProfile,
+    WorkloadTable,
+    global_workload_table,
+    profile_scope,
+)
+from pilosa_tpu.utils.stats import StatsClient, global_stats
+
+
+class TestFlightRecorder:
+    def test_delta_math(self):
+        """Adjacent-sample deltas: counters become per-span rates,
+        query_seconds (sum, count) becomes qps + busy seconds, per-site
+        lock-wait sums split by the site tag."""
+        stats = StatsClient()
+        fr = FlightRecorder(min_interval=0.0)
+        stats.count("import_bits_total", 100)
+        fr.sample(stats)
+        stats.count("import_bits_total", 400)
+        stats.timing("query_seconds", 0.02)
+        stats.timing("query_seconds", 0.04)
+        stats.with_tags("site:wal_append").timing("lock_wait_seconds", 0.5)
+        stats.gauge("wal_pending_ops", 7)
+        # Long enough that the served spanS (rounded to 2 decimals)
+        # reconstructs the deltas within tolerance.
+        time.sleep(0.25)
+        fr.sample(stats)
+        tl = fr.timeline(60)
+        assert len(tl) == 1
+        ent = tl[0]
+        assert ent["spanS"] > 0
+        # 400 new bits over the span.
+        assert ent["ingestBitsPerS"] * ent["spanS"] == pytest.approx(
+            400, rel=0.05
+        )
+        assert ent["qps"] * ent["spanS"] == pytest.approx(2, rel=0.05)
+        assert ent["queryS"] == pytest.approx(0.06, abs=1e-3)
+        assert ent["lockWaitS"] == {"wal_append": 0.5}
+        assert ent["walPendingOps"] == 7
+
+    def test_min_interval_dedups_and_skips_registry_reads(self, monkeypatch):
+        """Two tickers at the same instant produce ONE sample, and the
+        deduped call returns before touching the stats registry — the
+        recorder's idle-cost pin."""
+        stats = StatsClient()
+        fr = FlightRecorder(min_interval=10.0)
+        assert fr.sample(stats) is True
+
+        def boom(*a, **k):
+            raise AssertionError("deduped sample read the registry")
+
+        monkeypatch.setattr(stats, "counter_totals", boom)
+        assert fr.sample(stats) is False  # gated before any read
+        with fr._lock:
+            assert len(fr._ring) == 1
+
+    def test_ring_is_bounded(self):
+        stats = StatsClient()
+        fr = FlightRecorder(capacity=5, min_interval=0.0)
+        for _ in range(20):
+            fr.sample(stats)
+        with fr._lock:
+            assert len(fr._ring) == 5
+
+    def test_freeze_pins_incidents_bounded(self):
+        stats = StatsClient()
+        fr = FlightRecorder(min_interval=0.0)
+        for i in range(6):
+            fr.sample(stats)
+            fr.freeze(f"r{i}")
+        inc = fr.incidents()
+        assert len(inc) == 4  # deque(maxlen=4): newest survive
+        assert [e["reason"] for e in inc] == ["r2", "r3", "r4", "r5"]
+        assert "timeline" in inc[0] and "at" in inc[0]
+
+    def test_raw_samples_survive_missed_ticks(self):
+        """A gap in sampling widens spanS but never corrupts rates —
+        the raw-cumulative-totals design contract."""
+        stats = StatsClient()
+        fr = FlightRecorder(min_interval=0.0)
+        stats.count("import_bits_total", 10)
+        fr.sample(stats)
+        time.sleep(0.05)  # "missed" ticks
+        stats.count("import_bits_total", 90)
+        fr.sample(stats)
+        ent = fr.timeline(60)[0]
+        assert ent["spanS"] >= 0.05
+        assert ent["ingestBitsPerS"] * ent["spanS"] == pytest.approx(
+            90, rel=0.05
+        )
+
+
+class TestSloBurnFreeze:
+    def test_burn_transition_freezes_recorder(self):
+        """End to end: an objective crossing into burning (both burn
+        windows > 1) on evaluate_slos pins exactly one incident; staying
+        burning does not re-pin."""
+        mon = RuntimeMonitor()
+        # A unique tagged series so parallel tests can't pollute the
+        # windowed math for this objective.
+        tagged = global_stats.with_tags("call:TlBurnTest")
+        mon.slo = [{
+            "metric": 'query_seconds{call="TlBurnTest"}',
+            "quantile": 0.5, "threshold_s": 0.01, "window_s": 300,
+        }]
+        # Baseline snapshot, then over-threshold observations: every
+        # windowed delta is 100% violations → both windows burn.
+        mon.record_histogram_snapshot(force=True)
+        for _ in range(20):
+            tagged.timing("query_seconds", 0.2)
+        assert not any(
+            "TlBurnTest" in i["reason"]
+            for i in global_flight_recorder.incidents()
+        )
+        out = mon.evaluate_slos()
+        assert out[0]["burning"] is True
+        mine = [
+            i["reason"] for i in global_flight_recorder.incidents()
+            if "TlBurnTest" in i["reason"]
+        ]
+        assert mine == ['slo-burn:query_seconds{call="TlBurnTest"}']
+        # Second evaluation while still burning: no new incident.
+        tagged.timing("query_seconds", 0.2)
+        out = mon.evaluate_slos()
+        assert out[0]["burning"] is True
+        again = [
+            r for r in (i["reason"]
+                        for i in global_flight_recorder.incidents())
+            if "TlBurnTest" in r
+        ]
+        assert len(again) == 1
+
+
+class TestWorkloadTable:
+    def _profile(self, shape, device_us=1000, duration=0.01, query=""):
+        p = QueryProfile(query=query)
+        p.shape = shape
+        p.incr("device_wait_us", device_us)
+        p.incr("device_launches", 2)
+        p.incr("bytes_shipped", 512)
+        p.incr("bytes_returned", 64)
+        p.incr("lock_wait_us", 100)
+        p.finish()
+        p.duration = duration
+        return p
+
+    def test_aggregates_by_shape(self):
+        wt = WorkloadTable()
+        wt.observe(self._profile("Count(Row(f=?))", query="Count(Row(f=1))"))
+        wt.observe(self._profile("Count(Row(f=?))", device_us=3000))
+        wt.observe(self._profile("Row(g=?)"))
+        snap = wt.snapshot()
+        assert snap["shapes"] == 2
+        top = snap["entries"][0]
+        # Heaviest first by cumulative device-seconds.
+        assert top["shape"] == "Count(Row(f=?))"
+        assert top["queries"] == 2
+        assert top["deviceSeconds"] == pytest.approx(0.004)
+        assert top["launches"] == 4
+        assert top["bytesShipped"] == 1024
+        assert top["lockWaitSeconds"] == pytest.approx(0.0002)
+        assert top["example"] == "Count(Row(f=1))"
+
+    def test_eviction_drops_cheapest_device_consumer(self):
+        wt = WorkloadTable(capacity=3)
+        wt.observe(self._profile("s_cheap", device_us=1))
+        wt.observe(self._profile("s_mid", device_us=1000))
+        wt.observe(self._profile("s_hot", device_us=100000))
+        wt.observe(self._profile("s_new", device_us=500))
+        snap = wt.snapshot()
+        assert snap["shapes"] == 3
+        assert snap["evicted"] == 1
+        shapes = {e["shape"] for e in snap["entries"]}
+        assert "s_cheap" not in shapes  # the safest loss
+        assert {"s_hot", "s_mid", "s_new"} == shapes
+
+    def test_profile_without_shape_is_ignored(self):
+        wt = WorkloadTable()
+        p = QueryProfile()
+        p.finish()
+        wt.observe(p)
+        assert wt.snapshot()["shapes"] == 0
+
+    def test_new_shape_emits_counter(self):
+        wt = WorkloadTable()
+        stats = StatsClient()
+        wt.observe(self._profile("s1"), stats)
+        wt.observe(self._profile("s1"), stats)
+        wt.observe(self._profile("s2"), stats)
+        counters = stats.snapshot()["counters"]
+        assert counters["workload_shapes_total"] == 2  # distinct shapes
+
+
+class TestShapeKey:
+    def test_literals_collapse_structure_survives(self):
+        k1 = shape_key(parse_string("Count(Row(f=3))").calls[0])
+        k2 = shape_key(parse_string("Count(Row(f=99))").calls[0])
+        k3 = shape_key(parse_string("Count(Row(g=3))").calls[0])
+        assert k1 == k2 == "Count(Row(f=?))"
+        assert k3 == "Count(Row(g=?))" != k1
+
+    def test_difference_keeps_child_order(self):
+        a = shape_key(
+            parse_string("Difference(Row(f=1), Row(g=1))").calls[0]
+        )
+        b = shape_key(
+            parse_string("Difference(Row(g=1), Row(f=1))").calls[0]
+        )
+        assert a != b  # A\B is not B\A: shape is ordered structure
+
+    def test_condition_keeps_operator_drops_bound(self):
+        a = shape_key(parse_string("Row(v > 5)").calls[0])
+        b = shape_key(parse_string("Row(v > 99999)").calls[0])
+        c = shape_key(parse_string("Row(v < 5)").calls[0])
+        assert a == b
+        assert a != c  # the operator IS structure
+
+    def test_nested_call_args_recurse(self):
+        k = shape_key(
+            parse_string(
+                'GroupBy(Rows(_field="f"), filter=Row(g=7))'
+            ).calls[0]
+        )
+        assert "Rows(_field=f)" in k
+        assert "filter=Row(g=?)" in k
+        assert "7" not in k
+
+
+@pytest.fixture
+def tpu_server(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    ex = Executor(holder, backend=TPUBackend(holder))
+    srv = Server(API(holder, ex), host="localhost", port=0).open()
+    yield srv
+    srv.close()
+    holder.close()
+
+
+def _post(srv, path, body=b"{}", ctype="application/json"):
+    r = urllib.request.Request(
+        srv.uri + path, data=body, method="POST",
+        headers={"Content-Type": ctype},
+    )
+    return json.loads(urllib.request.urlopen(r).read())
+
+
+def get_json(srv, path):
+    return json.loads(urllib.request.urlopen(srv.uri + path).read())
+
+
+class TestEndpoints:
+    def test_debug_workload_serves_shapes(self, tpu_server):
+        _post(tpu_server, "/index/i")
+        _post(tpu_server, "/index/i/field/f")
+        _post(tpu_server, "/index/i/query", b"Set(10, f=1)", "text/plain")
+        for row in (1, 1, 1):
+            _post(tpu_server, "/index/i/query",
+                  f"Count(Row(f={row}))".encode(), "text/plain")
+        out = get_json(tpu_server, "/debug/workload")
+        ent = next(
+            e for e in out["entries"] if e["shape"] == "Count(Row(f=?))"
+        )
+        assert ent["queries"] >= 3
+        assert ent["deviceSeconds"] > 0  # counted launches attributed
+        assert ent["launches"] > 0
+        # ?top=N is honored.
+        top = get_json(tpu_server, "/debug/workload?top=1")
+        assert len(top["entries"]) <= 1
+
+    def test_debug_timeline_accrues_with_use(self, tpu_server):
+        # Each scrape takes a sample; two spaced scrapes give >= 1 delta
+        # (the recorder is process-global, so other tests' samples may
+        # contribute more — only the floor is pinned).
+        get_json(tpu_server, "/debug/timeline")
+        time.sleep(0.6)  # past min_interval
+        out = get_json(tpu_server, "/debug/timeline?seconds=30")
+        assert out["windowS"] == 30
+        assert isinstance(out["incidents"], list)
+        assert len(out["timeline"]) >= 1
+        ent = out["timeline"][-1]
+        for key in ("qps", "lockWaitS", "hbmResidentBytes", "spanS"):
+            assert key in ent
+        # Garbage seconds is a structured 400.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get_json(tpu_server, "/debug/timeline?seconds=abc")
+        assert ei.value.code == 400
+
+    def test_nonexplain_ring_entries_carry_device_wait(self, tpu_server):
+        """Satellite fix: a plain (non-explain) query's /debug/queries
+        ring entry carries the cheap scalar launch totals — before
+        ISSUE 18 per-launch device-wait existed only inside explain
+        plans."""
+        _post(tpu_server, "/index/i")
+        _post(tpu_server, "/index/i/field/f")
+        _post(tpu_server, "/index/i/query", b"Set(10, f=1)", "text/plain")
+        _post(tpu_server, "/index/i/query", b"Count(Row(f=1))", "text/plain")
+        recent = get_json(tpu_server, "/debug/queries")["recent"]
+        ent = next(
+            e for e in recent
+            if e["query"] == "Count(Row(f=1))" and "explain" not in e
+        )
+        c = ent["counters"]
+        assert c["device_launches"] >= 1
+        assert c["device_wait_us"] > 0
+        assert c["bytes_shipped"] > 0
+        assert c["bytes_returned"] > 0
+
+
+class TestLockWaitAttribution:
+    def test_contended_wait_lands_in_profile(self):
+        """A profiled thread that loses a contended acquire charges the
+        wait to its own profile's lock_wait_us counter."""
+        from pilosa_tpu.utils.locks import InstrumentedLock
+
+        lock = InstrumentedLock("test_tl_site")
+        release = threading.Event()
+        held = threading.Event()
+
+        def holder_thread():
+            with lock:
+                held.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder_thread, daemon=True)
+        t.start()
+        held.wait(5)
+        with profile_scope(index="i", query="q") as p:
+            p.shape = "TestShape()"
+            threading.Timer(0.05, release.set).start()
+            with lock:
+                pass
+            assert p.counters.get("lock_wait_us", 0) > 0
+        t.join(timeout=5)
+        # And the scope export fed the workload table with it.
+        ent = next(
+            e for e in global_workload_table.top(0)
+            if e["shape"] == "TestShape()"
+        )
+        assert ent["lockWaitSeconds"] > 0

@@ -42,7 +42,7 @@ METRIC_SUFFIXES = (
     "_inflight", "_up", "_fds", "_threads", "_nodes", "_fields",
     "_shards", "_evictions", "_rederives", "_state",
     "_occupancy", "_queries", "_ops", "_entries",
-    "_programs", "_live",
+    "_programs", "_live", "_heat",
 )
 
 _CALL_RE = re.compile(
@@ -162,6 +162,9 @@ ALLOWED_TAG_KEYS = {
     "le",      # histogram bucket bound (static BUCKET_BOUNDS)
     "site",    # instrumented-lock site name (utils/locks call sites)
     "program", # device-program ledger kind (program kinds are finite)
+    "shape",   # canonical-PQL shape fingerprint (pql/ast.py shape_key:
+               # structure only — call vocabulary x schema field names;
+               # literals never survive into the key)
 }
 
 #: Variable names that smell like raw request content. A tag VALUE
