@@ -149,6 +149,15 @@ INGEST_WRITERS = int(os.environ.get("BENCH_INGEST_WRITERS", "4"))
 INGEST_READERS = int(os.environ.get("BENCH_INGEST_READERS", "8"))
 INGEST_BATCH = int(os.environ.get("BENCH_INGEST_BATCH", "256"))
 INGEST_SHARDS = int(os.environ.get("BENCH_INGEST_SHARDS", "8"))
+# Plane-isolation knobs the ingest leg runs under (ISSUE r19): the
+# paced-snapshot bandwidth cap + global scheduler concurrency and the
+# windowed device-refresh coalescing window — the production posture
+# the leg's read-qps-ratio acceptance is measured against.
+INGEST_SNAPSHOT_BW = int(
+    os.environ.get("BENCH_INGEST_SNAPSHOT_BW", str(64 << 20))
+)
+INGEST_SNAPSHOT_CONC = int(os.environ.get("BENCH_INGEST_SNAPSHOT_CONC", "2"))
+INGEST_REFRESH_MS = int(os.environ.get("BENCH_INGEST_REFRESH_MS", "50"))
 # Zipf result-cache leg (ISSUE r12): skew exponent, distinct-query pool
 # size, per-window seconds (defaults to BENCH_SECONDS), and the cache
 # byte budget the leg's server runs with.
@@ -540,6 +549,16 @@ LEG_COUNTER_FAMILIES = (
     # steady-state leg should mint ~0 after warmup).
     "reuse_distance_samples_total",
     "workload_shapes_total",
+    # Plane-isolation families (ISSUE r19): paced-snapshot scheduler
+    # accounting (queue time + pacing sleep are writer-side costs the
+    # readers no longer pay), windowed-refresh coalescing vs forced
+    # barriers, and the derating sub-window's shed evidence.
+    "snapshot_sched_",
+    "snapshot_paced_",
+    "snapshot_orphans_swept_total",
+    "stack_windowed_refresh_total",
+    "stack_refresh_forced_total",
+    "import_derated_total",
 )
 
 
@@ -1821,6 +1840,8 @@ def bench_ingest_under_load() -> dict:
     holder = Holder(tmp).open()
     srv = None
     warm = None
+    be = None
+    from pilosa_tpu.core.fragment import SNAPSHOT_SCHEDULER
     try:
         idx = holder.create_index("ingest")
         rng = np.random.default_rng(47)
@@ -1841,6 +1862,13 @@ def bench_ingest_under_load() -> dict:
 
         idx.create_field("v", options_for_int(-10000, 10000))
         be = TPUBackend(holder)
+        # Plane-isolation posture (ISSUE r19): paced + bounded background
+        # snapshots and windowed device-refresh coalescing — the
+        # configuration the read-qps-ratio acceptance is measured under.
+        SNAPSHOT_SCHEDULER.configure(
+            concurrency=INGEST_SNAPSHOT_CONC, bandwidth=INGEST_SNAPSHOT_BW
+        )
+        be.start_refresher(INGEST_REFRESH_MS)
         ex = Executor(holder, backend=be)
         ex.batcher = ShardLegBatcher(be)
         api = API(holder, ex)
@@ -2016,7 +2044,70 @@ def bench_ingest_under_load() -> dict:
                 m = re.search(r'site="([^"]+)"', name)
                 site = m.group(1) if m else name
                 lock_wait[site] = round(lock_wait.get(site, 0.0) + d, 6)
-        rows_per_s = sum(rows_acked) / elapsed if elapsed > 0 else 0.0
+        rows_acked_b = sum(rows_acked)
+        rows_per_s = rows_acked_b / elapsed if elapsed > 0 else 0.0
+
+        # -- window C: derating sub-window (ISSUE r19 tentpole 4) ----------
+        # Writer overdrive against a deliberately impossible read-latency
+        # objective: the monitor's burn ladder must tighten import
+        # admission (429 + scaled Retry-After, import_derated_total)
+        # while the readers hold p99 — overload degrades the writer
+        # gracefully, never the readers silently.
+        from pilosa_tpu.utils.monitor import RuntimeMonitor
+
+        mon = RuntimeMonitor(holder, be)
+        mon.slo = [{
+            "metric": "http_request_duration_seconds",
+            "quantile": 0.5,
+            "threshold_s": 0.0005,
+            "window_s": 60,
+        }]
+        api.max_import_bytes = 0
+        api.monitor = mon
+        api.ingest_derate = True
+        counters_c0 = global_stats.snapshot()["counters"]
+        eval_stop = threading.Event()
+
+        def _evaluator() -> None:
+            # 2 Hz evaluation stands in for the server poll loop (10 s
+            # interval — longer than the whole sub-window): each pass
+            # steps the derate ladder while the objective burns.
+            while True:
+                try:
+                    mon.evaluate_slos()
+                except Exception:
+                    pass
+                if eval_stop.wait(0.5):
+                    return
+
+        stop.clear()
+        writers_c = [
+            threading.Thread(target=writer, args=(k,), daemon=True)
+            for k in range(INGEST_WRITERS)
+        ]
+        ev_thread = threading.Thread(target=_evaluator, daemon=True)
+        ev_thread.start()
+        t0c = time.time()
+        for t in writers_c:
+            t.start()
+        qps_derate, derate_ms = read_window(INGEST_SECONDS)
+        stop.set()
+        for t in writers_c:
+            t.join(timeout=10)
+        elapsed_c = time.time() - t0c
+        eval_stop.set()
+        ev_thread.join(timeout=5)
+        derate_level = mon.derate_level()
+        api.monitor = None
+        if writer_errors:
+            raise writer_errors[0]
+        snap_c = global_stats.snapshot()["counters"]
+        derated = sum(
+            v - counters_c0.get(k, 0.0) for k, v in snap_c.items()
+            if k.startswith("import_derated_total")
+        )
+        rows_c = sum(rows_acked) - rows_acked_b
+
         p99_ro = (ro_ms or {}).get("p99_ms")
         p99_churn = (churn_ms or {}).get("p99_ms")
         return {
@@ -2039,6 +2130,14 @@ def bench_ingest_under_load() -> dict:
             "ingest_timeline": ingest_timeline,
             "ingest_shards": INGEST_SHARDS,
             "ingest_writers": INGEST_WRITERS,
+            "ingest_snapshot_bandwidth": INGEST_SNAPSHOT_BW,
+            "ingest_refresh_window_ms": INGEST_REFRESH_MS,
+            "ingest_derate_sheds": int(derated),
+            "ingest_derate_level": int(derate_level),
+            "ingest_derate_rows_per_s": round(rows_c / elapsed_c, 1)
+            if elapsed_c > 0 else 0.0,
+            "ingest_derate_read_qps": round(qps_derate, 1),
+            "ingest_derate_read_p99_ms": (derate_ms or {}).get("p99_ms"),
         }
     finally:
         # Server first: tearing the holder/dir out from under in-flight
@@ -2048,6 +2147,9 @@ def bench_ingest_under_load() -> dict:
             warm.close()
         if srv is not None:
             srv.close()
+        if be is not None:
+            be.stop_refresher()
+        SNAPSHOT_SCHEDULER.configure(concurrency=2, bandwidth=0)
         holder.close()
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -139,6 +139,22 @@ def cmd_server(args) -> int:
     # existed since the seed but nothing consumed them — config-drift).
     api.max_writes_per_request = cfg.max_writes_per_request
     api.metric_service = cfg.metric_service
+    # Read/write plane isolation (ISSUE r19): paced + globally bounded
+    # background snapshots, windowed device-refresh coalescing, and
+    # SLO-adaptive import derating.
+    from pilosa_tpu.core.fragment import SNAPSHOT_SCHEDULER
+
+    SNAPSHOT_SCHEDULER.configure(
+        concurrency=cfg.snapshot_concurrency,
+        bandwidth=cfg.snapshot_bandwidth,
+    )
+    api.ingest_derate = cfg.ingest_derate
+    if backend is not None and cfg.refresh_window_ms > 0:
+        backend.start_refresher(cfg.refresh_window_ms)
+        log.printf(
+            "windowed device refresh: %d ms coalescing window",
+            cfg.refresh_window_ms,
+        )
 
     # TLS (reference server/tlsconfig.go): certificate+key serve HTTPS;
     # peers are dialed with a CA-verified (or skip-verify) context. A

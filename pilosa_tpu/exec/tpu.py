@@ -154,6 +154,13 @@ class _Unsupported(Exception):
     """Raised by the spec builder when a call can't be device-lowered."""
 
 
+#: Marks the calling thread as the background windowed-refresh flusher
+#: (ISSUE r19 tentpole 2): refresh_stale() sets it around its get()
+#: calls so the freshness counters can tell a coalesced window flush
+#: from a mid-window read forcing the splice barrier.
+_REFRESHER = threading.local()
+
+
 class _StackedBlocks:
     """Device cache: (index, field, view) -> uint32[S, R, W] + freshness.
 
@@ -229,6 +236,22 @@ class _StackedBlocks:
         # not pack+upload it twice (duplicate HBM residency could blow the
         # byte budget); losers wait for the winner's entry.
         self._building: dict[tuple, threading.Event] = {}
+        # Windowed device-refresh coalescing (ISSUE r19 tentpole 2):
+        # when > 0, TPUBackend's refresher thread calls refresh_stale()
+        # every window so dirty shards accumulated across the window
+        # flush as ONE incremental splice round per stack — instead of
+        # every read paying the splice inline after every write. Journal
+        # generation stamps stay per-write (rescache addressability and
+        # read-your-writes unchanged); only the device-tensor
+        # consequence is batched. Reads landing mid-window still
+        # revalidate inline — the flush-on-demand barrier — so answers
+        # stay byte-identical to unwindowed execution.
+        self.refresh_window_ms = 0
+        # key -> (field_obj, shards, view_name, min_rows): the build
+        # arguments the flusher replays through get(). Whole stacks
+        # only (row pages are demand-paged by design). GIL-atomic dict
+        # writes; pruned against _entries under _lock in refresh_stale.
+        self._refresh_args: dict[tuple, tuple] = {}
 
     def _pad_shards(self, n: int) -> int:
         if self.mesh is None or self.mesh.n <= 1:
@@ -264,7 +287,19 @@ class _StackedBlocks:
         # the cached stack rather than accumulating per-subset copies in HBM.
         key = (index, field_obj.name, view_name)
 
+        with self._lock:
+            self._refresh_args[key] = (field_obj, shards, view_name, min_rows)
+
         def build(stale):
+            if stale is not None and self.refresh_window_ms > 0:
+                # Freshness attribution under windowing: a stale entry
+                # refreshed by the background flusher is a coalesced
+                # window flush; one refreshed by a serving read is the
+                # mid-window flush-on-demand barrier firing.
+                if getattr(_REFRESHER, "active", False):
+                    global_stats.count("stack_windowed_refresh_total")
+                else:
+                    global_stats.count("stack_refresh_forced_total")
             frags = {s: (v.fragment(s) if v is not None else None) for s in shards}
             vers = tuple(
                 (fr.uid, fr.version) if fr is not None else None
@@ -642,6 +677,42 @@ class _StackedBlocks:
             ent = self._entries.get((index, field_obj.name, view_name))
             vers = ent[3] if ent is not None and ent[1] is block else None
         return block, rows_p, vers
+
+    def refresh_stale(self) -> int:
+        """One windowed flush round (ISSUE r19 tentpole 2): re-run the
+        build for every resident stack whose view generation moved since
+        upload, through the same get() path — i.e. the PR 12 incremental
+        splice — so the dirty shards a window accumulated flush as one
+        per-device splice round and reads landing after the window find
+        a fresh stack instead of paying the splice inline. Keeping the
+        per-window dirty set small is also what keeps the splice on its
+        incremental path (stack_full_rebuilds_total stays flat under
+        sustained churn). Returns the number of stacks refreshed."""
+        with self._lock:
+            for k in list(self._refresh_args):
+                if k not in self._entries:
+                    del self._refresh_args[k]
+            work = list(self._refresh_args.items())
+        n = 0
+        for key, (field_obj, shards, view_name, min_rows) in work:
+            try:
+                v = field_obj.view(view_name)
+            except Exception:  # lint: allow-except-exception(field deleted mid-walk: the entry prunes on the next round; nothing to count)
+                continue
+            gen = v.generation if v is not None else -1
+            with self._lock:
+                ent = self._entries.get(key)
+                if ent is None or ent[0] == (tuple(shards), gen, min_rows):
+                    continue  # evicted, or already fresh
+            _REFRESHER.active = True
+            try:
+                self.get(key[0], field_obj, shards, view_name, min_rows)
+                n += 1
+            except Exception:  # lint: allow-except-exception(flusher crash barrier: a failed background refresh must never kill the loop; the read path's inline barrier still guarantees freshness)
+                pass
+            finally:
+                _REFRESHER.active = False
+        return n
 
     def _cached_build(self, key: tuple, fingerprint: tuple, build):
         """Shared hit/latch/build/evict protocol for stack and row-page
@@ -1520,6 +1591,42 @@ class TPUBackend:
         else:
             for dev in self.mesh.devices:
                 warm_chunk_programs(dev)
+        # Windowed refresher (ISSUE r19 tentpole 2): started by the
+        # server when refresh-window-ms > 0.
+        self._refresher: Optional[threading.Thread] = None
+        self._refresher_stop: Optional[threading.Event] = None
+
+    def start_refresher(self, window_ms: float) -> None:
+        """Start the windowed device-refresh flusher: every window it
+        splices the shards dirtied since the last round into each
+        resident stack (blocks.refresh_stale), coalescing a window's
+        churn into one incremental round per stack. Idempotent; a
+        window of 0 keeps windowing off (inline-only refresh)."""
+        if window_ms <= 0 or self._refresher is not None:
+            return
+        self.blocks.refresh_window_ms = window_ms
+        stop = threading.Event()
+        self._refresher_stop = stop
+
+        def _loop():
+            while not stop.wait(window_ms / 1000.0):
+                try:
+                    self.blocks.refresh_stale()
+                except Exception:  # lint: allow-except-exception(refresher thread crash barrier: one bad round must not end windowing for the process; reads stay correct inline)
+                    pass
+
+        self._refresher = threading.Thread(
+            target=_loop, name="stack-refresh", daemon=True
+        )
+        self._refresher.start()
+
+    def stop_refresher(self) -> None:
+        if self._refresher is not None:
+            self._refresher_stop.set()
+            self._refresher.join(timeout=5)
+            self._refresher = None
+            self._refresher_stop = None
+            self.blocks.refresh_window_ms = 0
 
     def _count_device_fallback(self, reason: str, shape, err) -> None:
         """Count (and log once per shape) a device-fast-path fallback so

@@ -107,6 +107,13 @@ class API:
         self.max_pending_wal = 0
         self._import_lock = threading.Lock()
         self._import_inflight_bytes = 0
+        # SLO-adaptive ingest derating (ISSUE r19 tentpole 4, config
+        # `ingest-derate`): when the attached monitor's derate ladder is
+        # raised (read-latency objective burning), admit 1-in-2^level
+        # imports and shed the rest with 429 + a Retry-After scaled to
+        # the ladder — overload degrades the writer, not the readers.
+        self.ingest_derate = True
+        self._derate_seq = 0
         # Per-/query write-call cap (reference MaxWritesPerRequest,
         # config max-writes-per-request; cli.py wires it). 0 = no cap so
         # directly-constructed test APIs stay unbounded.
@@ -121,11 +128,33 @@ class API:
     def begin_import(self, nbytes: int):
         """Admit one import request of `nbytes` body bytes, or refuse:
         returns None when admitted (caller MUST call end_import(nbytes)
-        in a finally block), else (status, code, reason) for the shed
-        response. Sheds are counted as import_shed_total{reason}."""
+        in a finally block), else (status, code, reason[, retry_after])
+        for the shed response. Sheds are counted as
+        import_shed_total{reason} / import_derated_total{reason}."""
         from pilosa_tpu.core.fragment import WAL_BACKLOG
         from pilosa_tpu.utils.stats import global_stats
 
+        if self.ingest_derate and self.monitor is not None:
+            level = self.monitor.derate_level()
+            if level > 0:
+                with self._import_lock:
+                    self._derate_seq += 1
+                    admit = self._derate_seq % (1 << level) == 0
+                if not admit:
+                    # Deterministic 1-in-2^level counter (not random):
+                    # a well-behaved writer retrying on Retry-After sees
+                    # steady fractional admission, and the ingest-leg
+                    # bench is reproducible. Retry-After scales with the
+                    # ladder so backoff deepens as the burn persists.
+                    global_stats.with_tags("reason:read-slo").count(
+                        "import_derated_total"
+                    )
+                    return (
+                        429,
+                        "import-derated",
+                        "read-slo",
+                        float(1 << (level - 1)),
+                    )
         if self.max_pending_wal > 0 and WAL_BACKLOG.ops > self.max_pending_wal:
             # The WAL/snapshot plane is behind: admitting more writes
             # only deepens the un-snapshotted backlog (and the recovery

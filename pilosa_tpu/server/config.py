@@ -148,6 +148,28 @@ class Config:
     # Retry-After + code=wal-backlog until the background snapshot
     # plane catches up. 0 = no cap.
     max_pending_wal: int = 0
+    # -- read/write plane isolation (ISSUE r19) ----------------------------
+    # Token-bucket cap in bytes/s on the background snapshot rewrite's
+    # unlocked serialize+write middle (core/fragment.py): paces the
+    # rewrite's disk pressure so a churn burst cannot saturate the I/O
+    # the read plane shares. 0 = uncapped.
+    snapshot_bandwidth: int = 0
+    # Concurrent background snapshot rewrites across ALL fragments (the
+    # global snapshot scheduler's worker-pool size). Before r19 each
+    # fragment past MAX_OP_N spawned its own thread — a 64-fragment
+    # churn burst meant 64 concurrent O(storage) rewrites.
+    snapshot_concurrency: int = 2
+    # Windowed device-refresh coalescing (exec/tpu.py): dirty shards
+    # accumulate for this many milliseconds and flush as ONE incremental
+    # splice round per stack, instead of every read paying the splice
+    # inline after every write. Reads landing mid-window still force the
+    # splice (freshness is never traded away). 0 = off (inline-only).
+    refresh_window_ms: int = 0
+    # SLO-adaptive ingest derating (server/api.py + utils/monitor.py):
+    # when a read-latency SLO objective is burning, import admission
+    # sheds a growing fraction of requests with 429 + scaled Retry-After
+    # (import_derated_total{reason=read-slo}), relaxing on recovery.
+    ingest_derate: bool = True
     # -- result cache (ISSUE r12) ------------------------------------------
     # Byte budget for the epoch-tagged result cache (exec/rescache.py):
     # terminal answers (Count/Row/TopN/Sum/Min/Max/GroupBy) served from
@@ -275,6 +297,10 @@ class Config:
             "max-inflight": self.max_inflight,
             "max-import-bytes": self.max_import_bytes,
             "max-pending-wal": self.max_pending_wal,
+            "snapshot-bandwidth": self.snapshot_bandwidth,
+            "snapshot-concurrency": self.snapshot_concurrency,
+            "refresh-window-ms": self.refresh_window_ms,
+            "ingest-derate": self.ingest_derate,
             "max-hbm-bytes": self.max_hbm_bytes,
             "heat-half-life": self.heat_half_life,
             "mesh-devices": self.mesh_devices,
@@ -330,6 +356,10 @@ class Config:
             "max-inflight": "max_inflight",
             "max-import-bytes": "max_import_bytes",
             "max-pending-wal": "max_pending_wal",
+            "snapshot-bandwidth": "snapshot_bandwidth",
+            "snapshot-concurrency": "snapshot_concurrency",
+            "refresh-window-ms": "refresh_window_ms",
+            "ingest-derate": "ingest_derate",
             "max-hbm-bytes": "max_hbm_bytes",
             "heat-half-life": "heat_half_life",
             "mesh-devices": "mesh_devices",
@@ -392,6 +422,13 @@ class Config:
             pre + "MAX_INFLIGHT": ("max_inflight", int),
             pre + "MAX_IMPORT_BYTES": ("max_import_bytes", int),
             pre + "MAX_PENDING_WAL": ("max_pending_wal", int),
+            pre + "SNAPSHOT_BANDWIDTH": ("snapshot_bandwidth", int),
+            pre + "SNAPSHOT_CONCURRENCY": ("snapshot_concurrency", int),
+            pre + "REFRESH_WINDOW_MS": ("refresh_window_ms", int),
+            pre + "INGEST_DERATE": (
+                "ingest_derate",
+                lambda v: v.lower() in ("1", "true"),
+            ),
             pre + "MAX_HBM_BYTES": ("max_hbm_bytes", int),
             pre + "HEAT_HALF_LIFE": ("heat_half_life", float),
             pre + "MESH_DEVICES": ("mesh_devices", int),
@@ -448,6 +485,10 @@ class Config:
             f"max-inflight = {c.max_inflight}\n"
             f"max-import-bytes = {c.max_import_bytes}\n"
             f"max-pending-wal = {c.max_pending_wal}\n"
+            f"snapshot-bandwidth = {c.snapshot_bandwidth}\n"
+            f"snapshot-concurrency = {c.snapshot_concurrency}\n"
+            f"refresh-window-ms = {c.refresh_window_ms}\n"
+            f"ingest-derate = {str(c.ingest_derate).lower()}\n"
             f"max-hbm-bytes = {c.max_hbm_bytes}\n"
             f"heat-half-life = {c.heat_half_life}\n"
             f"mesh-devices = {c.mesh_devices}\n"

@@ -674,7 +674,8 @@ class _Handler(BaseHTTPRequestHandler):
         504: "deadline-exceeded",
     }
 
-    def _error(self, msg: str, status: int = 400, code: str = "") -> None:
+    def _error(self, msg: str, status: int = 400, code: str = "",
+               retry_after: Optional[float] = None) -> None:
         body = {
             "error": msg,
             "code": code or self._CODE_BY_STATUS.get(status, f"http-{status}"),
@@ -682,7 +683,13 @@ class _Handler(BaseHTTPRequestHandler):
         # 429/503/504 are retryable-by-contract: tell the client when
         # (ISSUE r9 satellite). 1 s is the breaker/hedge recovery scale;
         # a shed 429 clears as soon as an in-flight query finishes.
-        headers = {"Retry-After": "1"} if status in (429, 503, 504) else None
+        # Callers with a better estimate (the ingest-derate ladder
+        # scales backoff with burn persistence, ISSUE r19) override it.
+        headers = (
+            {"Retry-After": str(int(max(1, retry_after or 1)))}
+            if status in (429, 503, 504)
+            else None
+        )
         self._reply(body, status=status, headers=headers)
 
     def _dispatch(self, method: str) -> None:
@@ -1058,7 +1065,11 @@ class _Handler(BaseHTTPRequestHandler):
         unread body is drained to keep the keep-alive connection
         framed; a large one would be the very buffering the cap exists
         to refuse, so the connection closes after the error instead."""
-        status, code, reason = refuse
+        status, code, reason = refuse[:3]
+        # Optional 4th element: a caller-scaled Retry-After (the
+        # ingest-derate ladder deepens backoff while the read SLO
+        # burns, ISSUE r19); absent, _error's fixed 1 s applies.
+        retry_after = refuse[3] if len(refuse) > 3 else None
         if getattr(self, "_chunked_body", None) is None:
             if nbytes <= self.SHED_DRAIN_MAX:
                 self._body()
@@ -1068,6 +1079,7 @@ class _Handler(BaseHTTPRequestHandler):
             f"import shed ({reason}): write-side admission cap reached",
             status=status,
             code=code,
+            retry_after=retry_after,
         )
 
     @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")

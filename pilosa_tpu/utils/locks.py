@@ -10,8 +10,9 @@ histograms and a bounded worst-recent-waits ledger behind
 GET /debug/stalls.
 
 Site names are a bounded vocabulary (one per instrumented lock object):
-fragment, wal_append, snapshot_mutex, batcher_drain, rescache,
-hbm_ledger. `lock_wait_seconds` picks up trace exemplars for free via
+fragment, wal_append, wal_drain, snapshot_mutex, batcher_drain,
+rescache, hbm_ledger. `lock_wait_seconds` picks up trace exemplars for
+free via
 the stats client's exemplar provider, so a worst-wait entry resolves to
 the exact request that convoyed (/debug/traces/<id>).
 

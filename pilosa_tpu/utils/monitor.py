@@ -45,6 +45,13 @@ PROCESS_STARTED_AT = time.monotonic()
 SLO_FAST_WINDOW = 300.0
 SLO_SLOW_WINDOW = 3600.0
 
+#: Ingest-derate ladder ceiling (ISSUE r19 tentpole 4). Each level
+#: halves import admission in api.begin_import, so level 4 admits
+#: 1-in-16 — enough to shed a writer overdrive without ever fully
+#: closing the door (a wedged-open ladder still trickles imports, and
+#: the decay path below unwinds it one evaluation at a time).
+DERATE_MAX_LEVEL = 4
+
 #: Windowed-snapshot housekeeping: at most one retained snapshot per
 #: _SNAP_MIN_INTERVAL (the poll loop runs every 10 s; finer grain buys
 #: nothing a 5 m window can see). Retention covers the LARGEST window
@@ -273,6 +280,13 @@ class RuntimeMonitor:
         # is pinned on the False→True transition only, never re-pinned
         # every evaluation while the burn persists.
         self._burning: set[str] = set()
+        # Ingest-derate ladder (ISSUE r19): 0 = admit everything; each
+        # level halves import admission (api.begin_import consults
+        # derate_level() per request). Ramped +1 per evaluation while
+        # ANY configured objective burns, decayed -1 per clean
+        # evaluation — the multi-window burn rule already provides the
+        # hysteresis, so the ladder never flaps on sub-minute blips.
+        self._derate_level = 0
         self._seen_indexes: set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -456,6 +470,22 @@ class RuntimeMonitor:
             exemplars.sort(key=lambda e: e["ageS"])
             ent["exemplars"] = exemplars[:5]
             out.append(ent)
+        # SLO-adaptive ingest derating (ISSUE r19 tentpole 4): step the
+        # ladder once per evaluation of the monitor's OWN objectives —
+        # an ad-hoc evaluate_slos(objectives=[...]) probe must never
+        # move production admission. The configured objectives are the
+        # read-plane contract (query/http latency), so any of them
+        # burning means readers are paying for the writer.
+        if objectives is None and self.slo:
+            with self._snap_lock:
+                if any(e["burning"] for e in out):
+                    self._derate_level = min(
+                        DERATE_MAX_LEVEL, self._derate_level + 1
+                    )
+                elif self._derate_level:
+                    self._derate_level -= 1
+                level = self._derate_level
+            global_stats.gauge("ingest_derate_state", level)
         # Retain the snapshot AFTER evaluating: on a poller-less server
         # the very first scrape then falls back to cumulative-since-boot
         # (windowCoveredS = uptime, honestly reported) instead of
@@ -463,6 +493,13 @@ class RuntimeMonitor:
         # "0 observations" over hours of history.
         self.record_histogram_snapshot(now_snap)
         return out
+
+    def derate_level(self) -> int:
+        """Current ingest-derate ladder position (0 = no derating).
+        Read per import request by api.begin_import; a plain int read
+        under the snap lock so admission never observes a torn ramp."""
+        with self._snap_lock:
+            return self._derate_level
 
     def poll_once(self) -> None:
         s = global_stats

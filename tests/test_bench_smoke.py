@@ -126,6 +126,15 @@ def test_bench_smoke(tmp_path):
     assert isinstance(blob["ingest_timeline"], list)
     for ent in blob["ingest_timeline"]:
         assert "qps" in ent and "lockWaitS" in ent, ent
+    # The ISSUE r19 plane-isolation keys: the leg ran under the paced-
+    # snapshot + windowed-refresh posture, and the derating sub-window
+    # raised the burn ladder and shed writers while readers held.
+    assert blob["ingest_snapshot_bandwidth"] > 0
+    assert blob["ingest_refresh_window_ms"] > 0
+    assert "ingest_derate_sheds" in blob
+    assert "ingest_derate_read_p99_ms" in blob
+    assert blob["ingest_derate_level"] >= 1
+    assert blob["ingest_derate_rows_per_s"] >= 0
     assert "calls" in blob["groupby_explain"], blob["groupby_explain"]
     # The ISSUE 17 tiled-GroupBy keys: the forced-sweep figure rides
     # next to the served warm figure, and the cardinality leg proves

@@ -72,3 +72,49 @@ class TestRoundTrip:
         assert cfg2.max_hbm_bytes == 789
         assert cfg2.long_query_time == 1.5
         assert cfg2.to_dict() == cfg.to_dict()
+
+
+class TestPlaneIsolationKnobs:
+    """ISSUE r19 knobs (snapshot-bandwidth / snapshot-concurrency /
+    refresh-window-ms / ingest-derate): every source and sink agrees —
+    the config-drift contract, pinned per-knob here."""
+
+    def test_defaults(self):
+        cfg = Config.from_sources(env={})
+        assert cfg.snapshot_bandwidth == 0       # uncapped
+        assert cfg.snapshot_concurrency == 2
+        assert cfg.refresh_window_ms == 0        # windowing off
+        assert cfg.ingest_derate is True
+
+    def test_env(self):
+        cfg = Config.from_sources(env={
+            "PILOSA_TPU_SNAPSHOT_BANDWIDTH": "1048576",
+            "PILOSA_TPU_SNAPSHOT_CONCURRENCY": "4",
+            "PILOSA_TPU_REFRESH_WINDOW_MS": "50",
+            "PILOSA_TPU_INGEST_DERATE": "false",
+        })
+        assert cfg.snapshot_bandwidth == 1 << 20
+        assert cfg.snapshot_concurrency == 4
+        assert cfg.refresh_window_ms == 50
+        assert cfg.ingest_derate is False
+        d = cfg.to_dict()
+        assert d["snapshot-bandwidth"] == 1 << 20
+        assert d["snapshot-concurrency"] == 4
+        assert d["refresh-window-ms"] == 50
+        assert d["ingest-derate"] is False
+
+    @needs_tomllib
+    def test_toml_text_round_trip(self, tmp_path):
+        cfg = Config.from_sources(env={})
+        cfg.snapshot_bandwidth = 8 << 20
+        cfg.snapshot_concurrency = 3
+        cfg.refresh_window_ms = 25
+        cfg.ingest_derate = False
+        p = tmp_path / "gen.toml"
+        p.write_text(cfg.toml_text())
+        cfg2 = Config.from_sources(toml_path=str(p), env={})
+        assert cfg2.snapshot_bandwidth == 8 << 20
+        assert cfg2.snapshot_concurrency == 3
+        assert cfg2.refresh_window_ms == 25
+        assert cfg2.ingest_derate is False
+        assert cfg2.to_dict() == cfg.to_dict()

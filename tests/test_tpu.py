@@ -2,6 +2,8 @@
 the CPU oracle, and mesh execution on the 8-device virtual CPU platform
 (the multi-node-without-a-cluster strategy, SURVEY.md §4.3)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -1539,3 +1541,120 @@ class TestMinMaxChurnAbsorption:
             else:
                 idx.field("v").set_value(col, int(w.integers(-1000, 1001)))
             self._check(holder, be, shards)
+
+
+class TestWindowedRefresh:
+    """Windowed device-refresh coalescing (ISSUE r19 tentpole 2):
+    answers under churn stay byte-identical to unwindowed execution, a
+    read landing mid-window forces the flush barrier, a window flush
+    goes through the incremental splice (full rebuilds flat), and the
+    background flusher actually refreshes stale stacks."""
+
+    def _setup(self, holder, rng):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        for row in [1, 2, 3]:
+            cols = np.unique(
+                rng.integers(0, 2 * SHARD_WIDTH, 3000, dtype=np.uint64)
+            )
+            idx.field("f").import_bits(
+                np.full(cols.size, row, dtype=np.uint64), cols
+            )
+        cols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 2000, dtype=np.uint64))
+        idx.field("g").import_bits(np.full(cols.size, 9, dtype=np.uint64), cols)
+        return idx
+
+    @staticmethod
+    def _counter(name):
+        from pilosa_tpu.utils.stats import global_stats
+
+        return global_stats.snapshot()["counters"].get(name, 0.0)
+
+    def test_differential_with_mid_window_barrier(self, holder, rng):
+        """Interleave background window flushes (refresh_stale) with
+        mid-window stack reads across import churn: the windowed
+        backend's device tensor must stay byte-identical to an
+        UNWINDOWED backend's, query answers must match the CPU oracle,
+        the mid-window reads must show up as forced barriers, the
+        flushes as windowed refreshes — and stack_full_rebuilds_total
+        must not move (the splice stays on the incremental path)."""
+        from pilosa_tpu.pql import parse_string
+
+        idx = self._setup(holder, rng)
+        be_w = TPUBackend(holder)   # windowed
+        be_u = TPUBackend(holder)   # unwindowed reference
+        cpu = Executor(holder)
+        queries = [
+            "Intersect(Row(f=1), Row(g=9))",
+            "Union(Row(f=2), Row(g=9))",
+            "Row(f=3)",
+        ]
+        calls = [parse_string(q).calls[0] for q in queries]
+        fobj = idx.field("f")
+        shards = (0, 1)
+        be_w.blocks.get("i", fobj, shards)  # resident
+        rebuilds0 = self._counter("stack_full_rebuilds_total")
+        forced0 = self._counter("stack_refresh_forced_total")
+        windowed0 = self._counter("stack_windowed_refresh_total")
+        # Windowing on, no flusher thread: the window boundary is
+        # driven manually (refresh_stale) so the test is deterministic.
+        be_w.blocks.refresh_window_ms = 60_000
+        forced = windowed = 0
+        for k in range(8):
+            fobj.set_bit(1 + k % 3, 555_000 + 97 * k)
+            if k % 2 == 0:
+                # Mid-window read: the flush-on-demand barrier splices
+                # inline rather than serving stale device bits.
+                forced += 1
+            else:
+                # The window boundary: dirty shards flush as one
+                # incremental round per stale stack.
+                n = be_w.blocks.refresh_stale()
+                assert n >= 1, "write must have staled the stack"
+                windowed += n
+            block_w, _ = be_w.blocks.get("i", fobj, shards)
+            block_u, _ = be_u.blocks.get("i", fobj, shards)
+            np.testing.assert_array_equal(
+                np.asarray(block_w), np.asarray(block_u)
+            )
+            got = be_w.count_batch("i", calls, list(shards))
+            want = [cpu.execute("i", f"Count({q})")[0] for q in queries]
+            assert got == want, (k, got, want)
+        assert self._counter("stack_refresh_forced_total") - forced0 == forced
+        assert (
+            self._counter("stack_windowed_refresh_total") - windowed0
+            == windowed
+        )
+        assert self._counter("stack_full_rebuilds_total") == rebuilds0
+        # A read right after a window flush is a plain hit: no barrier.
+        assert be_w.blocks.refresh_stale() == 0
+        f1 = self._counter("stack_refresh_forced_total")
+        be_w.blocks.get("i", fobj, shards)
+        assert self._counter("stack_refresh_forced_total") == f1
+
+    def test_background_flusher_thread_refreshes(self, holder, rng):
+        """start_refresher: the stack-refresh daemon picks up a write
+        within a few windows with no read in between."""
+        from pilosa_tpu.pql import parse_string
+
+        idx = self._setup(holder, rng)
+        be = TPUBackend(holder)
+        calls = [parse_string("Row(f=1)").calls[0]]
+        shards = [0, 1]
+        first = be.count_batch("i", calls, shards)
+        be.start_refresher(10)
+        try:
+            w0 = self._counter("stack_windowed_refresh_total")
+            idx.field("f").set_bit(1, 777_777)
+            deadline = time.monotonic() + 10
+            while self._counter("stack_windowed_refresh_total") == w0:
+                assert time.monotonic() < deadline, "flusher never refreshed"
+                time.sleep(0.01)
+            # The flushed stack serves the new bit as a plain hit.
+            f0 = self._counter("stack_refresh_forced_total")
+            assert be.count_batch("i", calls, shards) == [first[0] + 1]
+            assert self._counter("stack_refresh_forced_total") == f0
+        finally:
+            be.stop_refresher()
+        assert be.blocks.refresh_window_ms == 0

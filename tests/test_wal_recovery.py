@@ -677,6 +677,126 @@ class TestImportBackpressure:
 
 
 # ---------------------------------------------------------------------------
+# SLO-adaptive ingest derating (ISSUE r19 tentpole 4)
+# ---------------------------------------------------------------------------
+
+
+class _StubMonitor:
+    """A monitor pinned at one derate level: the admission gate's unit
+    tests need the ladder position, not the burn-rate machinery."""
+
+    def __init__(self, level: int = 0):
+        self.level = level
+
+    def derate_level(self) -> int:
+        return self.level
+
+
+class TestIngestDerating:
+    def _schema(self, srv):
+        _req(srv, "POST", "/index/i", {})
+        _req(srv, "POST", "/index/i/field/f", {})
+
+    def test_gate_admits_one_in_two_pow_level(self, server):
+        api = server.api
+        api.monitor = _StubMonitor(2)
+        try:
+            d0 = _counter('import_derated_total{reason="read-slo"}')
+            admitted = 0
+            for _ in range(16):
+                refuse = api.begin_import(8)
+                if refuse is None:
+                    admitted += 1
+                    api.end_import(8)
+                else:
+                    # 4-tuple: the scaled Retry-After rides along.
+                    assert refuse == (429, "import-derated", "read-slo", 2.0)
+            assert admitted == 4  # level 2 admits 1-in-4
+            assert (
+                _counter('import_derated_total{reason="read-slo"}') - d0 == 12
+            )
+        finally:
+            api.monitor = None
+
+    def test_http_shed_scales_retry_after(self, server):
+        self._schema(server)
+        api = server.api
+        api.monitor = _StubMonitor(3)
+        try:
+            shed = None
+            for _ in range(8):  # level 3 admits 1-in-8: a shed must land
+                try:
+                    _req(server, "POST", "/index/i/field/f/import",
+                         {"rowIDs": [1], "columnIDs": [2]})
+                except urllib.error.HTTPError as e:
+                    shed = e
+                    break
+            assert shed is not None and shed.code == 429
+            assert shed.headers.get("Retry-After") == "4"  # 2^(level-1)
+            assert json.loads(shed.read())["code"] == "import-derated"
+        finally:
+            api.monitor = None
+        # Ladder released (SLO recovered): the same import lands.
+        out = _req(server, "POST", "/index/i/field/f/import",
+                   {"rowIDs": [1], "columnIDs": [2]})
+        assert out == {"success": True}
+
+    def test_disabled_knob_bypasses_gate(self, server):
+        api = server.api
+        api.monitor = _StubMonitor(4)
+        api.ingest_derate = False
+        try:
+            assert api.begin_import(8) is None
+            api.end_import(8)
+        finally:
+            api.ingest_derate = True
+            api.monitor = None
+
+    def test_monitor_ladder_ramps_and_decays(self):
+        """The burn ladder steps +1 per burning evaluation (capped) and
+        -1 per clean one — driven through real histogram windows, not a
+        stub: observations far over the threshold burn, then a raised
+        threshold recovers."""
+        from pilosa_tpu.utils.monitor import DERATE_MAX_LEVEL, RuntimeMonitor
+
+        mon = RuntimeMonitor()
+        mon.slo = [{
+            "metric": "derate_probe_seconds",
+            "quantile": 0.5,
+            "threshold_s": 0.0001,
+            "window_s": 60,
+        }]
+        for step in (1, 2, 3, 4, 4):
+            # Fresh over-threshold observations each round: the windows
+            # diff against the retained snapshot, so a silent round
+            # would read as recovered.
+            for _ in range(20):
+                global_stats.timing("derate_probe_seconds", 0.05)
+            mon.evaluate_slos()
+            assert mon.derate_level() == min(step, DERATE_MAX_LEVEL)
+        mon.slo[0]["threshold_s"] = 100.0  # objective satisfied
+        for want in (3, 2, 1, 0, 0):
+            mon.evaluate_slos()
+            assert mon.derate_level() == want
+
+    def test_adhoc_objectives_never_move_the_ladder(self):
+        """evaluate_slos(objectives=[...]) is the /debug/slo what-if
+        probe: it must not step production admission."""
+        from pilosa_tpu.utils.monitor import RuntimeMonitor
+
+        mon = RuntimeMonitor()
+        for _ in range(10):
+            global_stats.timing("derate_probe_seconds", 0.05)
+        mon.evaluate_slos(objectives=[{
+            "metric": "derate_probe_seconds",
+            "quantile": 0.5,
+            "threshold_s": 0.0001,
+            "window_s": 60,
+        }])
+        assert mon.derate_level() == 0
+
+
+# ---------------------------------------------------------------------------
 # Chaos: crash recovery
 # ---------------------------------------------------------------------------
 
@@ -752,6 +872,64 @@ class TestCrashRecoveryInProcess:
         finally:
             h2.close()
             holder.close()
+
+
+@pytest.mark.chaos
+class TestPacedSnapshotCrash:
+    """SIGKILL mid-paced-snapshot (ISSUE r19 satellite): a kill landing
+    inside the token-bucket wait leaves the live file complete (every
+    acked record is in the WAL — phase 2 only ever writes the temp) plus
+    an orphaned `.snapshotting` temp. Restart must recover every
+    acknowledged write via the torn-tail contract and sweep the orphan.
+    Tier-1-safe: the crash is simulated by copying the exact on-disk
+    state while the rewrite is parked mid-pacing."""
+
+    def test_kill_mid_token_bucket_wait_loses_nothing(self, tmp_path):
+        import shutil
+
+        from pilosa_tpu.core.fragment import SNAPSHOT_SCHEDULER
+
+        base = str(tmp_path / "live" / "0")
+        f = _fragment(base).open()
+        rng = np.random.default_rng(23)
+        cols = np.unique(rng.integers(0, SHARD_WIDTH, 4000, dtype=np.uint64))
+        f.bulk_import(np.full(cols.size, 1, dtype=np.uint64), cols)  # acked
+        # 1 KiB/s: the rewrite parks in the token-bucket wait before its
+        # first chunk, with the temp already created — the exact window
+        # the satellite names (mid-token-bucket-wait included).
+        SNAPSHOT_SCHEDULER.configure(bandwidth=1024)
+        crash_dir = str(tmp_path / "crash")
+        os.makedirs(crash_dir)
+        try:
+            f.storage.op_n = MAX_OP_N
+            f.set_bit(1, SHARD_WIDTH - 1)  # acked; crosses the bound
+            tmp_file = base + ".snapshotting"
+            deadline = time.monotonic() + 10
+            while not os.path.exists(tmp_file):
+                assert time.monotonic() < deadline, "rewrite never started"
+                time.sleep(0.005)
+            # -- the SIGKILL: freeze the on-disk state as the kill
+            # would leave it (live WAL + partial temp, no close).
+            shutil.copyfile(base, os.path.join(crash_dir, "0"))
+            shutil.copyfile(
+                tmp_file, os.path.join(crash_dir, "0.snapshotting")
+            )
+        finally:
+            # Uncap: the parked rewrite's next 50 ms slice sees rate 0
+            # and the ORIGINAL fragment finishes cleanly.
+            SNAPSHOT_SCHEDULER.configure(bandwidth=0)
+        f.await_snapshot()
+        f.close()
+        # -- restart on the crash copy -----------------------------------
+        swept0 = _counter("snapshot_orphans_swept_total")
+        f2 = _fragment(os.path.join(crash_dir, "0")).open()
+        try:
+            assert not os.path.exists(os.path.join(crash_dir, "0.snapshotting"))
+            assert _counter("snapshot_orphans_swept_total") - swept0 == 1
+            got = set(f2.row(1).columns().tolist())
+            assert got == set(cols.tolist()) | {SHARD_WIDTH - 1}
+        finally:
+            f2.close()
 
 
 def _free_port() -> int:
