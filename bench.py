@@ -435,6 +435,82 @@ def hist_quantiles_ms(family: str, baseline: Optional[dict] = None,
     return out
 
 
+_STATE_SECONDS_RE = re.compile(
+    r'http_connection_state_seconds\{state="([a-z]+)"\}'
+)
+
+
+class AcceptDepthSampler:
+    """Polls the bench server listener's kernel accept-queue depth
+    (~10 Hz, /proc/net/tcp — the connplane probe) on a daemon thread
+    for one bench window; `.max_depth` is the worst backlog observed.
+    None off Linux / restricted /proc — the block degrades gracefully.
+    Client-side polling only: the server pays nothing for it."""
+
+    def __init__(self, port: int, interval: float = 0.1):
+        from pilosa_tpu.server.connplane import global_conn_plane
+
+        self._plane = global_conn_plane
+        self._port = port
+        self._interval = interval
+        self._stop = threading.Event()
+        self.max_depth: Optional[int] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def __enter__(self) -> "AcceptDepthSampler":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            d = self._plane.accept_queue_depth(self._port)
+            if d is not None:
+                self.max_depth = (
+                    d if self.max_depth is None else max(self.max_depth, d)
+                )
+            self._stop.wait(self._interval)
+
+
+def conn_plane_delta(counters0: dict, hist0: dict,
+                     max_depth: Optional[int]) -> dict:
+    """Per-window connection-plane attribution block (ISSUE 20):
+    queue-wait quantiles from the http_queue_wait_seconds histogram,
+    the worst kernel accept-queue depth the window's sampler saw,
+    per-state seconds at FULL float precision (the reason
+    http_connection_state_seconds stays out of LEG_COUNTER_FAMILIES'
+    round()ed deltas), and the keep-alive reuse rate — the front-door
+    truth next to each window's qps so a queue-wait-shaped plateau
+    names itself in every future BENCH capture."""
+    snap = global_stats.snapshot()["counters"]
+
+    def delta(name: str) -> float:
+        return snap.get(name, 0.0) - counters0.get(name, 0.0)
+
+    state_seconds = {}
+    for k, v in snap.items():
+        m = _STATE_SECONDS_RE.match(k)
+        if m:
+            d = v - counters0.get(k, 0.0)
+            if d > 1e-9:
+                state_seconds[m.group(1)] = round(d, 4)
+    opened = delta("http_connections_opened_total")
+    reuse = delta("http_keepalive_reuse_total")
+    qw = hist_quantiles_ms("http_queue_wait_seconds", hist0)
+    return {
+        "queue_wait_p50_ms": qw["p50_ms"] if qw else None,
+        "queue_wait_p99_ms": qw["p99_ms"] if qw else None,
+        "queue_wait_count": qw["count"] if qw else 0,
+        "max_accept_queue_depth": max_depth,
+        "state_seconds": state_seconds,
+        "keepalive_reuse_rate": round(reuse / max(1.0, reuse + opened), 4),
+        "listen_overflows": round(delta("http_listen_overflows_total")),
+    }
+
+
 def walk_totals() -> dict:
     """Freshness-walk counters by kind, summed over tiers, plus the
     per-tier breakdown of FULL walks — the churn-walk legs' raw data
@@ -559,6 +635,18 @@ LEG_COUNTER_FAMILIES = (
     "stack_windowed_refresh_total",
     "stack_refresh_forced_total",
     "import_derated_total",
+    # Connection-plane families (ISSUE 20): front-door accounting per
+    # leg — opened sockets, keep-alive reuse, and kernel-observed
+    # listen overflows/drops (a nonzero overflow delta IS the silent-
+    # RST backlog saturation the 28k plateau hypothesis predicts).
+    # http_connection_state_seconds stays OUT of this tuple: these
+    # deltas render through round() (integers by contract) and the
+    # state-seconds floats are consumed at full precision by the
+    # sweep/zipf conn_plane blocks instead.
+    "http_connections_opened_total",
+    "http_keepalive_reuse_total",
+    "http_listen_overflows_total",
+    "http_listen_drops_total",
 )
 
 
@@ -1121,6 +1209,7 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
     server_ms_at: dict[str, Optional[dict]] = {}
     phase_ms_at: dict[str, dict] = {}
     payload_bps_at: dict[str, float] = {}
+    conn_plane_at: dict[str, dict] = {}
     try:
         for n in CONCURRENCY:
             hist0 = global_stats.histogram_snapshot()
@@ -1139,8 +1228,9 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
                 )
 
             t0 = time.time()
-            with concurrent.futures.ThreadPoolExecutor(n) as pool:
-                list(pool.map(client, range(n)))
+            with AcceptDepthSampler(srv.port) as depth:
+                with concurrent.futures.ThreadPoolExecutor(n) as pool:
+                    list(pool.map(client, range(n)))
             elapsed = time.time() - t0
             key = str(n)
             qps_at[key] = round(sum(counts) / elapsed, 1)
@@ -1159,6 +1249,13 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
             payload_bps_at[key] = round(
                 (payload_bytes_snapshot() - payload0) / elapsed, 1
             )
+            # Front-door truth per window (ISSUE 20): queue-wait
+            # quantiles, worst kernel accept backlog, per-state
+            # seconds, reuse rate — the attribution the 28k-plateau
+            # hypothesis needs next to each qps figure.
+            conn_plane_at[key] = conn_plane_delta(
+                counters0, hist0, depth.max_depth
+            )
             checkpoint(
                 f"qps@{n}",
                 **{
@@ -1166,6 +1263,7 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
                     f"batch_occupancy_mean_at_{n}": occupancy_at[key],
                     f"phase_ms_at_{n}_clients": phase_ms_at[key],
                     f"payload_bytes_per_s_at_{n}": payload_bps_at[key],
+                    f"conn_plane_at_{n}_clients": conn_plane_at[key],
                 },
             )
     finally:
@@ -1178,6 +1276,7 @@ def bench_concurrency_sweep(holder, be, checkpoint) -> dict:
         "concurrency_server_ms": server_ms_at,
         "concurrency_phase_ms": phase_ms_at,
         "payload_bytes_per_s_at_clients": payload_bps_at,
+        "concurrency_conn_plane": conn_plane_at,
     }
     base = qps_at.get("1")
     if base:
@@ -1270,12 +1369,16 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
     hit_at: dict[str, Optional[float]] = {}
     phase_ms_at: dict[str, dict] = {}
     payload_bps_at: dict[str, float] = {}
+    conn_plane_at: dict[str, dict] = {}
     try:
         for n in CONCURRENCY:
             phase0 = phase_totals_inproc()
             payload0 = payload_bytes_snapshot()
+            hist0 = global_stats.histogram_snapshot()
+            conn0 = global_stats.snapshot()["counters"]
             t_w = time.time()
-            q, r = run_window(n, ZIPF_SECONDS)
+            with AcceptDepthSampler(srv.port) as depth:
+                q, r = run_window(n, ZIPF_SECONDS)
             elapsed_w = max(time.time() - t_w, 1e-9)
             key = str(n)
             qps_at[key] = round(q, 1)
@@ -1287,6 +1390,13 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
             payload_bps_at[key] = round(
                 (payload_bytes_snapshot() - payload0) / elapsed_w, 1
             )
+            # Front-door truth per window (ISSUE 20): a hot cache
+            # window serves mostly from memory, so its queue-wait and
+            # per-state profile is the contrast case for the sweep's
+            # dispatch-bound windows.
+            conn_plane_at[key] = conn_plane_delta(
+                conn0, hist0, depth.max_depth
+            )
             checkpoint(
                 f"zipf@{n}",
                 **{
@@ -1294,6 +1404,7 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
                     f"zipf_hit_rate_at_{n}": hit_at[key],
                     f"zipf_phase_ms_at_{n}_clients": phase_ms_at[key],
                     f"zipf_payload_bytes_per_s_at_{n}": payload_bps_at[key],
+                    f"zipf_conn_plane_at_{n}_clients": conn_plane_at[key],
                 },
             )
         nmax = max(CONCURRENCY)
@@ -1374,6 +1485,7 @@ def bench_zipf_cache(holder, be, checkpoint) -> dict:
         "zipf_hit_rate_at_clients": hit_at,
         "zipf_phase_ms_at_clients": phase_ms_at,
         "zipf_payload_bytes_per_s_at_clients": payload_bps_at,
+        "zipf_conn_plane_at": conn_plane_at,
         "zipf_churn_phase_qps": phase_qps,
         "zipf_hit_rate_phases": phase_hit,
         "zipf_churn_writes": wrote[0],

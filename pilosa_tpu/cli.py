@@ -87,13 +87,13 @@ def cmd_server(args) -> int:
 
         executor.batcher = ShardLegBatcher(backend, window=cfg.batch_window)
         if cfg.preheat:
-            import threading as _threading
+            from pilosa_tpu.utils.threads import spawn
 
             def _preheat():
                 n = backend.preheat(logger=log)
                 log.printf("preheat: %d stacks resident", n)
 
-            _threading.Thread(target=_preheat, daemon=True).start()
+            spawn("preheat", _preheat)
     # Epoch-tagged result cache (exec/rescache.py, ISSUE r12): serve hot
     # terminal answers from memory while their journal-derived epoch
     # vector matches. 0 bytes = disabled (the max-inflight convention);
@@ -355,7 +355,7 @@ def cmd_server(args) -> int:
         local_scheme, cfg.host, cfg.port, data_dir,
     )
     if join_cluster_ref is not None:
-        import threading
+        from pilosa_tpu.utils.threads import spawn
 
         def announce():
             if join_cluster_ref.join_cluster(args.join):
@@ -363,7 +363,7 @@ def cmd_server(args) -> int:
             else:
                 log.printf("join via %s timed out; still standalone", args.join)
 
-        threading.Thread(target=announce, daemon=True).start()
+        spawn("cluster-announce", announce)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -240,7 +240,12 @@ class HTTPBroadcaster:
                     errors.append(f"{node.id}: {e}")
 
         # One RTT total, not N sequential RTTs.
-        threads = [threading.Thread(target=send, args=(n,), daemon=True) for n in peers]
+        from pilosa_tpu.utils.threads import spawn
+
+        threads = [
+            spawn("cluster-broadcast", send, args=(n,), start=False)
+            for n in peers
+        ]
         for t in threads:
             t.start()
         for t in threads:
@@ -249,12 +254,14 @@ class HTTPBroadcaster:
             raise RuntimeError("broadcast failed: " + "; ".join(errors))
 
     def send_async(self, msg: Message) -> None:
+        from pilosa_tpu.utils.threads import spawn
+
         payload = msg.to_bytes()  # marshal once for all peers
         for node in self._peers():
-            t = threading.Thread(
-                target=self._send_quiet, args=(node, msg, payload), daemon=True
+            spawn(
+                "cluster-broadcast",
+                self._send_quiet, args=(node, msg, payload),
             )
-            t.start()
 
     def _send_quiet(self, node, msg: Message, payload: bytes) -> None:
         try:

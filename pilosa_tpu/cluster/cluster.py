@@ -48,6 +48,7 @@ from pilosa_tpu.exec.result import (
     RowIDs,
     ValCount,
 )
+from pilosa_tpu.utils.threads import spawn
 
 
 class ShardUnavailableError(Exception):
@@ -620,13 +621,12 @@ class Cluster:
                     "attempt": attempt,
                     "parent": parent if parent is not None else leg,
                 }
-                t = threading.Thread(
-                    target=self._map_node,
+                spawn(
+                    "cluster-map",
+                    self._map_node,
                     args=(ch, leg, attempt, node, node_shards, index, c,
                           map_fn, reduce_fn, opt, parent_span, deadline),
-                    daemon=True,
                 )
-                t.start()
 
         launch(nodes, list(shards))
 
@@ -950,7 +950,7 @@ class Cluster:
                     span.finish()
 
         threads = [
-            threading.Thread(target=send, args=(i, n), daemon=True)
+            spawn("cluster-broadcast", send, args=(i, n), start=False)
             for i, n in enumerate(peers)
         ]
         for t in threads:
@@ -1206,9 +1206,10 @@ class Cluster:
                 # Follow asynchronously: the instruction fetches fragments
                 # from peers, which must not block the coordinator's
                 # broadcast round-trip.
-                threading.Thread(
-                    target=self.resizer.follow_instruction, args=(msg,), daemon=True
-                ).start()
+                spawn(
+                    "resize-follower",
+                    self.resizer.follow_instruction, args=(msg,),
+                )
         elif typ == bc.MSG_RESIZE_COMPLETE:
             if self.resizer is not None:
                 self.resizer.mark_complete(msg)

@@ -130,10 +130,11 @@ class DivergenceMonitor:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "DivergenceMonitor":
-        self._thread = threading.Thread(
-            target=self._run, name="divergence-monitor", daemon=True
+        from pilosa_tpu.utils.threads import spawn
+
+        self._thread = spawn(
+            "divergence-monitor", self._run, name="divergence-monitor"
         )
-        self._thread.start()
         return self
 
     def stop(self) -> None:

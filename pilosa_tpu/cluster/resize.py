@@ -349,10 +349,9 @@ class Resizer:
             if self._hb_stop is not None:
                 self._hb_stop.set()
             self._hb_stop = stop
-        t = threading.Thread(
-            target=self._heartbeat_loop, args=(job, stop), daemon=True
-        )
-        t.start()
+        from pilosa_tpu.utils.threads import spawn
+
+        spawn("resize-lease", self._heartbeat_loop, args=(job, stop))
 
     def _heartbeat_loop(self, job: int, stop: threading.Event) -> None:
         # 3 heartbeats per lease window: one lost datagram-equivalent
@@ -731,8 +730,10 @@ class Resizer:
                             "resize_migration_sources_done", n_done[0]
                         )
 
+        from pilosa_tpu.utils.threads import spawn
+
         threads = [
-            threading.Thread(target=worker, daemon=True)
+            spawn("resize-worker", worker, start=False)
             for _ in range(min(workers, max(len(sources), 1)))
         ]
         try:

@@ -555,8 +555,9 @@ class SyncDaemon:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "SyncDaemon":
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        from pilosa_tpu.utils.threads import spawn
+
+        self._thread = spawn("sync-daemon", self._run)
         return self
 
     def _run(self) -> None:
@@ -863,8 +864,9 @@ class FailureDetector:
             self.log.printf("node-state broadcast failed: %s", e)
 
     def start(self) -> "FailureDetector":
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        from pilosa_tpu.utils.threads import spawn
+
+        self._thread = spawn("failure-detector", self._run)
         return self
 
     def _run(self) -> None:

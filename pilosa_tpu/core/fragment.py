@@ -198,13 +198,13 @@ class SnapshotScheduler:
             global_stats.gauge(
                 "snapshot_sched_queue_depth", len(self._queue)
             )
-            spawn = self._active < self._concurrency
-            if spawn:
+            start_worker = self._active < self._concurrency
+            if start_worker:
                 self._active += 1
-        if spawn:
-            threading.Thread(
-                target=self._worker, name="snapshot-sched", daemon=True
-            ).start()
+        if start_worker:
+            from pilosa_tpu.utils.threads import spawn
+
+            spawn("snapshot-scheduler", self._worker, name="snapshot-sched")
 
     def cancel(self, frag: "Fragment") -> bool:
         """Remove a still-queued rewrite so close() doesn't have to wait

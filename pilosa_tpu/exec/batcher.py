@@ -83,6 +83,7 @@ from typing import Optional
 from pilosa_tpu.utils.locks import InstrumentedLock
 from pilosa_tpu.utils.qprofile import current_profile
 from pilosa_tpu.utils.stats import global_stats
+from pilosa_tpu.utils.threads import spawn
 
 #: Leg kinds the plane coalesces. count/row/topn legs are built only by
 #: their own submit methods; bsi() takes the kind as an argument and
@@ -235,9 +236,7 @@ class ShardLegBatcher:
                     if not self._pending:
                         self._leader_active = False
                         return
-                threading.Thread(
-                    target=self._drain, args=(False,), daemon=True
-                ).start()
+                spawn("batcher-leader", self._drain, args=(False,))
                 return
 
     # -- batch service ------------------------------------------------------

@@ -1615,10 +1615,9 @@ class TPUBackend:
                 except Exception:  # lint: allow-except-exception(refresher thread crash barrier: one bad round must not end windowing for the process; reads stay correct inline)
                     pass
 
-        self._refresher = threading.Thread(
-            target=_loop, name="stack-refresh", daemon=True
-        )
-        self._refresher.start()
+        from pilosa_tpu.utils.threads import spawn
+
+        self._refresher = spawn("device-refresh", _loop, name="stack-refresh")
 
     def stop_refresher(self) -> None:
         if self._refresher is not None:
@@ -3710,13 +3709,15 @@ class TPUBackend:
                     "group_tile", shapes, t_pred, False, True
                 ) in self._fns
             if not compiled:
-                prewarm = threading.Thread(
-                    target=lambda: self._group_tile_program(
+                from pilosa_tpu.utils.threads import spawn
+
+                spawn(
+                    "groupby-prewarm",
+                    lambda: self._group_tile_program(
                         shapes, t_pred, False, True
                     ),
-                    daemon=True, name="groupn-prewarm",
+                    name="groupn-prewarm",
                 )
-                prewarm.start()
             # Journal-complete freshness (ISSUE r7): a retained entry's
             # recorded per-field versions + the views' journals make the
             # walk O(dirty shards) per field; only cold tuples (or an

@@ -415,15 +415,14 @@ def warm_chunk_programs(device) -> threading.Thread:
             with _progs_lock:
                 _warm_inflight.discard(key)
 
+    from pilosa_tpu.utils.threads import spawn
+
     with _progs_lock:
         if key in _warmed or key in _warm_inflight:
-            t = threading.Thread(target=lambda: None)
-            t.start()  # joinable no-op: callers may t.join() the result
-            return t
+            # joinable no-op: callers may t.join() the result
+            return spawn("sparse-warm", lambda: None)
         _warm_inflight.add(key)
-    t = threading.Thread(target=run, daemon=True, name="sparse-warm")
-    t.start()
-    return t
+    return spawn("sparse-warm", run, name="sparse-warm")
 
 
 class ChunkedStackBuilder:

@@ -18,7 +18,7 @@ from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import __version__
-from pilosa_tpu.utils import fastjson
+from pilosa_tpu.utils import fastjson, threads
 from pilosa_tpu.utils.qprofile import (
     ExplainPlan,
     cache_state,
@@ -26,6 +26,7 @@ from pilosa_tpu.utils.qprofile import (
 )
 from pilosa_tpu.utils.stats import global_stats
 from pilosa_tpu.server.api import API, APIError
+from pilosa_tpu.server.connplane import current_entry, global_conn_plane
 from pilosa_tpu.server.wire import (
     ImportRequest,
     ImportRoaringRequest,
@@ -33,7 +34,9 @@ from pilosa_tpu.server.wire import (
     QueryRequest,
 )
 
-_ROUTES: list[tuple[str, re.Pattern, str]] = []
+#: (method, compiled pattern, handler name, raw pattern) — the raw
+#: pattern string rides along so GET /debug can render the catalogue.
+_ROUTES: list[tuple[str, re.Pattern, str, str]] = []
 
 #: RFC 7230 §3.2.6 token — the only charset a header field-name may use.
 #: Validated with fullmatch so embedded whitespace, bare CR, or any other
@@ -80,6 +83,11 @@ class _HTTPServer(ThreadingHTTPServer):
     request_queue_size = 128
 
     def __init__(self, *args, **kwargs):
+        # Single-slot carry from get_request to process_request: the
+        # listener thread runs one accept to completion (get_request →
+        # verify_request → process_request, all sequential) before the
+        # next, so no fd-keyed map is needed (ISSUE 20).
+        self._pending_entry = None
         # Request-finalization barrier (ISSUE r13 satellite): the reply
         # bytes reach a same-process client one GIL slice BEFORE the
         # handler thread finishes its post-reply work (end_query,
@@ -115,6 +123,63 @@ class _HTTPServer(ThreadingHTTPServer):
                 # lint: allow-lock-discipline(canonical Condition.wait: it RELEASES the condition lock while blocked, handlers never stall on it)
                 self._active_cv.wait(remaining)
         return True
+
+    def get_request(self):
+        """Accept + ledger registration in one breath (ISSUE 20): the
+        timestamp the entry carries out of here is the origin of the
+        http_queue_wait_seconds histogram — the accept-to-handler
+        thread-dispatch delay the C10k front-door rewrite must
+        collapse. Runs on the listener thread."""
+        request, client_address = super().get_request()
+        self._pending_entry = global_conn_plane.register(client_address)
+        return request, client_address
+
+    def process_request(self, request, client_address):
+        """ThreadingMixIn.process_request with two changes (ISSUE 20):
+        the worker starts through utils/threads.spawn — named
+        http-worker-N and role-registered for the profiler,
+        /debug/threads, and stall exemplars — and it runs _conn_worker,
+        which binds the accept-stamped ledger entry to the worker
+        before any request byte is read."""
+        entry = self._pending_entry
+        self._pending_entry = None
+        if self.block_on_close:
+            import socketserver
+
+            vars(self).setdefault("_threads", socketserver._Threads())
+        t = threads.spawn(
+            "http-worker", self._conn_worker,
+            args=(request, client_address, entry),
+            daemon=self.daemon_threads, start=False,
+        )
+        self._threads.append(t)
+        t.start()
+
+    def _conn_worker(self, request, client_address, entry) -> None:
+        """One connection's worker-thread body: bind the ledger entry
+        (observing the queue wait), run the stock socketserver
+        per-connection loop, close the entry on the way out — error
+        paths included, so aborted connections still land in the
+        recently-closed ring."""
+        if entry is not None:
+            global_conn_plane.enter(entry)
+        try:
+            self.process_request_thread(request, client_address)
+        finally:
+            if entry is not None:
+                global_conn_plane.close_entry(entry)
+
+    def server_activate(self):
+        super().server_activate()
+        # The kernel-truth poller matches LISTEN rows in /proc/net/tcp
+        # by local port; registered here, where listen() just happened.
+        global_conn_plane.register_listener(self.server_address[1])
+
+    def server_close(self):
+        try:
+            global_conn_plane.unregister_listener(self.server_address[1])
+        finally:
+            super().server_close()
 
     def handle_error(self, request, client_address):
         """A client that vanishes mid-exchange can surface OUTSIDE the
@@ -254,7 +319,7 @@ def route(method: str, pattern: str):
     compiled = re.compile("^" + pattern + "$")
 
     def deco(fn):
-        _ROUTES.append((method, compiled, fn.__name__))
+        _ROUTES.append((method, compiled, fn.__name__, pattern))
         return fn
 
     return deco
@@ -297,8 +362,9 @@ class Server:
 
     def open(self) -> "Server":
         self._bind()
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
+        self._thread = threads.spawn(
+            "http-listener", self._httpd.serve_forever
+        )
         return self
 
     def close(self) -> None:
@@ -387,6 +453,43 @@ class _Handler(BaseHTTPRequestHandler):
     api: API  # injected per-server subclass
     protocol_version = "HTTP/1.1"
 
+    def handle_one_request(self):
+        """Stdlib handle_one_request with the connection-plane state
+        transitions woven in (ISSUE 20). The keep-alive readline blocks
+        until the client's NEXT request — the transition to `reading`
+        happens only AFTER it returns, so socket idle time stays
+        charged to `queued`/`idle`, never to `reading`. The transition
+        to `idle` at the end of a completed request is the cycle
+        boundary that flushes the entry's aggregate deltas."""
+        conn = current_entry()
+        try:
+            self.raw_requestline = self.rfile.readline(65537)
+            if not self.raw_requestline:
+                self.close_connection = True
+                return
+            conn.transition("reading")
+            conn.add_bytes_in(len(self.raw_requestline))
+            if len(self.raw_requestline) > 65536:
+                self.requestline = ""
+                self.request_version = ""
+                self.command = ""
+                self.send_error(414, "Request-URI Too Long")
+                return
+            if not self.parse_request():
+                return
+            conn.request_started()
+            mname = "do_" + self.command
+            if not hasattr(self, mname):
+                self.send_error(501, f"Unsupported method ({self.command!r})")
+                return
+            getattr(self, mname)()
+            self.wfile.flush()
+            conn.transition("idle")
+        except TimeoutError:
+            # A read/write timed out: discard this connection (stdlib
+            # semantics, minus its log_error — logging is quiet here).
+            self.close_connection = True
+
     def parse_request(self) -> bool:
         """Minimal HTTP/1.x request parsing (mirrors the stdlib's
         semantics for request line, keep-alive, and Expect handling,
@@ -430,8 +533,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.command, self.path = command, path
         headers = _Headers()
         n = 0
+        head_bytes = 0  # accumulated locally: no per-line ledger calls
         while True:
             line = self.rfile.readline(65537)
+            head_bytes += len(line)
             if len(line) > 65536:
                 self.send_error(431, "Header line too long")
                 return False
@@ -474,6 +579,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return False
             headers.add(k, v.strip())
         self.headers = headers
+        # Header block fully read: request-head arrival (`reading`)
+        # ends; validation + eager chunked decode account as `parsing`.
+        conn = current_entry()
+        conn.add_bytes_in(head_bytes)
+        conn.transition("parsing")
         if headers.conflicting_length:
             self.send_error(400, "Conflicting Content-Length headers")
             return False
@@ -528,6 +638,9 @@ class _Handler(BaseHTTPRequestHandler):
             # desync class the old blanket 501 existed to prevent.
             try:
                 self._chunked_body = self._read_chunked_body()
+                # Decoded size, not wire framing bytes: the ledger's
+                # bytes_in answers "how much payload", close enough.
+                conn.add_bytes_in(len(self._chunked_body))
             except _BadChunked as e:
                 # A malformed/oversized stream leaves rfile mid-frame:
                 # the connection cannot be reused.
@@ -602,7 +715,11 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self, "_chunked_body", None) is not None:
             return self._chunked_body  # decoded eagerly in parse_request
         length = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(length) if length else b""
+        if not length:
+            return b""
+        data = self.rfile.read(length)
+        current_entry().add_bytes_in(len(data))
+        return data
 
     def _json_body(self) -> dict:
         return self._json_body_from(self._body())
@@ -655,7 +772,14 @@ class _Handler(BaseHTTPRequestHandler):
                 head += f"{k}: {v}\r\n"
         buf = head.encode("latin-1") + b"Date: " + _http_date() + b"\r\n\r\n"
         global_stats.count("http_response_payload_bytes_total", len(data))
+        # `writing` brackets exactly the response send; back to
+        # `executing` after — post-reply bookkeeping (span finish,
+        # profile-ring insert) is handler work, not socket work.
+        conn = current_entry()
+        conn.transition("writing")
         self.wfile.write(buf + data)
+        conn.add_bytes_out(len(buf) + len(data))
+        conn.transition("executing")
 
     #: Machine-readable fallback `code` per status, so EVERY 4xx/5xx JSON
     #: body out of this layer carries one (ISSUE r9 satellite — the peer
@@ -711,7 +835,7 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         path = parsed.path
         self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-        for m, pattern, fn_name in _ROUTES:
+        for m, pattern, fn_name, _raw in _ROUTES:
             if m != method:
                 continue
             match = pattern.match(path)
@@ -776,26 +900,32 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("GET", r"/")
     def handle_home(self):
+        """Server banner: the pilosa-tpu version."""
         self._reply({"pilosa-tpu": __version__})
 
     @route("GET", r"/version")
     def handle_version(self):
+        """Server version."""
         self._reply({"version": __version__})
 
     @route("GET", r"/info")
     def handle_info(self):
+        """Host info: shard width, CPU count, memory."""
         self._reply(self.api.info())
 
     @route("GET", r"/status")
     def handle_status(self):
+        """Cluster state, node list, local node id."""
         self._reply(self.api.status())
 
     @route("GET", r"/schema")
     def handle_get_schema(self):
+        """The full index/field schema."""
         self._reply(self.api.schema())
 
     @route("POST", r"/schema")
     def handle_post_schema(self):
+        """Apply a schema document (indexes + fields, idempotent)."""
         self.api.apply_schema(self._json_body())
         self._reply({"success": True})
 
@@ -859,6 +989,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("POST", r"/index/(?P<index>[^/]+)/query")
     def handle_post_query(self, index):
+        """Execute PQL against an index (the data-plane read path)."""
         # Admission gate FIRST (ROADMAP item 1 down payment): past the
         # configured in-flight cap the request is shed deliberately —
         # 429 + Retry-After + code=overloaded, counted — instead of
@@ -1084,6 +1215,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
     def handle_post_import(self, index, field):
+        """Bulk bit/value import (JSON or protobuf wire format)."""
         # Write-side admission FIRST (ISSUE r8 tentpole 3, the mirror of
         # handle_post_query's gate), consulted BEFORE the body is read:
         # gating after buffering would let N concurrent over-cap bodies
@@ -1233,6 +1365,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("GET", r"/metrics")
     def handle_metrics(self):
+        """Prometheus exposition of the local stats registry."""
         from pilosa_tpu.utils.stats import global_stats
 
         if getattr(self.api, "metric_service", "memory") == "none":
@@ -1366,6 +1499,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("POST", r"/debug/pprof/start")
     def handle_pprof_start(self):
+        """Start a manual CPU-sampling session (409 if one is live)."""
         if _profiler().start():
             self._reply({"profiling": True})
         else:
@@ -1373,6 +1507,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @route("POST", r"/debug/pprof/stop")
     def handle_pprof_stop(self):
+        """Stop the manual sampling session, return top frames by role."""
         if not _profiler().running:
             self._error("profiler not running", status=409)
             return
@@ -1752,6 +1887,47 @@ class _Handler(BaseHTTPRequestHandler):
                 "sites": global_stall_ledger.sites(),
             }
         )
+
+    # -- connection plane (ISSUE 20) ---------------------------------------
+
+    @route("GET", r"/debug")
+    def handle_debug_index(self):
+        """Route catalogue, auto-generated from the @route registry:
+        every endpoint's method, path, and the first line of its
+        handler docstring — the debug surface stays discoverable
+        without reading source."""
+        endpoints = []
+        for m, _compiled, fn_name, raw in _ROUTES:
+            # `(?P<index>[^/]+)` renders as `<index>` in the catalogue.
+            display = re.sub(r"\(\?P<([^>]+)>[^)]*\)", r"<\1>", raw)
+            display = display.replace("/?", "").replace(r"\d+", "<n>")
+            doc = (getattr(type(self), fn_name).__doc__ or "").strip()
+            first = doc.splitlines()[0].strip() if doc else ""
+            endpoints.append(
+                {"method": m, "path": display, "description": first}
+            )
+        endpoints.sort(key=lambda e: (e["path"], e["method"]))
+        self._reply({"endpoints": endpoints})
+
+    @route("GET", r"/debug/connections")
+    def handle_debug_connections(self):
+        """The connection-plane ledger (server/connplane.py): aggregates
+        first — live count, per-state occupancy, keep-alive reuse
+        distribution, worst queue waits, kernel accept-queue truth —
+        then the newest ?top=N live and recently-closed entries."""
+        top = self._int_query("top", 50)
+        self._reply(global_conn_plane.snapshot(top))
+
+    @route("GET", r"/debug/threads")
+    def handle_debug_threads(self):
+        """Every live thread with its registered role (utils/threads.py)
+        — which plane each thread serves, with name, daemon flag, and
+        age. The text twin of thread_samples_total{role}."""
+        snap = threads.threads_snapshot()
+        roles: dict[str, int] = {}
+        for t in snap:
+            roles[t["role"]] = roles.get(t["role"], 0) + 1
+        self._reply({"count": len(snap), "roles": roles, "threads": snap})
 
     # -- internal routes (reference http/handler.go:307-318) ---------------
 

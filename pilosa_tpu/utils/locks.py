@@ -41,6 +41,7 @@ from typing import Optional
 
 from pilosa_tpu.utils.qprofile import current_profile
 from pilosa_tpu.utils.stats import exemplar_trace_id, global_stats
+from pilosa_tpu.utils.threads import role_of_current
 
 
 class StallLedger:
@@ -63,6 +64,10 @@ class StallLedger:
             "waitMs": round(wait_s * 1e3, 3),
             "traceId": trace_id,
             "thread": threading.current_thread().name,
+            # Which PLANE stalled, not just which thread (ISSUE 20):
+            # exemplars used to read `Thread-42` — now the name is
+            # stable (utils/threads.spawn) and the role places it.
+            "role": role_of_current(),
             # Epoch stamp by contract: operators correlate stall times
             # with logs and traces, not with a monotonic origin.
             "at": time.time(),  # lint: allow-monotonic-time(operator-facing epoch display stamp, same contract as qprofile startedAt)

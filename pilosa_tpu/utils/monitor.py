@@ -516,6 +516,13 @@ class RuntimeMonitor:
         s.gauge("runtime_threads", threading.active_count())
         s.gauge("runtime_open_fds", _open_fds())
         s.gauge("runtime_uptime_seconds", time.monotonic() - self.started_at)
+        # Kernel-side front-door truth on the same cadence (ISSUE 20):
+        # listen-socket accept-queue depth + ListenOverflows/Drops
+        # deltas; a graceful no-op off Linux. Lazy import: the monitor
+        # must stay importable without the server package.
+        from pilosa_tpu.server.connplane import global_conn_plane
+
+        global_conn_plane.poll_kernel(s)
         counts = gc.get_count()
         s.gauge("runtime_gc_gen0_pending", counts[0])
         collected = sum(st.get("collected", 0) for st in gc.get_stats())
@@ -544,8 +551,9 @@ class RuntimeMonitor:
             self._seen_indexes = current
 
     def start(self) -> "RuntimeMonitor":
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        from pilosa_tpu.utils.threads import spawn
+
+        self._thread = spawn("monitor-poll", self._run)
         return self
 
     def _run(self) -> None:

@@ -10,6 +10,12 @@ it captures all threads' stacks and aggregates self + cumulative hit
 counts per frame. Sampling costs nothing between samples, needs no
 instrumentation, and sees every thread, including JAX dispatch waits.
 
+Every sample is also attributed to the owning thread's registered ROLE
+(utils/threads.py, ISSUE 20): the report carries a per-role breakdown
+and stop() flushes the counts to ``thread_samples_total{role}``, so
+"which plane is burning CPU" is answerable from /metrics alone — the
+old report named 20 anonymous ``Thread-N`` stacks nobody could place.
+
 Two operator flows (server/http.py routes):
 - ``GET /debug/pprof/profile?seconds=10&top=30`` — Go-pprof-style: block
   for the window, return the aggregated report.
@@ -25,6 +31,8 @@ import time
 from collections import defaultdict
 from typing import Optional
 
+from pilosa_tpu.utils import threads
+
 
 class SamplingProfiler:
     """Whole-process stack sampler; one instance per server."""
@@ -39,6 +47,8 @@ class SamplingProfiler:
         self._elapsed = 0.0
         # (file, line, func) -> [self_hits, cumulative_hits]
         self._frames: dict[tuple, list[int]] = defaultdict(lambda: [0, 0])
+        # role -> thread-samples (one per sampled thread per sample)
+        self._role_samples: dict[str, int] = defaultdict(int)
 
     @property
     def running(self) -> bool:
@@ -56,14 +66,14 @@ class SamplingProfiler:
             self._stop = threading.Event()
             self._samples = 0
             self._frames = defaultdict(lambda: [0, 0])
+            self._role_samples = defaultdict(int)
             self._t0 = time.perf_counter()
-            self._thread = threading.Thread(
-                target=self._run,
+            self._thread = threads.spawn(
+                "profiler",
+                self._run,
                 args=(self._stop, self._frames),
                 name="pprof-sampler",
-                daemon=True,
             )
-            self._thread.start()
             return True
 
     def stop(self, top: int = 30) -> dict:
@@ -80,6 +90,18 @@ class SamplingProfiler:
                 self._elapsed = time.perf_counter() - self._t0
         if t is not None:
             t.join(timeout=2)
+            # Flush the session's role attribution to the registry ONCE
+            # per session (never per sample — sampling must stay free):
+            # thread_samples_total{role} is the /metrics twin of the
+            # report's `roles` block.
+            from pilosa_tpu.utils.stats import global_stats
+
+            with self._lock:
+                flush = dict(self._role_samples)
+            for role, hits in flush.items():
+                global_stats.with_tags(f"role:{role}").count(
+                    "thread_samples_total", hits
+                )
         return self.report(top)
 
     def profile(self, seconds: float, top: int = 30) -> dict:
@@ -95,6 +117,9 @@ class SamplingProfiler:
         own = threading.get_ident()
         while not stop.wait(self.interval):
             frames = sys._current_frames()
+            # ONE registry lock acquisition per sample (not per thread):
+            # the map is read under the profiler lock below.
+            role_map = threads.roles_snapshot()
             with self._lock:
                 if stop is not self._stop:
                     return  # superseded session: drop the final sample
@@ -102,6 +127,9 @@ class SamplingProfiler:
                 for tid, frame in frames.items():
                     if tid == own:
                         continue
+                    self._role_samples[
+                        role_map.get(tid, "unknown")
+                    ] += 1
                     seen = set()
                     top_frame = True
                     f = frame
@@ -145,9 +173,23 @@ class SamplingProfiler:
                         "cum_samples": cum_h,
                     }
                 )
+            roles = sorted(
+                (
+                    {
+                        "role": role,
+                        "samples": hits,
+                        # Per-sample percentage like the frame table: k
+                        # busy threads of one role read up to k*100%.
+                        "pct": round(100.0 * hits / n, 2) if n else 0.0,
+                    }
+                    for role, hits in self._role_samples.items()
+                ),
+                key=lambda r: -r["samples"],
+            )
             return {
                 "samples": n,
                 "interval_s": self.interval,
                 "duration_s": round(self._elapsed, 3),
+                "roles": roles,
                 "frames": out,
             }

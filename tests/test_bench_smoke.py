@@ -106,6 +106,20 @@ def test_bench_smoke(tmp_path):
     assert set(blob["zipf_phase_ms_at_clients"]) == {"1", "4"}
     assert set(blob["zipf_payload_bytes_per_s_at_clients"]) == {"1", "4"}
     assert blob["payload_bytes_per_s"] > 0
+    # The ISSUE 20 connection-plane blocks: every sweep/zipf window
+    # ships queue-wait quantiles, the kernel accept-queue worst case,
+    # per-state seconds, and the keep-alive reuse rate.
+    assert set(blob["concurrency_conn_plane"]) == {"1", "4"}
+    assert set(blob["zipf_conn_plane_at"]) == {"1", "4"}
+    for win in list(blob["concurrency_conn_plane"].values()) + list(
+        blob["zipf_conn_plane_at"].values()
+    ):
+        for key in ("queue_wait_p50_ms", "queue_wait_p99_ms",
+                    "max_accept_queue_depth", "state_seconds",
+                    "keepalive_reuse_rate"):
+            assert key in win, win
+        assert win["queue_wait_count"] > 0, win
+        assert win["state_seconds"].get("executing", 0) > 0, win
     for win in blob["payload_bytes_per_s_at_clients"].values():
         assert win > 0
     # The r8 ingest-under-load keys the driver's acceptance reads.

@@ -1,0 +1,344 @@
+"""Connection-plane observability tests (ISSUE 20): the lifecycle
+ledger's bounded tables, keep-alive reuse accounting, per-state time
+conservation, queue-wait truth, the /proc kernel probes (fixture-parsed
++ non-Linux no-op), the thread-role registry, and the three debug
+endpoints over the real socket surface."""
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.connplane import (
+    ConnectionPlane,
+    parse_listen_backlogs,
+    parse_listen_drops,
+)
+from pilosa_tpu.server.http import Server
+from pilosa_tpu.utils import threads
+from pilosa_tpu.utils.locks import StallLedger
+from pilosa_tpu.utils.stats import StatsClient, global_stats
+
+
+@pytest.fixture
+def server(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    srv = Server(API(holder, Executor(holder)), host="localhost", port=0).open()
+    yield srv
+    srv.close()
+    holder.close()
+
+
+def get_json(srv, path):
+    with urllib.request.urlopen(srv.uri + path) as resp:
+        return json.loads(resp.read())
+
+
+def hist_count(family):
+    snap = global_stats.histogram_snapshot()
+    return sum(
+        sum(ent["buckets"]) for name, ent in snap.items()
+        if name == family or name.startswith(family + "{")
+    )
+
+
+def counter_total(family):
+    snap = global_stats.snapshot()["counters"]
+    return sum(v for k, v in snap.items() if k.startswith(family))
+
+
+class SmallPlane(ConnectionPlane):
+    LIVE_CAP = 8
+    RING_CAP = 4
+
+
+class TestLedgerBounds:
+    def test_ring_cap_under_churn(self):
+        plane = SmallPlane()
+        for i in range(20):
+            e = plane.register(("10.0.0.1", 40000 + i))
+            plane.close_entry(e)
+        snap = plane.snapshot()
+        assert snap["live"] == 0
+        assert snap["opened"] == 20
+        assert snap["tabled"] == 0
+        # The closed ring kept only the newest RING_CAP entries.
+        assert len(snap["recentClosed"]) == SmallPlane.RING_CAP
+        ids = [e["id"] for e in snap["recentClosed"]]
+        assert ids == sorted(ids, reverse=True)
+        assert max(ids) == 20
+
+    def test_live_cap_overflow_still_counts(self):
+        plane = SmallPlane()
+        entries = [
+            plane.register(("10.0.0.2", 50000 + i)) for i in range(12)
+        ]
+        snap = plane.snapshot()
+        # Past the cap: counted live, not tabled — bounded memory.
+        assert snap["live"] == 12
+        assert snap["tabled"] == SmallPlane.LIVE_CAP
+        assert sum(1 for e in entries if not e.tracked) == 4
+        for e in entries:
+            plane.close_entry(e)
+        snap = plane.snapshot()
+        assert snap["live"] == 0
+        assert snap["tabled"] == 0
+
+    def test_queue_wait_observed_via_enter(self):
+        plane = ConnectionPlane()
+        e = plane.register(("10.0.0.3", 1234))
+        time.sleep(0.03)
+        plane.enter(e)
+        assert e.queue_wait_s is not None and e.queue_wait_s >= 0.03
+        # worstQueueWaits surfaces it, worst-first.
+        plane2_snap = plane.snapshot()
+        worst = plane2_snap["worstQueueWaits"]
+        assert worst and worst[0]["queueWaitMs"] >= 30.0
+        plane.close_entry(e)
+
+
+class TestProcParsing:
+    TCP = (
+        "  sl  local_address rem_address   st tx_queue rx_queue tr "
+        "tm->when retrnsmt   uid  timeout inode\n"
+        # LISTEN (st=0A) on port 0x1F90=8080 with rx backlog 5.
+        "   0: 00000000:1F90 00000000:0000 0A 00000000:00000005 "
+        "00:00000000 00000000  1000 0 111 1 0 100 0 0 10 0\n"
+        # ESTABLISHED (st=01) on the same port: must be ignored.
+        "   1: 00000000:1F90 0100007F:D431 01 00000000:00000063 "
+        "00:00000000 00000000  1000 0 112 1 0 100 0 0 10 0\n"
+        # LISTEN on a port nobody asked about.
+        "   2: 00000000:0016 00000000:0000 0A 00000000:00000002 "
+        "00:00000000 00000000  0 0 113 1 0 100 0 0 10 0\n"
+        "garbage line\n"
+    )
+    NETSTAT = (
+        "TcpExt: SyncookiesSent ListenOverflows ListenDrops\n"
+        "TcpExt: 0 7 9\n"
+        "IpExt: InNoRoutes InTruncatedPkts\n"
+        "IpExt: 0 0\n"
+    )
+
+    def test_parse_listen_backlogs(self):
+        assert parse_listen_backlogs(self.TCP, {8080}) == {8080: 5}
+        assert parse_listen_backlogs(self.TCP, {22}) == {22: 2}
+        assert parse_listen_backlogs(self.TCP, {9999}) == {}
+        assert parse_listen_backlogs("", {8080}) == {}
+
+    def test_parse_listen_drops(self):
+        assert parse_listen_drops(self.NETSTAT) == (7, 9)
+        # Header without the fields, or no TcpExt pair at all: None.
+        assert parse_listen_drops("TcpExt: Foo\nTcpExt: 1\n") is None
+        assert parse_listen_drops("IpExt: A\nIpExt: 0\n") is None
+        assert parse_listen_drops("") is None
+
+    def test_poll_kernel_reads_fixture_proc(self, tmp_path):
+        proc = tmp_path / "net"
+        proc.mkdir()
+        (proc / "tcp").write_text(self.TCP)
+        (proc / "netstat").write_text(self.NETSTAT)
+        plane = ConnectionPlane(proc_net=str(proc))
+        plane.register_listener(8080)
+        stats = StatsClient()
+        out = plane.poll_kernel(stats)
+        assert out == {
+            "acceptQueueDepth": 5,
+            "listenOverflows": 7,
+            "listenDrops": 9,
+        }
+        # First poll establishes the baseline — no delta counted yet.
+        counters = stats.snapshot()["counters"]
+        assert "http_listen_overflows_total" not in counters
+        # Kernel counters move; the second poll counts exactly the delta.
+        (proc / "netstat").write_text(
+            "TcpExt: SyncookiesSent ListenOverflows ListenDrops\n"
+            "TcpExt: 0 10 9\n"
+        )
+        plane.poll_kernel(stats)
+        counters = stats.snapshot()["counters"]
+        assert counters["http_listen_overflows_total"] == 3
+        assert "http_listen_drops_total" not in counters
+        assert stats.snapshot()["gauges"]["http_accept_queue_depth"] == 5
+
+    def test_non_linux_noop(self, tmp_path):
+        plane = ConnectionPlane(proc_net=str(tmp_path / "nope"))
+        plane.register_listener(8080)
+        assert plane.accept_queue_depth() is None
+        out = plane.poll_kernel(StatsClient())
+        assert out == {
+            "acceptQueueDepth": None,
+            "listenOverflows": None,
+            "listenDrops": None,
+        }
+
+    def test_listener_registry_refcounts(self):
+        plane = ConnectionPlane(proc_net="/nonexistent")
+        plane.register_listener(9000)
+        plane.register_listener(9000)
+        plane.unregister_listener(9000)
+        assert plane._listeners == {9000: 1}
+        plane.unregister_listener(9000)
+        assert plane._listeners == {}
+
+
+class TestServerIntegration:
+    def test_keepalive_reuse_counting(self, server):
+        reuse0 = counter_total("http_keepalive_reuse_total")
+        conn = http.client.HTTPConnection(server.host, server.port)
+        try:
+            for _ in range(3):
+                conn.request("GET", "/")
+                conn.getresponse().read()
+            snap = get_json(server, "/debug/connections")
+            mine = [
+                e for e in snap["connections"] if e["requests"] >= 3
+            ]
+            assert mine, snap["connections"]
+            e = mine[0]
+            assert e["reuses"] == e["requests"] - 1
+            assert e["bytesIn"] > 0 and e["bytesOut"] > 0
+            assert e["queueWaitMs"] is not None
+            assert e["state"] == "idle"
+        finally:
+            conn.close()
+        # The flush at each idle transition pushed the reuse deltas.
+        assert counter_total("http_keepalive_reuse_total") >= reuse0 + 2
+
+    def test_state_seconds_conserve_wall_time(self, server):
+        conn = http.client.HTTPConnection(server.host, server.port)
+        conn.request("GET", "/")
+        conn.getresponse().read()
+        time.sleep(0.05)  # measurable keep-alive idle dwell
+        conn.request("GET", "/")
+        conn.getresponse().read()
+        conn.close()
+        # The worker notices the FIN and retires the entry.
+        deadline = time.time() + 5
+        closed = []
+        while time.time() < deadline:
+            snap = get_json(server, "/debug/connections")
+            closed = [
+                e for e in snap["recentClosed"] if e["requests"] == 2
+            ]
+            if closed:
+                break
+            time.sleep(0.02)
+        assert closed, "closed entry never reached the ring"
+        e = closed[0]
+        # Per-state dwell sums to the connection's whole life: the
+        # clock is read only at transitions, and every transition
+        # charges the outgoing state — nothing double-counted, nothing
+        # dropped.
+        total = sum(e["stateSeconds"].values())
+        assert total == pytest.approx(e["ageSeconds"], abs=0.02)
+        assert e["stateSeconds"].get("idle", 0.0) >= 0.05
+        for st in e["stateSeconds"]:
+            assert st in (
+                "accepted", "queued", "reading", "parsing",
+                "executing", "writing", "idle", "closed",
+            )
+
+    def test_queue_wait_histogram_observes(self, server):
+        n0 = hist_count("http_queue_wait_seconds")
+        get_json(server, "/status")
+        assert hist_count("http_queue_wait_seconds") > n0
+
+    def test_debug_connections_top_and_aggregates(self, server):
+        conns = [
+            http.client.HTTPConnection(server.host, server.port)
+            for _ in range(3)
+        ]
+        try:
+            for c in conns:
+                c.request("GET", "/")
+                c.getresponse().read()
+            snap = get_json(server, "/debug/connections?top=1")
+            assert snap["live"] >= 3
+            assert snap["opened"] >= 4
+            # Aggregates cover everything; the detail list honors top.
+            assert sum(snap["stateOccupancy"].values()) == snap["tabled"]
+            assert len(snap["connections"]) == 1
+            assert set(snap["kernel"]) == {
+                "acceptQueueDepth", "listenOverflows", "listenDrops",
+            }
+            assert snap["reuseDistribution"]
+        finally:
+            for c in conns:
+                c.close()
+
+    def test_debug_index_lists_routes(self, server):
+        idx = get_json(server, "/debug")
+        paths = {e["path"]: e for e in idx["endpoints"]}
+        assert "/debug/connections" in paths
+        assert "/debug/threads" in paths
+        assert "/index/<index>/query" in paths
+        for e in idx["endpoints"]:
+            assert e["method"] in ("GET", "POST", "DELETE", "PATCH")
+            assert isinstance(e["description"], str)
+        assert "ledger" in paths["/debug/connections"]["description"].lower()
+
+    def test_debug_threads_roles(self, server):
+        # Drive one request so at least one worker thread is alive.
+        get_json(server, "/status")
+        out = get_json(server, "/debug/threads")
+        assert out["count"] == len(out["threads"])
+        assert out["roles"].get("http-listener", 0) >= 1
+        assert out["roles"].get("http-worker", 0) >= 1
+        for t in out["threads"]:
+            assert set(t) == {
+                "name", "ident", "role", "daemon", "ageSeconds",
+            }
+        named = [
+            t for t in out["threads"] if t["role"] == "http-listener"
+        ]
+        assert all(t["name"].startswith("http-listener") for t in named)
+
+
+class TestThreadRegistry:
+    def test_spawn_registers_and_unregisters(self):
+        seen = {}
+        release = threading.Event()
+
+        def work():
+            seen["role"] = threads.role_of_current()
+            seen["name"] = threading.current_thread().name
+            release.wait(5)
+
+        t = threads.spawn("monitor-poll", work)
+        for _ in range(100):
+            if "role" in seen:
+                break
+            time.sleep(0.01)
+        assert seen["role"] == "monitor-poll"
+        assert seen["name"].startswith("monitor-poll-")
+        assert threads.roles_snapshot()[t.ident] == "monitor-poll"
+        release.set()
+        t.join(5)
+        # Dead threads leave the registry — no accumulation.
+        assert t.ident not in threads.roles_snapshot()
+        assert threads.role_of(t.ident) == "unknown"
+
+    def test_main_thread_role(self):
+        assert threads.role_of_current() == "main"
+        snap = threads.threads_snapshot()
+        mains = [t for t in snap if t["role"] == "main"]
+        assert len(mains) == 1
+
+    def test_spawn_start_false(self):
+        t = threads.spawn("preheat", lambda: None, start=False)
+        assert not t.is_alive()
+        t.start()
+        t.join(5)
+
+    def test_stall_exemplar_carries_role(self):
+        ledger = StallLedger()
+        ledger.record("test.site", 0.012, None)
+        worst = ledger.worst()
+        assert worst[0]["role"] == "main"
+        assert worst[0]["site"] == "test.site"

@@ -360,7 +360,7 @@ class TestWaiverRatchet:
 
         assert main(["--list-waivers"]) == 0
         out = capsys.readouterr().out
-        assert "shared-state 16" in out
+        assert "shared-state 22" in out
         # Per-site lines carry file:line, rule and the reason text.
         assert "pilosa_tpu/utils/tracing.py" in out
         assert "[monotonic-time]" in out

@@ -248,7 +248,10 @@ POOL_HINTS = ("pool", "executor", "workers")
 def thread_targets(graph: CallGraph, call: ast.Call,
                    fn: FuncInfo) -> list[str]:
     """Resolved func ids a Call hands to another thread, or []:
-    `threading.Thread(target=...)` under any alias, and
+    `threading.Thread(target=...)` under any alias, the
+    `spawn(role, target, ...)` named-thread helper (utils/threads.py,
+    ISSUE 20 — every converted Thread site must stay a thread root or
+    the shared-state/lock-discipline analyses go blind to it), and
     `<pool>.submit(f, ...)` / `<pool>.map(f, it)` executor dispatch."""
     out: list[str] = []
     func = call.func
@@ -259,6 +262,17 @@ def thread_targets(graph: CallGraph, call: ast.Call,
                 ref = graph.resolve_ref(kw.value, fn)
                 if ref:
                     out.extend(CallGraph.callee_ids(ref))
+    elif dn == "spawn" or dn.endswith(".spawn"):
+        # spawn(role, target) — target is positional arg 1 or a
+        # `target=` keyword; same resolution as Thread(target=...).
+        target = call.args[1] if len(call.args) > 1 else None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is not None:
+            ref = graph.resolve_ref(target, fn)
+            if ref:
+                out.extend(CallGraph.callee_ids(ref))
     elif isinstance(func, ast.Attribute) and func.attr in ("submit", "map"):
         recv = func.value
         rname = (recv.attr if isinstance(recv, ast.Attribute)

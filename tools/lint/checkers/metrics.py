@@ -153,7 +153,11 @@ ALLOWED_TAG_KEYS = {
     "node",    # node id (bounded by cluster size)
     "tier",    # container representation tier (dense/array/run)
     "class",   # error class (4xx/5xx/transport/decode)
-    "state",   # cluster state enum
+    "state",   # cluster state enum + connection lifecycle state
+               # (server/connplane.py STATES — 8 literals)
+    "role",    # thread role (utils/threads.py vocabulary: one literal
+               # per spawn site + main/unknown — bounded by
+               # construction, NEVER a thread name or peer address)
     "to",      # state-transition target enum
     "won",     # hedge winner (hedge/primary)
     "direction",  # directed-repair resolution (remote_wins/local_wins)
